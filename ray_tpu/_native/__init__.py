@@ -1,0 +1,42 @@
+"""Native components, built lazily with g++ on first use.
+
+The shared library is rebuilt whenever the source is newer than the binary,
+so a fresh checkout works without a separate build step.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+
+_LIBS = {
+    "tpustore": ["objstore.cc"],
+}
+
+
+def lib_path(name: str) -> str:
+    """Return the path to lib<name>.so, compiling it if missing/stale."""
+    sources = _LIBS[name]
+    so = os.path.join(_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_DIR, s) for s in sources]
+    with _LOCK:
+        if not os.path.exists(so) or any(
+            os.path.getmtime(s) > os.path.getmtime(so) for s in srcs
+        ):
+            tmp = so + f".tmp.{os.getpid()}"
+            cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+                   *srcs, "-o", tmp]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise RuntimeError(
+                    f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+            os.replace(tmp, so)  # atomic: concurrent builders race safely
+    return so

@@ -1,0 +1,737 @@
+// Shared-memory object store ("tpustore").
+//
+// TPU-native equivalent of the reference's Plasma store
+// (/root/reference/src/ray/object_manager/plasma/{store.cc,client.cc,dlmalloc.cc}).
+// Design differences from Plasma, chosen for the TPU runtime:
+//
+//  * Plasma is a server: clients speak a flatbuffer protocol over a unix
+//    socket and receive fds to mmap (fling.cc).  Here the WHOLE store state
+//    (object table + allocator + client registry + locks) lives inside one
+//    shared-memory segment, so create/seal/get/release are plain function
+//    calls guarded by a process-shared robust mutex — no IPC round-trip on
+//    the hot path.  On a TPU host every worker feeds the same chips; the
+//    store's job is to hand zero-copy host buffers to jax.device_put as
+//    fast as possible.
+//
+//  * Plasma tracks per-client references in the server and releases them on
+//    disconnect.  Here each attached client claims a slot in a shared client
+//    registry and records its refs there; when an allocation fails, a
+//    reclaim pass drops the refs (and unsealed creations) of clients whose
+//    pid no longer exists, so crashed workers cannot leak pinned capacity.
+//
+//  * Eviction is LRU over sealed, unreferenced objects, like Plasma's
+//    eviction_policy.h, but runs inline in the allocating client.
+//
+//  * Object IDs are 28 bytes (TaskID(24) + return index(4)), matching the
+//    Python layer's lineage-embedded IDs (ray_tpu/_private/ids.py).
+//
+// Build: g++ -O2 -fPIC -shared -pthread objstore.cc -o libtpustore.so
+
+#include <cerrno>
+#include <csignal>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x545055535452304bULL;  // "TPUSTR0K"
+constexpr uint32_t kIdSize = 28;
+constexpr uint64_t kAlign = 64;  // payload alignment: cacheline, XLA-friendly
+constexpr uint64_t kBlockHeader = 64;
+constexpr uint32_t kMaxClients = 64;
+constexpr uint32_t kRefsPerClient = 4096;  // open-addressed, so keep <70% full
+
+// ---- error codes (mirrored in ray_tpu/_private/object_store.py) ----
+enum {
+  TPUS_OK = 0,
+  TPUS_EXISTS = -1,
+  TPUS_NOT_FOUND = -2,
+  TPUS_OOM = -3,
+  TPUS_TIMEOUT = -4,
+  TPUS_BAD_STATE = -5,
+  TPUS_SYS = -6,
+};
+
+enum ObjState : uint32_t {
+  SLOT_EMPTY = 0,
+  OBJ_CREATED = 1,
+  OBJ_SEALED = 2,
+  SLOT_TOMBSTONE = 3,  // deleted slot, keeps probe chains intact
+};
+
+struct Slot {
+  uint8_t id[kIdSize];
+  uint32_t state;
+  int32_t refcount;
+  uint32_t delete_pending;  // delete requested while refcount > 0
+  uint32_t creator_client;  // registry index of the creating client + 1
+  uint64_t generation;      // bumped on every reuse of this slot
+  uint64_t data_off;        // offset of payload from segment base
+  uint64_t data_size;       // user data bytes
+  uint64_t meta_size;       // metadata bytes (stored right after data)
+  uint64_t lru_tick;
+};
+
+// One client's record of a pinned object (open-addressed by slot index).
+struct RefEnt {
+  uint32_t used;
+  uint32_t slot_idx;
+  uint64_t generation;
+  int64_t count;
+};
+
+struct ClientSlot {
+  int32_t pid;      // 0 = free
+  uint32_t nrefs;   // used RefEnt entries
+  RefEnt refs[kRefsPerClient];
+};
+
+// Heap block header (boundary-tag allocator, first fit, coalescing).
+struct Block {
+  uint64_t size;       // total block size including this header
+  uint64_t prev_size;  // size of the physically preceding block (0 if first)
+  uint32_t free_;      // 1 if on the free list
+  uint32_t pad_;
+  uint64_t next_free;  // free-list links: heap offsets biased by +1 (0=null)
+  uint64_t prev_free;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;
+  uint64_t table_off;
+  uint32_t max_objects;
+  uint32_t pad0_;
+  uint64_t clients_off;
+  uint64_t heap_off;
+  uint64_t heap_size;
+  pthread_mutex_t lock;
+  pthread_cond_t seal_cv;
+  uint64_t lru_tick;
+  uint64_t generation;
+  uint64_t bytes_in_use;   // payload bytes of live objects
+  uint64_t num_objects;    // live (created+sealed) objects
+  uint64_t num_evictions;
+  uint64_t num_reclaims;   // dead clients reclaimed
+  uint64_t free_head;      // biased offset (+1) of first free block
+  uint64_t ready_seq;      // bumped on every seal, for cheap wakeup checks
+};
+
+struct Handle {
+  uint8_t* base;
+  uint64_t map_size;
+  Header* hdr;
+  int32_t client_idx;  // -1 if registry was full (untracked legacy mode)
+};
+
+inline Slot* table(Handle* h) {
+  return reinterpret_cast<Slot*>(h->base + h->hdr->table_off);
+}
+
+inline ClientSlot* clients(Handle* h) {
+  return reinterpret_cast<ClientSlot*>(h->base + h->hdr->clients_off);
+}
+
+inline Block* block_at(Handle* h, uint64_t heap_rel) {
+  return reinterpret_cast<Block*>(h->base + h->hdr->heap_off + heap_rel);
+}
+
+inline uint64_t heap_rel_of(Handle* h, Block* b) {
+  return reinterpret_cast<uint8_t*>(b) - (h->base + h->hdr->heap_off);
+}
+
+// ---------- locking (robust, process shared) ----------
+
+void recover_lock(Handle* h) {
+  // Previous owner died mid-critical-section.  All mutations are small and
+  // ordered so the structures stay structurally valid; any leaked refs or
+  // unsealed objects are swept by reclaim_dead_clients().
+  pthread_mutex_consistent(&h->hdr->lock);
+}
+
+int lock_store(Handle* h) {
+  int rc = pthread_mutex_lock(&h->hdr->lock);
+  if (rc == EOWNERDEAD) {
+    recover_lock(h);
+    return 0;
+  }
+  return rc;
+}
+
+void unlock_store(Handle* h) { pthread_mutex_unlock(&h->hdr->lock); }
+
+// ---------- hash table ----------
+
+uint64_t id_hash(const uint8_t* id) {
+  uint64_t x = 1469598103934665603ULL;  // FNV-1a
+  for (uint32_t i = 0; i < kIdSize; i++) {
+    x ^= id[i];
+    x *= 1099511628211ULL;
+  }
+  return x;
+}
+
+Slot* find_slot(Handle* h, const uint8_t* id) {
+  Slot* t = table(h);
+  uint32_t n = h->hdr->max_objects;
+  uint64_t i = id_hash(id) % n;
+  for (uint32_t probes = 0; probes < n; probes++) {
+    Slot* s = &t[(i + probes) % n];
+    if (s->state == SLOT_EMPTY) return nullptr;
+    if (s->state != SLOT_TOMBSTONE && memcmp(s->id, id, kIdSize) == 0) return s;
+  }
+  return nullptr;
+}
+
+Slot* insert_slot(Handle* h, const uint8_t* id) {
+  Slot* t = table(h);
+  uint32_t n = h->hdr->max_objects;
+  uint64_t i = id_hash(id) % n;
+  Slot* first_tomb = nullptr;
+  for (uint32_t probes = 0; probes < n; probes++) {
+    Slot* s = &t[(i + probes) % n];
+    if (s->state == SLOT_EMPTY) return first_tomb ? first_tomb : s;
+    if (s->state == SLOT_TOMBSTONE && !first_tomb) first_tomb = s;
+  }
+  return first_tomb;
+}
+
+// ---------- per-client ref registry ----------
+
+RefEnt* ref_find(ClientSlot* c, uint32_t slot_idx, uint64_t gen, bool insert) {
+  uint64_t i = (uint64_t(slot_idx) * 2654435761u) % kRefsPerClient;
+  RefEnt* first_free = nullptr;
+  for (uint32_t p = 0; p < kRefsPerClient; p++) {
+    RefEnt* e = &c->refs[(i + p) % kRefsPerClient];
+    if (e->used && e->slot_idx == slot_idx && e->generation == gen) return e;
+    if (!e->used && !first_free) {
+      first_free = e;
+      if (!insert) return nullptr;  // free slot ends the probe chain
+    }
+  }
+  if (insert && first_free) return first_free;
+  return nullptr;
+}
+
+void client_track(Handle* h, Slot* s, int64_t delta) {
+  if (h->client_idx < 0) return;
+  ClientSlot* c = &clients(h)[h->client_idx];
+  uint32_t idx = uint32_t(s - table(h));
+  RefEnt* e = ref_find(c, idx, s->generation, delta > 0);
+  if (!e) return;  // registry full or already gone: degrade to untracked
+  if (!e->used) {
+    e->used = 1;
+    e->slot_idx = idx;
+    e->generation = s->generation;
+    e->count = 0;
+    c->nrefs++;
+  }
+  e->count += delta;
+  if (e->count <= 0) {
+    e->used = 0;
+    c->nrefs--;
+  }
+}
+
+// ---------- allocator ----------
+
+uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+void freelist_push(Handle* h, Block* b) {
+  b->free_ = 1;
+  b->next_free = h->hdr->free_head;
+  b->prev_free = 0;
+  if (h->hdr->free_head) {
+    block_at(h, h->hdr->free_head - 1)->prev_free = heap_rel_of(h, b) + 1;
+  }
+  h->hdr->free_head = heap_rel_of(h, b) + 1;
+}
+
+void freelist_remove(Handle* h, Block* b) {
+  if (b->prev_free)
+    block_at(h, b->prev_free - 1)->next_free = b->next_free;
+  else
+    h->hdr->free_head = b->next_free;
+  if (b->next_free) block_at(h, b->next_free - 1)->prev_free = b->prev_free;
+  b->free_ = 0;
+  b->next_free = b->prev_free = 0;
+}
+
+Block* next_block(Handle* h, Block* b) {
+  uint64_t rel = heap_rel_of(h, b) + b->size;
+  if (rel >= h->hdr->heap_size) return nullptr;
+  return block_at(h, rel);
+}
+
+Block* prev_block(Handle* h, Block* b) {
+  if (b->prev_size == 0) return nullptr;
+  return block_at(h, heap_rel_of(h, b) - b->prev_size);
+}
+
+uint64_t heap_alloc(Handle* h, uint64_t payload) {
+  uint64_t need = align_up(payload, kAlign) + kBlockHeader;
+  uint64_t cur = h->hdr->free_head;
+  while (cur) {
+    Block* b = block_at(h, cur - 1);
+    if (b->size >= need) {
+      freelist_remove(h, b);
+      if (b->size - need >= kBlockHeader + kAlign) {
+        uint64_t rest = b->size - need;
+        b->size = need;
+        Block* nb = next_block(h, b);
+        nb->size = rest;
+        nb->prev_size = need;
+        nb->free_ = 0;
+        nb->next_free = nb->prev_free = 0;
+        Block* after = next_block(h, nb);
+        if (after) after->prev_size = rest;
+        freelist_push(h, nb);
+      }
+      return h->hdr->heap_off + heap_rel_of(h, b) + kBlockHeader;
+    }
+    cur = b->next_free;
+  }
+  return 0;
+}
+
+void heap_free(Handle* h, uint64_t payload_off) {
+  Block* b = reinterpret_cast<Block*>(h->base + payload_off - kBlockHeader);
+  Block* nb = next_block(h, b);
+  if (nb && nb->free_) {
+    freelist_remove(h, nb);
+    b->size += nb->size;
+    Block* after = next_block(h, b);
+    if (after) after->prev_size = b->size;
+  }
+  Block* pb = prev_block(h, b);
+  if (pb && pb->free_) {
+    freelist_remove(h, pb);
+    pb->size += b->size;
+    Block* after = next_block(h, pb);
+    if (after) after->prev_size = pb->size;
+    b = pb;
+  }
+  freelist_push(h, b);
+}
+
+// Free an object's storage and clear its slot, compacting tombstones.
+// Lock held.
+void destroy_object(Handle* h, Slot* s) {
+  if (s->data_off) heap_free(h, s->data_off);
+  h->hdr->bytes_in_use -= s->data_size + s->meta_size;
+  h->hdr->num_objects--;
+  s->state = SLOT_TOMBSTONE;
+  s->data_off = 0;
+  // Linear-probing invariant: a tombstone whose successor is EMPTY is not on
+  // any probe chain, so it (and any contiguous tombstones before it) can
+  // revert to EMPTY.  Keeps misses O(1) under churn.
+  Slot* t = table(h);
+  uint32_t n = h->hdr->max_objects;
+  uint32_t idx = uint32_t(s - t);
+  if (t[(idx + 1) % n].state == SLOT_EMPTY) {
+    uint32_t j = idx;
+    for (uint32_t steps = 0; steps < n && t[j].state == SLOT_TOMBSTONE; steps++) {
+      t[j].state = SLOT_EMPTY;
+      j = (j + n - 1) % n;
+    }
+  }
+}
+
+// Evict the least-recently-used sealed unreferenced object.  Lock held.
+bool evict_one(Handle* h) {
+  Slot* t = table(h);
+  Slot* victim = nullptr;
+  for (uint32_t i = 0; i < h->hdr->max_objects; i++) {
+    Slot* s = &t[i];
+    if (s->state == OBJ_SEALED && s->refcount == 0 &&
+        (!victim || s->lru_tick < victim->lru_tick)) {
+      victim = s;
+    }
+  }
+  if (!victim) return false;
+  destroy_object(h, victim);
+  h->hdr->num_evictions++;
+  return true;
+}
+
+// Drop refs held by clients whose pid is gone; destroy their unsealed
+// creations.  Lock held.  Returns true if anything was reclaimed.
+bool reclaim_dead_clients(Handle* h) {
+  bool any = false;
+  ClientSlot* cs = clients(h);
+  for (uint32_t ci = 0; ci < kMaxClients; ci++) {
+    ClientSlot* c = &cs[ci];
+    if (c->pid == 0) continue;
+    if (kill(c->pid, 0) == 0 || errno != ESRCH) continue;  // still alive
+    for (uint32_t ri = 0; ri < kRefsPerClient && c->nrefs > 0; ri++) {
+      RefEnt* e = &c->refs[ri];
+      if (!e->used) continue;
+      Slot* s = &table(h)[e->slot_idx];
+      if (s->state != SLOT_EMPTY && s->state != SLOT_TOMBSTONE &&
+          s->generation == e->generation) {
+        s->refcount -= int32_t(e->count);
+        if (s->refcount < 0) s->refcount = 0;
+        if (s->state == OBJ_CREATED && s->creator_client == ci + 1) {
+          destroy_object(h, s);  // creator died before sealing
+        } else if (s->refcount == 0 && s->delete_pending) {
+          destroy_object(h, s);
+        }
+      }
+      e->used = 0;
+      c->nrefs--;
+      any = true;
+    }
+    c->pid = 0;
+    h->hdr->num_reclaims++;
+    any = true;
+  }
+  return any;
+}
+
+int32_t register_client(Handle* h) {
+  ClientSlot* cs = clients(h);
+  int32_t pid = int32_t(getpid());
+  for (uint32_t i = 0; i < kMaxClients; i++) {
+    if (cs[i].pid == 0 ||
+        (kill(cs[i].pid, 0) != 0 && errno == ESRCH)) {
+      memset(&cs[i], 0, sizeof(ClientSlot));
+      cs[i].pid = pid;
+      return int32_t(i);
+    }
+  }
+  return -1;  // registry full: operate untracked
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpus_create(const char* path, uint64_t heap_size, uint32_t max_objects,
+                void** out) {
+  heap_size = align_up(heap_size, kAlign);
+  uint64_t table_off = align_up(sizeof(Header), kAlign);
+  uint64_t clients_off =
+      align_up(table_off + uint64_t(max_objects) * sizeof(Slot), kAlign);
+  uint64_t heap_off =
+      align_up(clients_off + uint64_t(kMaxClients) * sizeof(ClientSlot), 4096);
+  uint64_t total = heap_off + heap_size;
+
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return TPUS_SYS;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    unlink(path);
+    return TPUS_SYS;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    unlink(path);
+    return TPUS_SYS;
+  }
+
+  Header* hdr = reinterpret_cast<Header*>(mem);
+  memset(hdr, 0, sizeof(Header));
+  hdr->total_size = total;
+  hdr->table_off = table_off;
+  hdr->max_objects = max_objects;
+  hdr->clients_off = clients_off;
+  hdr->heap_off = heap_off;
+  hdr->heap_size = heap_size;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->lock, &ma);
+  pthread_mutexattr_destroy(&ma);
+
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&hdr->seal_cv, &ca);
+  pthread_condattr_destroy(&ca);
+
+  Handle* h = new Handle{reinterpret_cast<uint8_t*>(mem), total, hdr, -1};
+
+  Block* b = block_at(h, 0);
+  b->size = heap_size;
+  b->prev_size = 0;
+  b->free_ = 0;
+  b->next_free = b->prev_free = 0;
+  freelist_push(h, b);
+
+  __sync_synchronize();
+  hdr->magic = kMagic;  // publish: attachers spin until magic is set
+  h->client_idx = register_client(h);
+  *out = h;
+  return TPUS_OK;
+}
+
+int tpus_attach(const char* path, void** out) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return TPUS_SYS;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return TPUS_SYS;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return TPUS_SYS;
+  Header* hdr = reinterpret_cast<Header*>(mem);
+  for (int i = 0; i < 1000 && hdr->magic != kMagic; i++) usleep(1000);
+  if (hdr->magic != kMagic) {
+    munmap(mem, st.st_size);
+    return TPUS_BAD_STATE;
+  }
+  Handle* h =
+      new Handle{reinterpret_cast<uint8_t*>(mem), (uint64_t)st.st_size, hdr, -1};
+  if (lock_store(h) == 0) {
+    h->client_idx = register_client(h);
+    unlock_store(h);
+  }
+  *out = h;
+  return TPUS_OK;
+}
+
+void tpus_close(void* hv) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  // Clean detach: drop any refs we still hold so we don't depend on a later
+  // reclaim pass.
+  if (h->client_idx >= 0 && lock_store(h) == 0) {
+    ClientSlot* c = &clients(h)[h->client_idx];
+    for (uint32_t ri = 0; ri < kRefsPerClient && c->nrefs > 0; ri++) {
+      RefEnt* e = &c->refs[ri];
+      if (!e->used) continue;
+      Slot* s = &table(h)[e->slot_idx];
+      if (s->state != SLOT_EMPTY && s->state != SLOT_TOMBSTONE &&
+          s->generation == e->generation) {
+        s->refcount -= int32_t(e->count);
+        if (s->refcount < 0) s->refcount = 0;
+        if (s->state == OBJ_CREATED) {
+          destroy_object(h, s);
+        } else if (s->refcount == 0 && s->delete_pending) {
+          destroy_object(h, s);
+        }
+      }
+      e->used = 0;
+      c->nrefs--;
+    }
+    c->pid = 0;
+    unlock_store(h);
+  }
+  munmap(h->base, h->map_size);
+  delete h;
+}
+
+int tpus_destroy(const char* path) { return unlink(path) == 0 ? TPUS_OK : TPUS_SYS; }
+
+unsigned char* tpus_base(void* hv) { return reinterpret_cast<Handle*>(hv)->base; }
+
+int tpus_obj_create(void* hv, const uint8_t* id, uint64_t data_size,
+                    uint64_t meta_size, uint64_t* data_off) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  if (find_slot(h, id)) {
+    unlock_store(h);
+    return TPUS_EXISTS;
+  }
+  Slot* s = insert_slot(h, id);
+  if (!s) {
+    reclaim_dead_clients(h);
+    s = insert_slot(h, id);
+    if (!s) {
+      unlock_store(h);
+      return TPUS_OOM;  // table full
+    }
+  }
+  uint64_t total = data_size + meta_size;
+  uint64_t off = 0;
+  if (total > 0) {
+    bool reclaimed = false;
+    while ((off = heap_alloc(h, total)) == 0) {
+      if (evict_one(h)) continue;
+      if (!reclaimed) {
+        reclaimed = true;
+        if (reclaim_dead_clients(h)) continue;
+      }
+      unlock_store(h);
+      return TPUS_OOM;
+    }
+  }
+  memcpy(s->id, id, kIdSize);
+  s->state = OBJ_CREATED;
+  s->refcount = 1;  // creator holds a ref until seal
+  s->delete_pending = 0;
+  s->creator_client = h->client_idx >= 0 ? uint32_t(h->client_idx) + 1 : 0;
+  s->generation = ++h->hdr->generation;
+  s->data_off = off;
+  s->data_size = data_size;
+  s->meta_size = meta_size;
+  s->lru_tick = ++h->hdr->lru_tick;
+  h->hdr->bytes_in_use += total;
+  h->hdr->num_objects++;
+  client_track(h, s, +1);
+  *data_off = off;
+  unlock_store(h);
+  return TPUS_OK;
+}
+
+int tpus_obj_seal(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  Slot* s = find_slot(h, id);
+  if (!s) {
+    unlock_store(h);
+    return TPUS_NOT_FOUND;
+  }
+  if (s->state != OBJ_CREATED) {
+    unlock_store(h);
+    return TPUS_BAD_STATE;
+  }
+  s->state = OBJ_SEALED;
+  s->refcount--;  // drop creator ref
+  client_track(h, s, -1);
+  h->hdr->ready_seq++;
+  pthread_cond_broadcast(&h->hdr->seal_cv);
+  unlock_store(h);
+  return TPUS_OK;
+}
+
+int tpus_obj_abort(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  Slot* s = find_slot(h, id);
+  if (!s) {
+    unlock_store(h);
+    return TPUS_NOT_FOUND;
+  }
+  if (s->state != OBJ_CREATED) {
+    unlock_store(h);
+    return TPUS_BAD_STATE;
+  }
+  client_track(h, s, -1);
+  destroy_object(h, s);
+  unlock_store(h);
+  return TPUS_OK;
+}
+
+int tpus_obj_get(void* hv, const uint8_t* id, int64_t timeout_ms,
+                 uint64_t* data_off, uint64_t* data_size, uint64_t* meta_size) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  struct timespec deadline;
+  if (timeout_ms > 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec++;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
+  if (lock_store(h)) return TPUS_SYS;
+  for (;;) {
+    Slot* s = find_slot(h, id);
+    if (s && s->state == OBJ_SEALED) {
+      s->refcount++;
+      s->lru_tick = ++h->hdr->lru_tick;
+      client_track(h, s, +1);
+      *data_off = s->data_off;
+      *data_size = s->data_size;
+      *meta_size = s->meta_size;
+      unlock_store(h);
+      return TPUS_OK;
+    }
+    if (timeout_ms == 0) {
+      unlock_store(h);
+      return s ? TPUS_BAD_STATE : TPUS_NOT_FOUND;
+    }
+    int rc;
+    if (timeout_ms > 0) {
+      rc = pthread_cond_timedwait(&h->hdr->seal_cv, &h->hdr->lock, &deadline);
+    } else {
+      rc = pthread_cond_wait(&h->hdr->seal_cv, &h->hdr->lock);
+    }
+    if (rc == EOWNERDEAD) {
+      recover_lock(h);  // waiter inherited a dead owner's mutex
+    } else if (rc == ETIMEDOUT) {
+      unlock_store(h);
+      return TPUS_TIMEOUT;
+    }
+  }
+}
+
+int tpus_obj_release(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  Slot* s = find_slot(h, id);
+  if (!s) {
+    unlock_store(h);
+    return TPUS_NOT_FOUND;
+  }
+  if (s->refcount > 0) {
+    s->refcount--;
+    client_track(h, s, -1);
+  }
+  if (s->refcount == 0 && s->delete_pending) destroy_object(h, s);
+  unlock_store(h);
+  return TPUS_OK;
+}
+
+int tpus_obj_delete(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  Slot* s = find_slot(h, id);
+  if (!s) {
+    unlock_store(h);
+    return TPUS_NOT_FOUND;
+  }
+  if (s->refcount > 0) {
+    s->delete_pending = 1;
+  } else {
+    destroy_object(h, s);
+  }
+  unlock_store(h);
+  return TPUS_OK;
+}
+
+int tpus_obj_contains(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  Slot* s = find_slot(h, id);
+  int rc = (s && s->state == OBJ_SEALED) ? 1 : 0;
+  unlock_store(h);
+  return rc;
+}
+
+// Sweep dead clients now (daemon periodic hygiene).
+int tpus_reclaim(void* hv) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  bool any = reclaim_dead_clients(h);
+  unlock_store(h);
+  return any ? 1 : 0;
+}
+
+int tpus_stats(void* hv, uint64_t* capacity, uint64_t* used, uint64_t* count,
+               uint64_t* evictions) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  *capacity = h->hdr->heap_size;
+  *used = h->hdr->bytes_in_use;
+  *count = h->hdr->num_objects;
+  *evictions = h->hdr->num_evictions;
+  unlock_store(h);
+  return TPUS_OK;
+}
+
+}  // extern "C"

@@ -1,0 +1,157 @@
+"""Binary identifiers for every entity in the runtime.
+
+Design follows the reference's ID scheme (src/ray/common/id.h): fixed-width
+binary IDs with lineage embedded (an ObjectID embeds the TaskID that produced
+it; a TaskID embeds the ActorID/JobID context).  We keep the same widths so
+tooling expectations (hex string lengths) carry over, but generation is
+simplified: random unique bytes + embedded parent prefixes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_UNIQUE_SIZE = 16  # random portion
+
+
+class BaseID:
+    SIZE = 20
+    __slots__ = ("_bytes",)
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, bytes) or len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {binary!r}"
+            )
+        self._bytes = binary
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls.SIZE)
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:12]}…)"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+    _counter = 0
+    _lock = threading.Lock()
+
+    @classmethod
+    def next(cls) -> "JobID":
+        with cls._lock:
+            cls._counter += 1
+            return cls(cls._counter.to_bytes(4, "little"))
+
+
+class NodeID(BaseID):
+    SIZE = 20
+
+
+class WorkerID(BaseID):
+    SIZE = 20
+
+
+class ActorID(BaseID):
+    """12 random bytes + 4-byte job id."""
+
+    SIZE = 16
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(cls.SIZE - JobID.SIZE) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[-JobID.SIZE:])
+
+
+class TaskID(BaseID):
+    """8 random bytes + 16-byte actor id (nil for normal tasks)."""
+
+    SIZE = 24
+
+    @classmethod
+    def of(cls, actor_id: "ActorID | None" = None) -> "TaskID":
+        aid = actor_id.binary() if actor_id is not None else b"\x00" * ActorID.SIZE
+        return cls(os.urandom(cls.SIZE - ActorID.SIZE) + aid)
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[-ActorID.SIZE:])
+
+
+class ObjectID(BaseID):
+    """TaskID (24 bytes) + 4-byte little-endian return index = 28 bytes.
+
+    Mirrors the reference's lineage-embedded ObjectID: given an ObjectID we
+    can recover the task that produces it, which is what makes lineage
+    reconstruction possible without a central map.
+    """
+
+    SIZE = 28
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Put objects use the high bit of the index to avoid colliding with
+        # return indices.
+        return cls(task_id.binary() + (0x8000_0000 | put_index).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[: TaskID.SIZE])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bytes[TaskID.SIZE:], "little") & 0x7FFF_FFFF
+
+    def is_put(self) -> bool:
+        return bool(int.from_bytes(self._bytes[TaskID.SIZE:], "little") & 0x8000_0000)
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+
+
+__all__ = [
+    "BaseID",
+    "JobID",
+    "NodeID",
+    "WorkerID",
+    "ActorID",
+    "TaskID",
+    "ObjectID",
+    "PlacementGroupID",
+]

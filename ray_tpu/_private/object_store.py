@@ -1,0 +1,224 @@
+"""ctypes client for the native shared-memory object store.
+
+Equivalent of the reference's plasma client
+(/root/reference/src/ray/object_manager/plasma/client.cc) but with no socket
+protocol: the store state lives in shared memory and every operation is a
+direct call into libtpustore.so (see objstore.cc for the design rationale).
+
+Zero-copy: `get()` returns memoryviews straight into the mapped segment.
+The serialization layer builds numpy arrays over them with np.frombuffer,
+which jax.device_put consumes without an extra host copy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from ray_tpu import _native
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError, RayTpuTimeoutError
+
+_OK = 0
+_EXISTS = -1
+_NOT_FOUND = -2
+_OOM = -3
+_TIMEOUT = -4
+_BAD_STATE = -5
+_SYS = -6
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_native.lib_path("tpustore"))
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.tpus_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_uint32, ctypes.POINTER(ctypes.c_void_p)]
+        lib.tpus_attach.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+        lib.tpus_close.argtypes = [ctypes.c_void_p]
+        lib.tpus_close.restype = None
+        lib.tpus_destroy.argtypes = [ctypes.c_char_p]
+        lib.tpus_base.argtypes = [ctypes.c_void_p]
+        lib.tpus_base.restype = ctypes.c_void_p
+        lib.tpus_obj_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64, ctypes.c_uint64, u64p]
+        lib.tpus_obj_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpus_obj_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpus_obj_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64, u64p, u64p, u64p]
+        lib.tpus_obj_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpus_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpus_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpus_reclaim.argtypes = [ctypes.c_void_p]
+        lib.tpus_stats.argtypes = [ctypes.c_void_p, u64p, u64p, u64p, u64p]
+        _lib = lib
+    return _lib
+
+
+class StoreBuffer:
+    """A sealed object's data+metadata views plus the ref keeping them pinned."""
+
+    __slots__ = ("store", "object_id", "data", "metadata", "_released")
+
+    def __init__(self, store, object_id, data, metadata):
+        self.store = store
+        self.object_id = object_id
+        self.data = data
+        self.metadata = metadata
+        self._released = False
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self.data = None
+            self.metadata = None
+            self.store._release(self.object_id)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class ObjectStore:
+    """One per node; the node daemon creates it, workers attach."""
+
+    def __init__(self, path: str, handle, view: memoryview, owner: bool):
+        self.path = path
+        self._h = handle
+        self._view = view
+        self._owner = owner
+        self._closed = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    def create(cls, path: str, capacity_bytes: int, max_objects: int = 1 << 16):
+        lib = _load()
+        h = ctypes.c_void_p()
+        rc = lib.tpus_create(path.encode(), capacity_bytes, max_objects,
+                             ctypes.byref(h))
+        _check(rc, "create store")
+        return cls(path, h, _map_view(lib, h), owner=True)
+
+    @classmethod
+    def attach(cls, path: str):
+        lib = _load()
+        h = ctypes.c_void_p()
+        rc = lib.tpus_attach(path.encode(), ctypes.byref(h))
+        _check(rc, "attach store")
+        return cls(path, h, _map_view(lib, h), owner=False)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._view = None
+            _load().tpus_close(self._h)
+            if self._owner:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+    # -- object ops ----------------------------------------------------------
+
+    def create_object(self, object_id: ObjectID, data_size: int,
+                      metadata: bytes = b"") -> memoryview:
+        """Allocate an unsealed object; returns a writable view of the data
+        region. Caller writes into it and then calls seal()."""
+        lib = _load()
+        off = ctypes.c_uint64()
+        rc = lib.tpus_obj_create(self._h, object_id.binary(), data_size,
+                                 len(metadata), ctypes.byref(off))
+        if rc == _OOM:
+            raise ObjectStoreFullError(
+                f"cannot allocate {data_size} bytes (capacity {self.stats()['capacity']})")
+        _check(rc, f"create {object_id}")
+        base = off.value
+        if metadata:
+            self._view[base + data_size: base + data_size + len(metadata)] = metadata
+        return self._view[base: base + data_size]
+
+    def put_bytes(self, object_id: ObjectID, data: bytes, metadata: bytes = b""):
+        buf = self.create_object(object_id, len(data), metadata)
+        buf[:] = data
+        self.seal(object_id)
+
+    def seal(self, object_id: ObjectID):
+        _check(_load().tpus_obj_seal(self._h, object_id.binary()),
+               f"seal {object_id}")
+
+    def abort(self, object_id: ObjectID):
+        _load().tpus_obj_abort(self._h, object_id.binary())
+
+    def get(self, object_id: ObjectID, timeout_ms: int = 0) -> StoreBuffer | None:
+        """Returns pinned zero-copy views, or None when absent (timeout_ms=0)
+        / raises RayTpuTimeoutError (timeout_ms>0).  timeout_ms=-1 blocks."""
+        lib = _load()
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        msize = ctypes.c_uint64()
+        rc = lib.tpus_obj_get(self._h, object_id.binary(), timeout_ms,
+                              ctypes.byref(off), ctypes.byref(size),
+                              ctypes.byref(msize))
+        if rc in (_NOT_FOUND, _BAD_STATE):
+            return None
+        if rc == _TIMEOUT:
+            raise RayTpuTimeoutError(f"get({object_id}) timed out")
+        _check(rc, f"get {object_id}")
+        base, n, m = off.value, size.value, msize.value
+        return StoreBuffer(self, object_id,
+                           self._view[base: base + n],
+                           bytes(self._view[base + n: base + n + m]))
+
+    def _release(self, object_id: ObjectID):
+        if not self._closed:
+            _load().tpus_obj_release(self._h, object_id.binary())
+
+    def delete(self, object_id: ObjectID):
+        _load().tpus_obj_delete(self._h, object_id.binary())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        rc = _load().tpus_obj_contains(self._h, object_id.binary())
+        _check(min(rc, 0), f"contains {object_id}")
+        return rc == 1
+
+    def reclaim_dead_clients(self) -> bool:
+        """Drop refs and unsealed creations of clients whose process died.
+        Also runs automatically when an allocation fails."""
+        return _load().tpus_reclaim(self._h) == 1
+
+    def stats(self) -> dict:
+        cap = ctypes.c_uint64()
+        used = ctypes.c_uint64()
+        count = ctypes.c_uint64()
+        ev = ctypes.c_uint64()
+        _check(_load().tpus_stats(self._h, ctypes.byref(cap), ctypes.byref(used),
+                                  ctypes.byref(count), ctypes.byref(ev)), "stats")
+        return {"capacity": cap.value, "used": used.value,
+                "num_objects": count.value, "num_evictions": ev.value}
+
+
+def _map_view(lib, h) -> memoryview:
+    import mmap as _  # noqa: F401  (documentation: base points into an mmap)
+    base = lib.tpus_base(h)
+    # Build a memoryview over the raw mapping.  The segment never moves or
+    # shrinks while the handle is open, so this is safe.
+    # Size: read the header's total_size (second u64 of the header).
+    total = ctypes.cast(base + 8, ctypes.POINTER(ctypes.c_uint64)).contents.value
+    arr = (ctypes.c_ubyte * total).from_address(base)
+    return memoryview(arr).cast("B")
+
+
+def _check(rc: int, what: str):
+    if rc == _OK:
+        return
+    msg = {_EXISTS: "already exists", _NOT_FOUND: "not found", _OOM: "out of memory",
+           _TIMEOUT: "timeout", _BAD_STATE: "bad state", _SYS: "system error"}.get(rc, rc)
+    if rc == _OOM:
+        raise ObjectStoreFullError(f"{what}: {msg}")
+    raise RuntimeError(f"object store: {what}: {msg}")

@@ -1,0 +1,97 @@
+"""Public exception hierarchy.
+
+Mirrors the capability surface of the reference's python/ray/exceptions.py:
+task errors wrap the remote traceback, actor errors carry actor identity,
+and lost objects raise a reconstruction-aware error.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTpuTimeoutError(RayTpuError, TimeoutError):
+    """A blocking get()/wait() exceeded its timeout."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception.
+
+    The remote traceback string is carried so the driver sees where the
+    failure happened (reference: python/ray/exceptions.py RayTaskError).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    """Base for actor failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead: creation failed, it was killed, or it crashed
+    beyond its max_restarts budget."""
+
+    def __init__(self, actor_id=None, reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"actor {actor_id} died: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is restarting; the call may be retried."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object was evicted/lost and could not be reconstructed."""
+
+    def __init__(self, object_id=None, reason: str = ""):
+        self.object_id = object_id
+        super().__init__(f"object {object_id} lost: {reason}")
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Allocation failed even after eviction/spilling."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing a task/actor runtime environment failed."""
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    """The placement group cannot fit in the cluster."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled with ray_tpu.cancel()."""
+
+
+class GetTimeoutError(RayTpuTimeoutError):
+    """Alias kept for API parity with the reference."""
+
+
+__all__ = [
+    "RayTpuError",
+    "RayTpuTimeoutError",
+    "TaskError",
+    "WorkerCrashedError",
+    "ActorError",
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "ObjectLostError",
+    "ObjectStoreFullError",
+    "RuntimeEnvSetupError",
+    "PlacementGroupUnschedulableError",
+    "TaskCancelledError",
+    "GetTimeoutError",
+]

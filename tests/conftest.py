@@ -1,0 +1,31 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's analogue is the
+in-process multi-node Cluster fixture, python/ray/cluster_utils.py:99): JAX on
+CPU with xla_force_host_platform_device_count=8 stands in for an 8-chip TPU
+slice, so every sharding/collective path is exercised without TPU hardware.
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_store(tmp_path):
+    from ray_tpu._private.object_store import ObjectStore
+
+    store = ObjectStore.create(str(tmp_path / "store.shm"), 16 << 20)
+    yield store
+    store.close()

@@ -1,0 +1,32 @@
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+
+
+def test_sizes_and_hex():
+    n = NodeID.from_random()
+    assert len(n.binary()) == 20
+    assert NodeID.from_hex(n.hex()) == n
+
+
+def test_object_id_embeds_lineage():
+    job = JobID.next()
+    actor = ActorID.of(job)
+    task = TaskID.of(actor)
+    obj = ObjectID.for_return(task, 2)
+    assert obj.task_id() == task
+    assert obj.return_index() == 2
+    assert not obj.is_put()
+    assert task.actor_id() == actor
+    assert actor.job_id() == job
+
+
+def test_put_ids_distinct_from_returns():
+    task = TaskID.of()
+    a = ObjectID.for_return(task, 1)
+    b = ObjectID.for_put(task, 1)
+    assert a != b
+    assert b.is_put() and b.return_index() == 1
+
+
+def test_nil():
+    assert ActorID.nil().is_nil()
+    assert not ActorID.of(JobID.next()).is_nil()
