@@ -1,0 +1,31 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+A from-scratch framework with the capability surface of Ray (tasks, actors,
+objects, placement groups, Train/Tune/Data/Serve/RL libraries), architected
+for TPUs: JAX/XLA is the compute plane (pjit/GSPMD sharding over ICI meshes,
+Pallas kernels), a shared-memory object store + per-host daemons + a global
+control service form the host-side runtime.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu.api import (  # noqa: F401
+    ActorClass,
+    ActorHandle,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.object_ref import ObjectRef  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401

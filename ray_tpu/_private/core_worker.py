@@ -1,0 +1,1041 @@
+"""CoreWorker — the runtime library linked into every driver and worker.
+
+Reference parity: src/ray/core_worker/core_worker.h:284 —
+Put/Get/Wait/SubmitTask/CreateActor/SubmitActorTask, plus the subsystems it
+owns: in-process memory store for small objects (memory_store.h:43),
+ownership-based reference counting (reference_count.h:61), the pending-task
+table with retries + lineage reconstruction (task_manager.h:90), the direct
+task submitter with worker leasing (transport/direct_task_transport.h:75),
+and the per-actor ordered submitter (direct_actor_task_submitter.h:67).
+
+Threading: the public API is synchronous; all networking runs on a dedicated
+asyncio thread (rpc.EventLoopThread) — the same split as the reference's
+Python-on-C++-asio design.  Worker-side task execution runs on the process
+main thread, fed by a queue from the RPC handlers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import queue
+import threading
+import traceback
+from dataclasses import dataclass, field
+
+from ray_tpu import object_ref as object_ref_mod
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ObjectLostError,
+    RayTpuTimeoutError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.protocol import (
+    INLINE_LIMIT,
+    RefArg,
+    Resources,
+    TaskSpec,
+    ValueArg,
+)
+from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcClient, RpcServer
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+@dataclass
+class _ObjectState:
+    """Owner-side record for one owned object (directory + refcount)."""
+
+    inline: tuple | None = None          # (data, metadata)
+    locations: set = field(default_factory=set)  # node_id hex strings
+    error: BaseException | None = None
+    pending: bool = True
+    local_refs: int = 0
+    borrows: int = 0
+    pins: int = 0                        # in-flight task args etc.
+    event: asyncio.Event | None = None   # set when no longer pending
+    producing_task: TaskID | None = None
+
+
+@dataclass
+class _PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    future: object                       # concurrent.futures.Future | None
+    lineage: bool = False                # keep spec for reconstruction
+
+
+class _ActorSubmitter:
+    """Client-side per-actor ordered pipeline
+    (reference: direct_actor_task_submitter.h:67).
+
+    seq is assigned in program order at submit time.  On actor restart the
+    fresh worker expects wire sequence numbers from 0, so sends are rebased:
+    wire_seq = seq - epoch_base, where epoch_base is the count of completed
+    calls when the restart was detected (execution is in-order per actor, so
+    completed calls form a prefix)."""
+
+    def __init__(self, actor_id: ActorID):
+        self.actor_id = actor_id
+        self.seq = 0
+        self.epoch_base = 0
+        self.completed = 0
+        self.address: str | None = None
+        self.version = -1
+        self.dead: str | None = None
+        self.lock = asyncio.Lock()
+
+
+class CoreWorker:
+    def __init__(self, *, mode: str, gcs_address: str, store_path: str | None,
+                 node_id: NodeID | None, hostd_address: str | None,
+                 job_id: JobID | None = None, host: str = "127.0.0.1"):
+        self.mode = mode                      # "driver" | "worker"
+        self.worker_id = WorkerID.from_random()
+        self.gcs_address = gcs_address
+        self.node_id = node_id
+        self.hostd_address = hostd_address
+        self.host = host
+        self.io = EventLoopThread()
+        self.gcs = RpcClient(gcs_address)
+        self.pool = ClientPool()
+        self.store = ObjectStore.attach(store_path) if store_path else None
+        self.fn_manager = FunctionManager(self._kv_call)
+        self.job_id = job_id
+        self.objects: dict[ObjectID, _ObjectState] = {}
+        self.tasks: dict[TaskID, _PendingTask] = {}
+        self.actor_submitters: dict[ActorID, _ActorSubmitter] = {}
+        self.borrowed: dict[ObjectID, str] = {}  # borrowed ref -> owner addr
+        self._put_index = 0
+        self._obj_lock = threading.RLock()
+        self.current_task_id = TaskID.of()    # driver context task
+        self.address = ""
+        self._shutdown = False
+        # Execution side (worker mode)
+        self.exec_queue: queue.Queue = queue.Queue()
+        self.actor_instance = None
+        self.actor_id: ActorID | None = None
+        self._actor_seq_state: dict[bytes, dict] = {}  # caller -> ordering
+        self.server = RpcServer(host)
+        self._register_services()
+        port = self.io.run(self.server.start(0))
+        self.address = f"{host}:{port}"
+        object_ref_mod._install_hooks(_RefHooks(self))
+
+    # ------------------------------------------------------------------
+    # RPC services (owner + execution)
+    # ------------------------------------------------------------------
+
+    def _register_services(self):
+        s = self.server
+        s.register("CoreWorker", "PushTask", self._rpc_push_task)
+        s.register("CoreWorker", "CreateActor", self._rpc_create_actor)
+        s.register("CoreWorker", "KillActor", self._rpc_kill_actor)
+        s.register("CoreWorker", "GetObjectStatus", self._rpc_get_object_status)
+        s.register("CoreWorker", "AddBorrow", self._rpc_add_borrow)
+        s.register("CoreWorker", "RemoveBorrow", self._rpc_remove_borrow)
+        s.register("CoreWorker", "AddLocation", self._rpc_add_location)
+        s.register("CoreWorker", "Ping", self._rpc_ping)
+
+    async def _rpc_ping(self, req):
+        return {"ok": True, "worker_id": self.worker_id}
+
+    async def _kv_call(self, method: str, request):
+        return await self.gcs.call("Kv", method, request)
+
+    # ---- owner services ----
+
+    async def _rpc_get_object_status(self, req):
+        """Resolve an object for a borrower: inline value, locations, or
+        error.  Long-polls while the producing task is still running
+        (reference: core_worker.proto GetObjectStatus:411)."""
+        oid = ObjectID(req["id"])
+        wait_s = req.get("wait_s", 30.0)
+        st = self.objects.get(oid)
+        if st is None:
+            return {"status": "unknown"}
+        if st.pending:
+            if st.event is None:
+                st.event = asyncio.Event()
+            try:
+                await asyncio.wait_for(st.event.wait(), wait_s)
+            except asyncio.TimeoutError:
+                return {"status": "pending"}
+            st = self.objects.get(oid)
+            if st is None:
+                return {"status": "unknown"}
+        if st.error is not None:
+            return {"status": "error", "error": st.error}
+        if st.inline is not None:
+            return {"status": "inline", "data": st.inline[0],
+                    "metadata": st.inline[1]}
+        return {"status": "locations", "locations": sorted(st.locations)}
+
+    async def _rpc_add_borrow(self, req):
+        st = self.objects.get(ObjectID(req["id"]))
+        if st is not None:
+            st.borrows += 1
+        return {"ok": True}
+
+    async def _rpc_remove_borrow(self, req):
+        oid = ObjectID(req["id"])
+        st = self.objects.get(oid)
+        if st is not None:
+            st.borrows = max(0, st.borrows - 1)
+            self._maybe_free(oid)
+        return {"ok": True}
+
+    async def _rpc_add_location(self, req):
+        st = self.objects.get(ObjectID(req["id"]))
+        if st is not None:
+            st.locations.add(req["node"])
+        return {"ok": True}
+
+    # ---- execution services ----
+
+    async def _rpc_push_task(self, req):
+        """Queue a task for the execution thread and await its result
+        (reference: core_worker.proto PushTask:406)."""
+        spec: TaskSpec = req["spec"]
+        loop = asyncio.get_running_loop()
+        done = loop.create_future()
+        if spec.actor_id is not None and not spec.actor_creation:
+            self._enqueue_actor_task(req, done, loop)
+        else:
+            self.exec_queue.put((spec, done, loop))
+        return await done
+
+    def _enqueue_actor_task(self, req, done, loop):
+        """Order actor tasks per caller by sequence number
+        (reference: transport/actor_scheduling_queue.h:40).
+
+        A restarted actor starts with no ordering state while callers keep
+        counting, so the first seq seen from an unknown caller initializes
+        the expectation; anything below `next` is a stale retry and runs
+        immediately rather than being held forever."""
+        spec: TaskSpec = req["spec"]
+        caller = req.get("caller", b"")
+        wire_seq = req.get("seq", spec.seq_no)
+        state = self._actor_seq_state.setdefault(
+            caller, {"next": 0, "held": {}})
+        if wire_seq < state["next"]:
+            # Stale retry rebased below the current horizon: run immediately.
+            self.exec_queue.put((spec, done, loop))
+            return
+        state["held"][wire_seq] = (spec, done, loop)
+        while state["next"] in state["held"]:
+            item = state["held"].pop(state["next"])
+            state["next"] += 1
+            self.exec_queue.put(item)
+
+    async def _rpc_create_actor(self, req):
+        spec: TaskSpec = req["spec"]
+        loop = asyncio.get_running_loop()
+        done = loop.create_future()
+        self.actor_id = req["actor_id"]
+        self.exec_queue.put((spec, done, loop))
+        return await done
+
+    async def _rpc_kill_actor(self, req):
+        self.exec_queue.put(None)  # sentinel: exit main loop
+        asyncio.get_running_loop().call_later(0.5, os._exit, 0)
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # Public API: put / get / wait
+    # ------------------------------------------------------------------
+
+    def put(self, value) -> ObjectRef:
+        self._put_index += 1
+        oid = ObjectID.for_put(self.current_task_id, self._put_index)
+        sv = ser.serialize(value, ref_sink=self._pin_serialized_ref)
+        self._store_owned_value(oid, sv)
+        return ObjectRef(oid, self.address)
+
+    def _store_owned_value(self, oid: ObjectID, sv: ser.SerializedValue):
+        with self._obj_lock:
+            st = self.objects.setdefault(oid, _ObjectState())
+        st.pending = False
+        if sv.total_size < INLINE_LIMIT or self.store is None:
+            st.inline = (sv.to_bytes(), sv.metadata)
+        else:
+            view = self.store.create_object(oid, sv.total_size, sv.metadata)
+            sv.write_into(view)
+            self.store.seal(oid)
+            st.locations.add(self.node_id.hex())
+        self._signal_ready(oid, st)
+
+    def _signal_ready(self, oid: ObjectID, st: _ObjectState):
+        if st.event is not None:
+            self.io.loop.call_soon_threadsafe(st.event.set)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        values = self.io.run(self._get_async(refs, timeout))
+        return values[0] if single else values
+
+    async def _get_async(self, refs, timeout):
+        return await asyncio.gather(*[self._get_one(r, timeout) for r in refs])
+
+    async def _get_one(self, ref: ObjectRef, timeout: float | None):
+        deadline = None if timeout is None else \
+            asyncio.get_running_loop().time() + timeout
+        for attempt in range(5):
+            data, metadata = await self._resolve_bytes(ref, deadline)
+            if data is not None:
+                return ser.deserialize(data, metadata)
+            # Object lost: try lineage reconstruction then loop.
+            if not await self._try_reconstruct(ref):
+                raise ObjectLostError(ref.id, "no live copy and no lineage")
+        raise ObjectLostError(ref.id, "reconstruction did not converge")
+
+    async def _resolve_bytes(self, ref: ObjectRef, deadline):
+        """Return (data, metadata) or (None, None) if the object was lost."""
+        oid = ref.id
+        owned = ref.owner_address in ("", self.address)
+        while True:
+            st = self.objects.get(oid) if owned else None
+            if owned and st is None:
+                raise ObjectLostError(oid, "owner has no record of object")
+            if owned and not st.pending:
+                if st.error is not None:
+                    raise st.error
+                if st.inline is not None:
+                    return st.inline
+                got = await self._fetch_from_locations(oid, sorted(st.locations))
+                if got is not None:
+                    return got
+                st.locations.clear()
+                return None, None
+            if not owned:
+                # Local store fast path before asking the owner.
+                if self.store is not None:
+                    buf = self.store.get(oid)
+                    if buf is not None:
+                        try:
+                            return bytes(buf.data), buf.metadata
+                        finally:
+                            buf.release()
+                reply = await self._call_owner(
+                    ref, "GetObjectStatus",
+                    {"id": oid.binary(), "wait_s": 5.0})
+                status = reply["status"]
+                if status == "inline":
+                    return reply["data"], reply["metadata"]
+                if status == "error":
+                    raise reply["error"]
+                if status == "locations":
+                    got = await self._fetch_from_locations(
+                        oid, reply["locations"], owner=ref.owner_address)
+                    if got is not None:
+                        return got
+                    return None, None
+                if status == "unknown":
+                    raise ObjectLostError(oid, "owner does not know object")
+            # pending → check deadline and loop (owner long-polls internally)
+            if owned and st.pending:
+                if st.event is None:
+                    st.event = asyncio.Event()
+                try:
+                    wait = None if deadline is None else \
+                        deadline - asyncio.get_running_loop().time()
+                    if wait is not None and wait <= 0:
+                        raise RayTpuTimeoutError(f"get({oid}) timed out")
+                    await asyncio.wait_for(st.event.wait(),
+                                           None if wait is None else wait)
+                except asyncio.TimeoutError:
+                    raise RayTpuTimeoutError(f"get({oid}) timed out") from None
+            elif deadline is not None and \
+                    asyncio.get_running_loop().time() > deadline:
+                raise RayTpuTimeoutError(f"get({oid}) timed out")
+
+    async def _call_owner(self, ref: ObjectRef, method: str, req):
+        try:
+            return await self.pool.get(ref.owner_address).call(
+                "CoreWorker", method, req)
+        except Exception as e:
+            raise ObjectLostError(
+                ref.id, f"owner {ref.owner_address} unreachable: {e}") from e
+
+    async def _fetch_from_locations(self, oid: ObjectID, locations,
+                                    owner: str | None = None):
+        """Pull the object into the local store from any live location
+        (reference: object_manager PullManager, locations from the owner —
+        OwnershipBasedObjectDirectory)."""
+        my_node = self.node_id.hex() if self.node_id else None
+        # Local copy?
+        if self.store is not None and (my_node in locations):
+            buf = self.store.get(oid)
+            if buf is not None:
+                try:
+                    return bytes(buf.data), buf.metadata
+                finally:
+                    buf.release()
+        nodes = await self._node_table()
+        for loc in locations:
+            if loc == my_node:
+                continue
+            addr = nodes.get(loc)
+            if addr is None:
+                continue
+            try:
+                reply = await self.pool.get(addr).call(
+                    "NodeManager", "PullObject", {"id": oid.binary()})
+            except Exception:
+                continue
+            if not reply.get("found"):
+                continue
+            data, metadata = reply["data"], reply["metadata"]
+            if self.store is not None:
+                try:
+                    if not self.store.contains(oid):
+                        self.store.put_bytes(oid, data, metadata)
+                    if owner:
+                        asyncio.ensure_future(self.pool.get(owner).call(
+                            "CoreWorker", "AddLocation",
+                            {"id": oid.binary(), "node": my_node}))
+                    elif oid in self.objects:
+                        self.objects[oid].locations.add(my_node)
+                except Exception:
+                    pass
+            return data, metadata
+        return None
+
+    _node_cache: tuple | None = None
+
+    async def _node_table(self) -> dict:
+        """node_id hex -> hostd address, cached briefly."""
+        now = asyncio.get_running_loop().time()
+        if self._node_cache is not None and now - self._node_cache[0] < 1.0:
+            return self._node_cache[1]
+        reply = await self.gcs.call("Gcs", "get_nodes", {})
+        table = {n.node_id.hex(): n.address for n in reply["nodes"] if n.alive}
+        self._node_cache = (now, table)
+        return table
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        return self.io.run(self._wait_async(refs, num_returns, timeout))
+
+    async def _ready_probe(self, ref: ObjectRef):
+        """Block until the object is ready WITHOUT pulling its payload
+        (errored objects count as ready, as in the reference)."""
+        oid = ref.id
+        owned = ref.owner_address in ("", self.address)
+        while True:
+            if owned:
+                st = self.objects.get(oid)
+                if st is None:
+                    return  # freed/unknown: surfaces as error on get()
+                if not st.pending:
+                    return
+                if st.event is None:
+                    st.event = asyncio.Event()
+                await st.event.wait()
+            else:
+                if self.store is not None and self.store.contains(oid):
+                    return
+                try:
+                    reply = await self._call_owner(
+                        ref, "GetObjectStatus",
+                        {"id": oid.binary(), "wait_s": 5.0})
+                except ObjectLostError:
+                    return
+                if reply["status"] != "pending":
+                    return
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        pending = {asyncio.ensure_future(self._ready_probe(r)): r
+                   for r in refs}
+        ready = []
+        try:
+            deadline = None if timeout is None else \
+                asyncio.get_running_loop().time() + timeout
+            while pending and len(ready) < num_returns:
+                wait_t = None if deadline is None else max(
+                    0, deadline - asyncio.get_running_loop().time())
+                done, _ = await asyncio.wait(
+                    pending.keys(), timeout=wait_t,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break
+                for f in done:
+                    f.exception()  # consume; errored objects count as ready
+                    ready.append(pending.pop(f))
+        finally:
+            for f in pending:
+                f.cancel()
+        return ready, [r for r in refs if r not in ready]
+
+    # ------------------------------------------------------------------
+    # Task submission
+    # ------------------------------------------------------------------
+
+    def submit_task(self, fn, args, kwargs, opts) -> list[ObjectRef]:
+        task_id = TaskID.of()
+        num_returns = opts.get("num_returns", 1)
+        refs = [ObjectRef(ObjectID.for_return(task_id, i), self.address)
+                for i in range(num_returns)]
+        for ref in refs:
+            st = self.objects.setdefault(ref.id, _ObjectState())
+            st.producing_task = task_id
+        self.io.run(self._prepare_and_launch(fn, args, kwargs, opts, task_id))
+        return refs
+
+    async def _prepare_and_launch(self, fn, args, kwargs, opts, task_id):
+        fn_key = await self.fn_manager.export(self._job_int(), fn)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id or JobID.nil(),
+            name=getattr(fn, "__qualname__", str(fn)),
+            fn_key=fn_key,
+            args=[await self._pack_arg(a) for a in args],
+            kwargs={k: await self._pack_arg(v) for k, v in kwargs.items()},
+            num_returns=opts.get("num_returns", 1),
+            resources=Resources.from_options(opts),
+            max_retries=opts.get("max_retries", 3),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            owner_address=self.address,
+            scheduling_strategy=opts.get("scheduling_strategy") or "DEFAULT",
+            node_affinity=opts.get("_node_id"),
+        )
+        self.tasks[task_id] = _PendingTask(
+            spec=spec, retries_left=spec.max_retries, future=None, lineage=True)
+        asyncio.ensure_future(self._run_task_to_completion(task_id))
+
+    def _job_int(self) -> int:
+        return int.from_bytes((self.job_id or JobID.nil()).binary(), "little")
+
+    async def _pack_arg(self, value):
+        if isinstance(value, ObjectRef):
+            self._pin_serialized_ref(value)
+            return RefArg(value.id.binary(), value.owner_address or self.address)
+        sv = ser.serialize(value, ref_sink=self._pin_serialized_ref)
+        if sv.total_size >= INLINE_LIMIT:
+            # Promote big args to the object store (reference: args >100KB go
+            # through plasma, _raylet.pyx submit_task).
+            self._put_index += 1
+            oid = ObjectID.for_put(self.current_task_id, self._put_index)
+            self._store_owned_value(oid, sv)
+            st = self.objects[oid]
+            st.pins += 1
+            return RefArg(oid.binary(), self.address)
+        return ValueArg(sv.to_bytes(), sv.metadata)
+
+    async def _run_task_to_completion(self, task_id: TaskID):
+        pending = self.tasks.get(task_id)
+        spec = pending.spec
+        exclude: list = []
+        while True:
+            try:
+                reply = await self._submit_once(spec, exclude)
+            except _RetryableSubmitError as e:
+                if e.busy:
+                    # Saturated cluster: keep queueing, don't burn retries
+                    # (the reference queues tasks in the raylet indefinitely).
+                    exclude.clear()
+                    await asyncio.sleep(0.1)
+                    continue
+                if pending.retries_left > 0:
+                    pending.retries_left -= 1
+                    if e.node_id is not None:
+                        exclude.append(e.node_id)
+                    logger.info("retrying task %s (%s left): %s", spec.name,
+                                pending.retries_left, e)
+                    continue
+                self._complete_task_error(
+                    spec, WorkerCrashedError(f"task {spec.name}: {e}"))
+                return
+            except Exception as e:  # scheduling errors etc.
+                self._complete_task_error(spec, e)
+                return
+            err = reply.get("error")
+            if err is not None and spec.retry_exceptions \
+                    and pending.retries_left > 0:
+                pending.retries_left -= 1
+                continue
+            self._complete_task_reply(spec, reply)
+            return
+
+    async def _submit_once(self, spec: TaskSpec, exclude):
+        # 1. pick node (GCS resource view; spillback = exclude + repick)
+        pick = await self.gcs.call("Gcs", "pick_node", {
+            "resources": spec.resources.to_dict(),
+            "strategy": spec.scheduling_strategy,
+            "exclude": exclude,
+            "node_affinity": spec.node_affinity,
+        })
+        node = pick["node"]
+        if node is None:
+            if exclude:
+                raise _RetryableSubmitError("all feasible nodes excluded",
+                                            None, busy=True)
+            raise ValueError(
+                f"no node can satisfy resources "
+                f"{spec.resources.to_dict()} for task {spec.name}")
+        # 2. lease worker from that node's daemon
+        try:
+            lease = await self.pool.get(node.address).call(
+                "NodeManager", "LeaseWorker",
+                {"resources": spec.resources.to_dict(),
+                 "job_id": self._job_int()}, timeout=60)
+        except Exception as e:
+            raise _RetryableSubmitError(f"lease rpc failed: {e}", node.node_id)
+        if not lease.get("granted"):
+            raise _RetryableSubmitError(
+                f"lease rejected: {lease.get('reason')}", node.node_id,
+                busy=lease.get("reason") in ("busy", "resources"))
+        worker_addr = lease["worker_address"]
+        # 3. push task directly to the leased worker
+        try:
+            reply = await self.pool.get(worker_addr).call(
+                "CoreWorker", "PushTask",
+                {"spec": spec, "caller": self.worker_id.binary()},
+                timeout=None)
+            return reply
+        except Exception as e:
+            self.pool.invalidate(worker_addr)
+            raise _RetryableSubmitError(f"worker died: {e}", node.node_id)
+        finally:
+            try:
+                await self.pool.get(node.address).call(
+                    "NodeManager", "ReturnWorker",
+                    {"lease_id": lease["lease_id"]}, timeout=5)
+            except Exception:
+                pass
+
+    def _complete_task_reply(self, spec: TaskSpec, reply):
+        returns = reply.get("returns", [])
+        err = reply.get("error")
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_return(spec.task_id, i)
+            st = self.objects.setdefault(oid, _ObjectState())
+            st.pending = False
+            if err is not None:
+                st.error = err
+            else:
+                kind, payload, meta = returns[i]
+                if kind == "inline":
+                    st.inline = (payload, meta)
+                else:  # "location"
+                    st.locations.add(payload)
+            self._signal_ready(oid, st)
+        self._release_arg_pins(spec)
+
+    def _complete_task_error(self, spec: TaskSpec, exc: BaseException):
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_return(spec.task_id, i)
+            st = self.objects.setdefault(oid, _ObjectState())
+            st.pending = False
+            st.error = exc
+            self._signal_ready(oid, st)
+        self._release_arg_pins(spec)
+
+    def _release_arg_pins(self, spec: TaskSpec):
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(arg, RefArg):
+                oid = ObjectID(arg.id_binary)
+                with self._obj_lock:
+                    st = self.objects.get(oid)
+                    if st is not None:
+                        st.pins = max(0, st.pins - 1)
+                if st is not None:
+                    self._maybe_free(oid)
+                elif arg.owner_address not in ("", self.address):
+                    asyncio.ensure_future(
+                        self.pool.get(arg.owner_address).call(
+                            "CoreWorker", "RemoveBorrow",
+                            {"id": arg.id_binary}))
+
+    async def _try_reconstruct(self, ref: ObjectRef) -> bool:
+        """Lineage reconstruction: resubmit the producing task
+        (reference: object_recovery_manager.h:41)."""
+        st = self.objects.get(ref.id)
+        if st is None or st.producing_task is None:
+            return False
+        pending = self.tasks.get(st.producing_task)
+        if pending is None or pending.retries_left <= 0:
+            return False
+        pending.retries_left -= 1
+        for i in range(pending.spec.num_returns):
+            oid = ObjectID.for_return(pending.spec.task_id, i)
+            rst = self.objects.setdefault(oid, _ObjectState())
+            rst.pending = True
+            rst.inline = None
+            rst.error = None
+            rst.locations.clear()
+            rst.event = asyncio.Event()
+        logger.info("reconstructing %s via task %s", ref.id, pending.spec.name)
+        await self._run_task_to_completion(st.producing_task)
+        return True
+
+    # ------------------------------------------------------------------
+    # Actors
+    # ------------------------------------------------------------------
+
+    def create_actor(self, cls, args, kwargs, opts) -> ActorID:
+        actor_id = ActorID.of(self.job_id or JobID.nil())
+        # May differ from actor_id when get_if_exists resolves to an
+        # existing named actor.
+        return self.io.run(
+            self._create_actor_async(actor_id, cls, args, kwargs, opts))
+
+    async def _create_actor_async(self, actor_id, cls, args, kwargs, opts):
+        from ray_tpu._private.protocol import ActorInfo
+        fn_key = await self.fn_manager.export(self._job_int(), cls)
+        spec = TaskSpec(
+            task_id=TaskID.of(actor_id),
+            job_id=self.job_id or JobID.nil(),
+            name=f"{cls.__name__}.__init__",
+            fn_key=fn_key,
+            args=[await self._pack_arg(a) for a in args],
+            kwargs={k: await self._pack_arg(v) for k, v in kwargs.items()},
+            # Reference semantics: a default actor takes 1 CPU for scheduling
+            # but 0 while running, so resident actors don't starve tasks.
+            resources=Resources.from_options(opts, default_cpu=0.0),
+            owner_address=self.address,
+            actor_id=actor_id,
+            actor_creation=True,
+        )
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=opts.get("name") or "",
+            namespace=opts.get("namespace") or "default",
+            class_name=cls.__name__,
+            owner_address=self.address,
+            max_restarts=opts.get("max_restarts", 0) or 0,
+            lifetime_detached=(opts.get("lifetime") == "detached"),
+            creation_spec=spec,
+            resources=Resources.from_options(opts, default_cpu=0.0),
+        )
+        reply = await self.gcs.call(
+            "Gcs", "register_actor",
+            {"info": info, "get_if_exists": opts.get("get_if_exists", False)})
+        if reply.get("existing") is not None:
+            return reply["existing"].actor_id
+        return actor_id
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
+                          kwargs, opts) -> list[ObjectRef]:
+        task_id = TaskID.of(actor_id)
+        num_returns = opts.get("num_returns", 1)
+        refs = [ObjectRef(ObjectID.for_return(task_id, i), self.address)
+                for i in range(num_returns)]
+        self.io.run(self._prep_actor_task(actor_id, method_name, args, kwargs,
+                                          opts, task_id))
+        return refs
+
+    async def _prep_actor_task(self, actor_id, method_name, args, kwargs,
+                               opts, task_id):
+        sub = self.actor_submitters.setdefault(actor_id,
+                                               _ActorSubmitter(actor_id))
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id or JobID.nil(),
+            name=method_name,
+            fn_key="",
+            args=[await self._pack_arg(a) for a in args],
+            kwargs={k: await self._pack_arg(v) for k, v in kwargs.items()},
+            num_returns=opts.get("num_returns", 1),
+            owner_address=self.address,
+            actor_id=actor_id,
+            method_name=method_name,
+            max_retries=opts.get("max_task_retries", 0),
+        )
+        async with sub.lock:
+            spec.seq_no = sub.seq
+            sub.seq += 1
+        self.tasks[task_id] = _PendingTask(
+            spec=spec, retries_left=spec.max_retries, future=None)
+        asyncio.ensure_future(self._run_actor_task(sub, task_id))
+
+    async def _run_actor_task(self, sub: _ActorSubmitter, task_id: TaskID):
+        pending = self.tasks[task_id]
+        spec = pending.spec
+        while True:
+            try:
+                addr = await self._resolve_actor(sub)
+            except ActorDiedError as e:
+                self._complete_task_error(spec, e)
+                return
+            try:
+                reply = await self.pool.get(addr).call(
+                    "CoreWorker", "PushTask",
+                    {"spec": spec, "caller": self.worker_id.binary(),
+                     "seq": spec.seq_no - sub.epoch_base},
+                    timeout=None)
+                sub.completed += 1
+                self._complete_task_reply(spec, reply)
+                return
+            except Exception as e:
+                self.pool.invalidate(addr)
+                async with sub.lock:
+                    if sub.address == addr:
+                        # First detector of this incarnation's death: rebase
+                        # the wire sequence for the next incarnation.
+                        sub.address = None
+                        sub.epoch_base = sub.completed
+                if pending.retries_left != 0:
+                    if pending.retries_left > 0:
+                        pending.retries_left -= 1
+                    await asyncio.sleep(0.1)
+                    continue
+                # Terminal failure of an undelivered call: its wire slot on
+                # the new incarnation will never be filled, so shift the
+                # window or every later call would be held forever.
+                async with sub.lock:
+                    sub.completed += 1
+                    sub.epoch_base += 1
+                self._complete_task_error(
+                    spec, ActorDiedError(sub.actor_id,
+                                         f"call failed: {e}"))
+                return
+
+    async def _resolve_actor(self, sub: _ActorSubmitter) -> str:
+        if sub.address:
+            return sub.address
+        deadline = asyncio.get_running_loop().time() + 120
+        while asyncio.get_running_loop().time() < deadline:
+            reply = await self.gcs.call(
+                "Gcs", "get_actor_info",
+                {"actor_id": sub.actor_id, "wait_s": 5.0})
+            info = reply["info"]
+            if info is None:
+                raise ActorDiedError(sub.actor_id, "unknown actor")
+            if info.state == "ALIVE":
+                sub.address = info.address
+                sub.version = info.version
+                return info.address
+            if info.state == "DEAD":
+                raise ActorDiedError(sub.actor_id, info.death_cause)
+            await asyncio.sleep(0.1)
+        raise ActorDiedError(sub.actor_id, "timed out waiting for actor")
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.io.run(self.gcs.call("Gcs", "kill_actor",
+                                  {"actor_id": actor_id,
+                                   "no_restart": no_restart}))
+
+    def get_named_actor(self, name: str, namespace: str = "default"):
+        reply = self.io.run(self.gcs.call(
+            "Gcs", "get_named_actor", {"name": name, "namespace": namespace}))
+        return reply["info"]
+
+    # ------------------------------------------------------------------
+    # Reference counting (owner side)
+    # ------------------------------------------------------------------
+
+    def _pin_serialized_ref(self, ref: ObjectRef):
+        if ref.owner_address in ("", self.address):
+            with self._obj_lock:
+                st = self.objects.get(ref.id)
+                if st is not None:
+                    st.pins += 1
+        else:
+            self.io.spawn(self.pool.get(ref.owner_address).call(
+                "CoreWorker", "AddBorrow", {"id": ref.id.binary()}))
+
+    def on_ref_created(self, ref: ObjectRef):
+        if ref.owner_address in ("", self.address):
+            with self._obj_lock:
+                st = self.objects.setdefault(ref.id, _ObjectState())
+                st.local_refs += 1
+
+    def on_ref_deleted(self, ref: ObjectRef):
+        if self._shutdown:
+            return
+        if ref.owner_address in ("", self.address):
+            with self._obj_lock:
+                st = self.objects.get(ref.id)
+                if st is not None:
+                    st.local_refs = max(0, st.local_refs - 1)
+            self._maybe_free(ref.id)
+        else:
+            owner = self.borrowed.pop(ref.id, None)
+            if owner:
+                try:
+                    self.io.spawn(self.pool.get(owner).call(
+                        "CoreWorker", "RemoveBorrow", {"id": ref.id.binary()}))
+                except Exception:
+                    pass
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        if ref.owner_address not in ("", self.address):
+            self.borrowed[ref.id] = ref.owner_address
+            try:
+                self.io.spawn(self.pool.get(ref.owner_address).call(
+                    "CoreWorker", "AddBorrow", {"id": ref.id.binary()}))
+            except Exception:
+                pass
+
+    def _maybe_free(self, oid: ObjectID):
+        with self._obj_lock:
+            st = self.objects.get(oid)
+            if st is None or st.pending:
+                return
+            if st.local_refs > 0 or st.borrows > 0 or st.pins > 0:
+                return
+            self.objects.pop(oid, None)
+        if st.locations:
+            self.io.spawn(self._free_locations(oid, set(st.locations)))
+        self.tasks.pop(st.producing_task, None)
+
+    async def _free_locations(self, oid: ObjectID, locations):
+        nodes = await self._node_table()
+        for loc in locations:
+            addr = nodes.get(loc)
+            if addr:
+                try:
+                    await self.pool.get(addr).call(
+                        "NodeManager", "FreeObject", {"id": oid.binary()})
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------------
+    # Execution loop (worker mode)
+    # ------------------------------------------------------------------
+
+    def run_task_loop(self):
+        """Blocks executing tasks until KillActor/shutdown
+        (reference: CoreWorker::RunTaskExecutionLoop via default_worker.py)."""
+        while True:
+            item = self.exec_queue.get()
+            if item is None:
+                break
+            spec, done, loop = item
+            reply = self._execute_task(spec)
+            loop.call_soon_threadsafe(
+                lambda d=done, r=reply: d.done() or d.set_result(r))
+
+    def _execute_task(self, spec: TaskSpec) -> dict:
+        try:
+            args = [self._resolve_arg(a) for a in spec.args]
+            kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
+            self.current_task_id = spec.task_id
+            if spec.actor_creation:
+                cls = self.io.run(self.fn_manager.fetch(spec.fn_key))
+                self.actor_instance = cls(*args, **kwargs)
+                return {"returns": [], "error": None}
+            if spec.actor_id is not None:
+                if self.actor_instance is None:
+                    raise ActorDiedError(spec.actor_id, "no instance")
+                method = getattr(self.actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+            else:
+                fn = self.io.run(self.fn_manager.fetch(spec.fn_key))
+                result = fn(*args, **kwargs)
+            return {"returns": self._pack_returns(spec, result), "error": None}
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            logger.info("task %s failed:\n%s", spec.name, tb)
+            if isinstance(e, (TaskError, ActorDiedError)):
+                err = e
+            else:
+                err = TaskError(spec.name, tb, None)
+            return {"returns": [], "error": err}
+
+    def _resolve_arg(self, arg):
+        if isinstance(arg, ValueArg):
+            return ser.deserialize(arg.data, arg.metadata)
+        ref = ObjectRef(ObjectID(arg.id_binary), arg.owner_address,
+                        _register=False)
+        return self.get(ref)
+
+    def _pack_returns(self, spec: TaskSpec, result) -> list:
+        if spec.num_returns == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns="
+                    f"{spec.num_returns} but returned {len(results)} values")
+        packed = []
+        for i, value in enumerate(results):
+            oid = ObjectID.for_return(spec.task_id, i)
+            sv = ser.serialize(value, ref_sink=self._pin_serialized_ref)
+            if sv.total_size < INLINE_LIMIT or self.store is None:
+                packed.append(("inline", sv.to_bytes(), sv.metadata))
+            else:
+                if not self.store.contains(oid):
+                    try:
+                        view = self.store.create_object(
+                            oid, sv.total_size, sv.metadata)
+                        sv.write_into(view)
+                        self.store.seal(oid)
+                    except Exception:
+                        packed.append(("inline", sv.to_bytes(), sv.metadata))
+                        continue
+                packed.append(("location", self.node_id.hex(), sv.metadata))
+        return packed
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self):
+        self._shutdown = True
+        object_ref_mod._install_hooks(None)
+        try:
+            self.io.run(self.server.stop())
+            self.io.run(self.pool.close_all())
+            self.io.run(self.gcs.close())
+        except Exception:
+            pass
+        self.io.stop()
+        if self.store is not None:
+            self.store.close()
+
+    # hooks used by ObjectRef.future()/await
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+        fut = concurrent.futures.Future()
+
+        async def run():
+            try:
+                fut.set_result(await self._get_one(ref, None))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+        self.io.spawn(run())
+        return fut
+
+    async def await_ref(self, ref: ObjectRef):
+        return await self._get_one(ref, None)
+
+
+class _RefHooks:
+    """Bridges ObjectRef lifecycle events to the core worker."""
+
+    def __init__(self, cw: CoreWorker):
+        self.cw = cw
+
+    def on_ref_created(self, ref):
+        self.cw.on_ref_created(ref)
+
+    def on_ref_deleted(self, ref):
+        self.cw.on_ref_deleted(ref)
+
+    def on_ref_serialized(self, ref):
+        pass  # pinning handled via serializer ref_sink
+
+    def on_ref_deserialized(self, ref):
+        self.cw.on_ref_deserialized(ref)
+
+    def as_future(self, ref):
+        return self.cw.as_future(ref)
+
+    def await_ref(self, ref):
+        return self.cw.await_ref(ref)
+
+
+class _RetryableSubmitError(Exception):
+    def __init__(self, msg: str, node_id, busy: bool = False):
+        super().__init__(msg)
+        self.node_id = node_id
+        self.busy = busy  # transient saturation: requeue without burning retries

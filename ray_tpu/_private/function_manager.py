@@ -1,0 +1,49 @@
+"""Function/class distribution via the GCS KV store.
+
+Reference parity: python/ray/_private/function_manager.py — functions and
+actor classes are cloudpickled once, exported to the GCS KV under a content
+hash, and imported lazily (with caching) by workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+import cloudpickle
+
+
+class FunctionManager:
+    def __init__(self, kv_call):
+        """kv_call: async fn(method, request) -> reply (bound to GCS Kv svc)."""
+        self._kv_call = kv_call
+        self._export_cache: dict[int, str] = {}
+        self._import_cache: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    async def export(self, job_id: int, obj) -> str:
+        with self._lock:
+            key = self._export_cache.get(id(obj))
+            if key is not None:
+                return key
+        blob = cloudpickle.dumps(obj, protocol=5)
+        key = f"fn:{job_id}:{hashlib.sha1(blob).hexdigest()}"
+        await self._kv_call("kv_put", {"ns": "fn", "key": key, "value": blob,
+                                       "overwrite": False})
+        with self._lock:
+            self._export_cache[id(obj)] = key
+            self._import_cache[key] = obj  # local fast path
+        return key
+
+    async def fetch(self, key: str):
+        with self._lock:
+            if key in self._import_cache:
+                return self._import_cache[key]
+        reply = await self._kv_call("kv_get", {"ns": "fn", "key": key})
+        blob = reply["value"]
+        if blob is None:
+            raise RuntimeError(f"function {key} not found in GCS")
+        obj = cloudpickle.loads(blob)
+        with self._lock:
+            self._import_cache[key] = obj
+        return obj

@@ -1,0 +1,378 @@
+"""GCS — the cluster control plane.
+
+Reference parity: src/ray/gcs/gcs_server/ — GcsKvManager, GcsNodeManager,
+GcsHealthCheckManager, GcsActorManager (+GcsActorScheduler two-phase
+register/create, gcs_actor_manager.h:249), GcsResourceManager, GcsJobManager.
+One asyncio process; state is in-memory (the reference's default
+gcs_storage="memory", ray_config_def.h:382) with a pluggable table layer so a
+persistent backend can slot in later.
+
+Scheduling policy: the cluster-wide resource view lives here (fed by hostd
+heartbeats, the reference's RaySyncer gossip), and `pick_node` implements the
+hybrid/spread/affinity policies of src/ray/raylet/scheduling/policy/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import time
+
+from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu._private.protocol import ActorInfo, NodeInfo
+from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private import scheduler as sched
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+HEARTBEAT_INTERVAL_S = 0.5
+NODE_DEATH_TIMEOUT_S = 5.0
+
+
+class KvManager:
+    def __init__(self):
+        self._data: dict[str, dict[str, bytes]] = {}
+
+    async def kv_put(self, req):
+        ns = self._data.setdefault(req.get("ns", ""), {})
+        existed = req["key"] in ns
+        if req.get("overwrite", True) or not existed:
+            ns[req["key"]] = req["value"]
+        return {"existed": existed}
+
+    async def kv_get(self, req):
+        return {"value": self._data.get(req.get("ns", ""), {}).get(req["key"])}
+
+    async def kv_del(self, req):
+        ns = self._data.get(req.get("ns", ""), {})
+        return {"deleted": ns.pop(req["key"], None) is not None}
+
+    async def kv_exists(self, req):
+        return {"exists": req["key"] in self._data.get(req.get("ns", ""), {})}
+
+    async def kv_keys(self, req):
+        ns = self._data.get(req.get("ns", ""), {})
+        prefix = req.get("prefix", "")
+        return {"keys": [k for k in ns if k.startswith(prefix)]}
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self.kv = KvManager()
+        self.nodes: dict[NodeID, NodeInfo] = {}
+        self.node_heartbeat: dict[NodeID, float] = {}
+        self.actors: dict[ActorID, ActorInfo] = {}
+        self.named_actors: dict[tuple[str, str], ActorID] = {}
+        self.placement_groups = {}  # filled by PG manager (milestone: PGs)
+        self.pool = ClientPool()
+        self.server = RpcServer(host)
+        self.next_job = 0
+        self._job_lock = asyncio.Lock()
+        self._shutdown = asyncio.Event()
+        self._cluster_version = 0  # bumped on node/actor table changes
+
+    # ---------------- node manager ----------------
+
+    async def register_node(self, req):
+        info: NodeInfo = req["info"]
+        self.nodes[info.node_id] = info
+        self.node_heartbeat[info.node_id] = time.monotonic()
+        self._cluster_version += 1
+        logger.info("node %s registered at %s (%s)", info.node_id.hex()[:8],
+                    info.address, info.resources_total)
+        return {"ok": True}
+
+    async def heartbeat(self, req):
+        nid = req["node_id"]
+        info = self.nodes.get(nid)
+        if info is None or not info.alive:
+            return {"ok": False, "reregister": True}
+        self.node_heartbeat[nid] = time.monotonic()
+        info.resources_available = req["available"]
+        return {"ok": True, "shutdown": self._shutdown.is_set()}
+
+    async def get_nodes(self, req):
+        return {"nodes": list(self.nodes.values()),
+                "version": self._cluster_version}
+
+    async def drain_node(self, req):
+        await self._mark_node_dead(req["node_id"], "drained")
+        return {"ok": True}
+
+    async def _mark_node_dead(self, nid: NodeID, reason: str):
+        info = self.nodes.get(nid)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        self._cluster_version += 1
+        logger.warning("node %s dead: %s", nid.hex()[:8], reason)
+        # Fail over actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == nid and actor.state in ("ALIVE", "PENDING"):
+                await self._on_actor_interrupted(actor, f"node died: {reason}")
+
+    async def _health_loop(self):
+        while not self._shutdown.is_set():
+            now = time.monotonic()
+            for nid, last in list(self.node_heartbeat.items()):
+                info = self.nodes.get(nid)
+                if info is not None and info.alive and not info.is_head \
+                        and now - last > NODE_DEATH_TIMEOUT_S:
+                    await self._mark_node_dead(nid, "heartbeat timeout")
+            await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+
+    # ---------------- job manager ----------------
+
+    async def next_job_id(self, req):
+        async with self._job_lock:
+            self.next_job += 1
+            return {"job_id": self.next_job}
+
+    # ---------------- actor manager ----------------
+    # Two-phase as in the reference (gcs_actor_manager.h:249): RegisterActor
+    # persists the record, CreateActor drives scheduling.  We fuse the
+    # scheduling trigger into register for simplicity but keep the externally
+    # visible states PENDING -> ALIVE (-> RESTARTING) -> DEAD.
+
+    async def register_actor(self, req):
+        info: ActorInfo = req["info"]
+        if info.name:
+            key = (info.namespace, info.name)
+            existing_id = self.named_actors.get(key)
+            if existing_id is not None:
+                existing = self.actors.get(existing_id)
+                if existing is not None and existing.state != "DEAD":
+                    if req.get("get_if_exists"):
+                        return {"existing": existing}
+                    raise ValueError(
+                        f"actor name {info.name!r} already taken in "
+                        f"namespace {info.namespace!r}")
+            self.named_actors[key] = info.actor_id
+        self.actors[info.actor_id] = info
+        asyncio.ensure_future(self._schedule_actor(info))
+        return {"existing": None}
+
+    async def _schedule_actor(self, info: ActorInfo):
+        """Lease a dedicated worker on some node and run the creation task."""
+        demand = info.resources.to_dict()
+        # Pick with >=1 CPU so default actors land on nodes with headroom,
+        # but reserve only the declared demand (1-for-scheduling /
+        # 0-for-running, as in the reference).
+        pick_demand = demand or {"CPU": 1.0}
+        tried: set[NodeID] = set()
+        for _ in range(100):
+            if info.state == "DEAD":
+                return
+            node = sched.pick_node(self._alive_nodes(), pick_demand,
+                                   strategy="DEFAULT", exclude=tried)
+            if node is None:
+                await asyncio.sleep(0.2)  # wait for capacity / new nodes
+                tried.clear()
+                continue
+            job_int = int.from_bytes(
+                info.creation_spec.job_id.binary(), "little") \
+                if info.creation_spec is not None else 0
+            try:
+                lease = await self.pool.get(node.address).call(
+                    "NodeManager", "LeaseWorkerForActor",
+                    {"actor_id": info.actor_id, "resources": demand,
+                     "job_id": job_int},
+                    timeout=30)
+            except Exception as e:
+                logger.info("lease on %s failed: %s", node.address, e)
+                tried.add(node.node_id)
+                continue
+            if not lease.get("granted"):
+                tried.add(node.node_id)
+                continue
+            worker_addr = lease["worker_address"]
+            try:
+                reply = await self.pool.get(worker_addr).call(
+                    "CoreWorker", "CreateActor",
+                    {"spec": info.creation_spec, "actor_id": info.actor_id},
+                    timeout=120)
+            except Exception as e:
+                logger.warning("actor %s creation push failed: %s",
+                               info.actor_id.hex()[:8], e)
+                tried.add(node.node_id)
+                continue
+            if info.state == "DEAD":
+                # Killed while we were scheduling it: don't resurrect; tear
+                # down the worker we just created it on.
+                try:
+                    await self.pool.get(worker_addr).call(
+                        "CoreWorker", "KillActor",
+                        {"actor_id": info.actor_id, "no_restart": True},
+                        timeout=5)
+                except Exception:
+                    pass
+                return
+            if reply.get("error") is not None:
+                info.state = "DEAD"
+                info.death_cause = f"creation failed: {reply['error']}"
+                info.version += 1
+                self._cluster_version += 1
+                return
+            info.state = "ALIVE"
+            info.address = worker_addr
+            info.node_id = node.node_id
+            info.version += 1
+            self._cluster_version += 1
+            logger.info("actor %s alive at %s", info.actor_id.hex()[:8],
+                        worker_addr)
+            return
+        info.state = "DEAD"
+        info.death_cause = "scheduling failed after 100 attempts"
+        info.version += 1
+
+    async def _on_actor_interrupted(self, actor: ActorInfo, reason: str):
+        if actor.num_restarts < actor.max_restarts or actor.max_restarts == -1:
+            actor.num_restarts += 1
+            actor.state = "RESTARTING"
+            actor.address = ""
+            actor.version += 1
+            self._cluster_version += 1
+            logger.info("restarting actor %s (%d/%s): %s",
+                        actor.actor_id.hex()[:8], actor.num_restarts,
+                        actor.max_restarts, reason)
+            asyncio.ensure_future(self._schedule_actor(actor))
+        else:
+            actor.state = "DEAD"
+            actor.death_cause = reason
+            actor.address = ""
+            actor.version += 1
+            self._cluster_version += 1
+
+    async def report_actor_death(self, req):
+        actor = self.actors.get(req["actor_id"])
+        if actor is not None and actor.state in ("ALIVE", "PENDING"):
+            if req.get("intentional"):
+                actor.state = "DEAD"
+                actor.death_cause = req.get("reason", "killed")
+                actor.address = ""
+                actor.version += 1
+                self._cluster_version += 1
+            else:
+                await self._on_actor_interrupted(actor, req.get("reason", "?"))
+        return {"ok": True}
+
+    async def get_actor_info(self, req):
+        actor = self.actors.get(req["actor_id"])
+        # Long-poll: while the actor is pending/restarting, hold the request
+        # briefly so callers don't spin (reference: pubsub long-poll).
+        deadline = time.monotonic() + req.get("wait_s", 0)
+        while actor is not None and actor.state in ("PENDING", "RESTARTING") \
+                and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        return {"info": actor}
+
+    async def get_named_actor(self, req):
+        aid = self.named_actors.get((req.get("namespace", "default"), req["name"]))
+        return {"info": self.actors.get(aid) if aid else None}
+
+    async def list_actors(self, req):
+        return {"actors": list(self.actors.values())}
+
+    async def kill_actor(self, req):
+        actor = self.actors.get(req["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        no_restart = req.get("no_restart", True)
+        address = actor.address
+        if no_restart:
+            actor.state = "DEAD"
+            actor.death_cause = "ray_tpu.kill"
+            actor.address = ""
+            actor.version += 1
+            self._cluster_version += 1
+        else:
+            # Kill the process but honor max_restarts (reference:
+            # ray.kill(no_restart=False) semantics).
+            await self._on_actor_interrupted(actor, "ray_tpu.kill(no_restart=False)")
+        if address:
+            try:
+                await self.pool.get(address).call(
+                    "CoreWorker", "KillActor",
+                    {"actor_id": req["actor_id"], "no_restart": no_restart},
+                    timeout=5)
+            except Exception:
+                pass
+        return {"ok": True}
+
+    # ---------------- scheduling service ----------------
+
+    async def pick_node(self, req):
+        node = sched.pick_node(
+            self._alive_nodes(), req["resources"],
+            strategy=req.get("strategy", "DEFAULT"),
+            exclude=set(req.get("exclude") or ()),
+            affinity=req.get("node_affinity"),
+            affinity_soft=req.get("node_affinity_soft", True),
+        )
+        return {"node": node}
+
+    def _alive_nodes(self) -> list[NodeInfo]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    # ---------------- cluster lifecycle ----------------
+
+    async def cluster_resources(self, req):
+        total: dict[str, float] = {}
+        avail: dict[str, float] = {}
+        for n in self._alive_nodes():
+            for k, v in n.resources_total.items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n.resources_available.items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def shutdown_cluster(self, req):
+        self._shutdown.set()
+        return {"ok": True}
+
+    async def ping(self, req):
+        return {"ok": True, "version": self._cluster_version}
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self, port: int = 0) -> int:
+        self.server.register_service("Kv", self.kv)
+        self.server.register_service("Gcs", self)
+        port = await self.server.start(port)
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        return port
+
+    async def run_until_shutdown(self):
+        await self._shutdown.wait()
+        await asyncio.sleep(2 * HEARTBEAT_INTERVAL_S)  # let hostds see it
+        await self.server.stop()
+        await self.pool.close_all()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--ready-file", default="")
+    args = parser.parse_args()
+    logging.basicConfig(level=os.environ.get("RAY_TPU_LOGLEVEL", "INFO"))
+
+    async def run():
+        gcs = GcsServer(args.host)
+        port = await gcs.start(args.port)
+        if args.ready_file:
+            tmp = args.ready_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(port))
+            os.replace(tmp, args.ready_file)
+        logger.info("GCS listening on %s:%d", args.host, port)
+        await gcs.run_until_shutdown()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

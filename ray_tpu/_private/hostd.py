@@ -1,0 +1,444 @@
+"""hostd — the per-node daemon (reference: src/ray/raylet/).
+
+Owns the node's shared-memory object store, the worker pool
+(raylet/worker_pool.h: spawn/pop/cache idle workers), local resource
+accounting (LocalResourceManager), worker leasing for tasks and actors
+(NodeManager::HandleRequestWorkerLease, node_manager.cc:1817), node-to-node
+object transfer (object_manager/: pull semantics), and the GCS heartbeat.
+
+Scheduling split, as in the reference: the GCS resource view proposes a node;
+this daemon is the admission controller — a lease can be rejected and the
+submitter reschedules (spillback).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+
+from ray_tpu._private import gcs as gcs_mod
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.protocol import NodeInfo
+from ray_tpu._private.rpc import ClientPool, RpcClient, RpcServer
+
+logger = logging.getLogger("ray_tpu.hostd")
+
+IDLE_WORKER_TTL_S = 60.0
+
+
+def detect_resources() -> dict:
+    res = {"CPU": float(os.cpu_count() or 1)}
+    # TPU detection: honor explicit env (set by the pod provisioner) first;
+    # otherwise probe jax lazily in a subprocess so hostd itself never holds
+    # the TPU runtime open.
+    if "RAY_TPU_NUM_TPUS" in os.environ:
+        n = float(os.environ["RAY_TPU_NUM_TPUS"])
+        if n > 0:
+            res["TPU"] = n
+    return res
+
+
+class WorkerHandle:
+    def __init__(self, proc: subprocess.Popen, job_id: int):
+        self.proc = proc
+        self.job_id = job_id
+        self.worker_id: WorkerID | None = None
+        self.address: str = ""
+        self.state = "starting"  # starting/idle/claimed/leased/actor
+        self.reserved = False    # pinned for the lease that spawned it
+        self.lease_id: str | None = None
+        self.lease_resources: dict = {}
+        self.actor_id = None
+        self.idle_since = time.monotonic()
+        self.ready = asyncio.Event()
+
+
+class NodeDaemon:
+    def __init__(self, gcs_address: str, resources: dict | None = None,
+                 store_capacity: int = 256 << 20, is_head: bool = False,
+                 host: str = "127.0.0.1", session_dir: str = "/tmp/ray_tpu"):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        self.gcs = RpcClient(gcs_address)
+        self.pool = ClientPool()
+        self.host = host
+        self.is_head = is_head
+        self.session_dir = session_dir
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        self.store_path = os.path.join(
+            "/dev/shm", f"ray_tpu_{self.node_id.hex()[:12]}")
+        self.store = ObjectStore.create(self.store_path, store_capacity)
+        self.resources_total = dict(resources or detect_resources())
+        self.resources_available = dict(self.resources_total)
+        self.workers: dict[int, WorkerHandle] = {}  # pid -> handle
+        self._lease_seq = 0
+        self.server = RpcServer(host)
+        self._shutdown = asyncio.Event()
+        self.max_workers = int(os.environ.get(
+            "RAY_TPU_MAX_WORKERS",
+            max(8, int(self.resources_total.get("CPU", 1)) * 4)))
+        self._capacity_freed: asyncio.Event | None = None  # made on start()
+
+    # ---------------- worker pool ----------------
+
+    def _spawn_worker(self, job_id: int) -> WorkerHandle:
+        log_base = os.path.join(self.session_dir, "logs",
+                                f"worker-{len(self.workers)}-{os.getpid()}")
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+               "--gcs", self.gcs_address,
+               "--hostd", f"{self.host}:{self.server.port}",
+               "--store", self.store_path,
+               "--node-id", self.node_id.hex(),
+               "--job-id", str(job_id)]
+        out = open(log_base + ".out", "ab")
+        err = open(log_base + ".err", "ab")
+        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
+        handle = WorkerHandle(proc, job_id)
+        self.workers[proc.pid] = handle
+        logger.info("spawned worker pid=%d job=%d", proc.pid, job_id)
+        return handle
+
+    async def worker_ready(self, req):
+        """Called by a freshly started worker process."""
+        handle = self.workers.get(req["pid"])
+        if handle is None:
+            return {"ok": False}
+        handle.worker_id = req["worker_id"]
+        handle.address = req["address"]
+        handle.state = "idle"
+        handle.idle_since = time.monotonic()
+        handle.ready.set()
+        return {"ok": True, "node_id": self.node_id}
+
+    async def _get_worker(self, job_id: int, timeout: float = 60.0):
+        """Pop an idle worker for the job, spawning if necessary.  The
+        returned handle is already claimed (state="claimed") so concurrent
+        leases can never share a worker."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            for handle in self.workers.values():
+                if handle.state == "idle" and not handle.reserved \
+                        and handle.job_id == job_id:
+                    handle.state = "claimed"
+                    return handle
+            live = [w for w in self.workers.values() if w.proc.poll() is None]
+            if len(live) >= self.max_workers:
+                for handle in live:
+                    if handle.state == "idle" and not handle.reserved \
+                            and handle.job_id != job_id:
+                        self._kill_worker(handle)
+                        break
+                else:
+                    return None
+            # Spawn a worker pinned to this lease (reserved=True) so another
+            # lease cannot steal it the moment it boots — stealing cascades
+            # into one extra spawn per steal.
+            handle = self._spawn_worker(job_id)
+            handle.reserved = True
+            try:
+                await asyncio.wait_for(
+                    handle.ready.wait(),
+                    max(0.1, deadline - asyncio.get_event_loop().time()))
+            except asyncio.TimeoutError:
+                self._kill_worker(handle)
+                return None
+            handle.reserved = False
+            handle.state = "claimed"
+            return handle
+
+    def _kill_worker(self, handle: WorkerHandle):
+        self.workers.pop(handle.proc.pid, None)
+        if handle.proc.poll() is None:
+            handle.proc.terminate()
+
+    # ---------------- leasing ----------------
+
+    def _reserve(self, demand: dict) -> bool:
+        for k, v in demand.items():
+            if v > 0 and self.resources_available.get(k, 0.0) + 1e-9 < v:
+                return False
+        for k, v in demand.items():
+            if v > 0:
+                self.resources_available[k] = self.resources_available.get(k, 0.0) - v
+        return True
+
+    def _unreserve(self, demand: dict):
+        for k, v in demand.items():
+            if v > 0:
+                self.resources_available[k] = min(
+                    self.resources_available.get(k, 0.0) + v,
+                    self.resources_total.get(k, float("inf")))
+        self._notify_capacity()
+
+    def _notify_capacity(self):
+        if self._capacity_freed is not None:
+            self._capacity_freed.set()
+            self._capacity_freed = asyncio.Event()
+
+    async def _wait_capacity(self, timeout: float):
+        if self._capacity_freed is None:
+            self._capacity_freed = asyncio.Event()
+        ev = self._capacity_freed
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+
+    async def lease_worker(self, req):
+        """Lease a worker for normal task execution; queues while the node is
+        saturated (reference: RequestWorkerLease node_manager.proto:363 +
+        LocalTaskManager dispatch queue)."""
+        demand = req.get("resources", {})
+        job_id = req.get("job_id", 0)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + req.get("queue_timeout", 10.0)
+        while True:
+            if self._reserve(demand):
+                handle = await self._get_worker(job_id)
+                if handle is not None:
+                    break
+                self._unreserve(demand)
+                if not any(w.state == "idle" or w.proc.poll() is None
+                           for w in self.workers.values()):
+                    return {"granted": False, "reason": "no_worker"}
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return {"granted": False, "reason": "busy"}
+            await self._wait_capacity(min(remaining, 0.5))
+        self._lease_seq += 1
+        lease_id = f"{self.node_id.hex()[:8]}-{self._lease_seq}"
+        logger.info("lease %s -> worker pid=%d", lease_id, handle.proc.pid)
+        handle.state = "leased"
+        handle.lease_id = lease_id
+        handle.lease_resources = demand
+        return {"granted": True, "worker_address": handle.address,
+                "lease_id": lease_id, "node_id": self.node_id}
+
+    async def return_worker(self, req):
+        for handle in self.workers.values():
+            if handle.lease_id == req["lease_id"]:
+                self._unreserve(handle.lease_resources)
+                logger.info("return lease %s pid=%d", req["lease_id"], handle.proc.pid)
+                handle.lease_id = None
+                handle.lease_resources = {}
+                if req.get("kill") or handle.proc.poll() is not None:
+                    self._kill_worker(handle)
+                else:
+                    handle.state = "idle"
+                    handle.idle_since = time.monotonic()
+                return {"ok": True}
+        return {"ok": False}
+
+    async def lease_worker_for_actor(self, req):
+        """Dedicated worker for an actor (reference: GcsActorScheduler leases
+        via the same raylet path, gcs_actor_scheduler.h:111)."""
+        demand = req.get("resources", {})
+        if not self._reserve(demand):
+            return {"granted": False, "reason": "resources"}
+        handle = await self._get_worker(req.get("job_id", 0))
+        if handle is None:
+            self._unreserve(demand)
+            return {"granted": False, "reason": "no_worker"}
+        handle.state = "actor"
+        handle.actor_id = req["actor_id"]
+        handle.lease_resources = demand
+        return {"granted": True, "worker_address": handle.address,
+                "node_id": self.node_id}
+
+    # ---------------- object transfer ----------------
+
+    async def pull_object(self, req):
+        """Read an object out of the local store for a remote node.
+        (reference: object_manager chunked pull; chunking TBD)"""
+        from ray_tpu._private.ids import ObjectID
+        buf = self.store.get(ObjectID(req["id"]), timeout_ms=int(
+            req.get("timeout_ms", 0)))
+        if buf is None:
+            return {"found": False}
+        try:
+            return {"found": True, "data": bytes(buf.data),
+                    "metadata": buf.metadata}
+        finally:
+            buf.release()
+
+    async def push_object(self, req):
+        from ray_tpu._private.ids import ObjectID
+        oid = ObjectID(req["id"])
+        if not self.store.contains(oid):
+            try:
+                self.store.put_bytes(oid, req["data"], req.get("metadata", b""))
+            except Exception as e:  # duplicate create race is fine
+                logger.debug("push_object: %s", e)
+        return {"ok": True}
+
+    async def free_object(self, req):
+        from ray_tpu._private.ids import ObjectID
+        self.store.delete(ObjectID(req["id"]))
+        return {"ok": True}
+
+    async def store_stats(self, req):
+        return self.store.stats()
+
+    # ---------------- lifecycle ----------------
+
+    async def shutdown_node(self, req):
+        self._shutdown.set()
+        return {"ok": True}
+
+    def node_info(self) -> NodeInfo:
+        import socket
+        return NodeInfo(
+            node_id=self.node_id,
+            address=f"{self.host}:{self.server.port}",
+            store_path=self.store_path,
+            hostname=socket.gethostname(),
+            resources_total=dict(self.resources_total),
+            resources_available=dict(self.resources_available),
+            is_head=self.is_head,
+        )
+
+    async def _heartbeat_loop(self):
+        misses = 0
+        while not self._shutdown.is_set():
+            try:
+                reply = await self.gcs.call(
+                    "Gcs", "heartbeat",
+                    {"node_id": self.node_id,
+                     "available": dict(self.resources_available)},
+                    timeout=2)
+                misses = 0
+                if reply.get("shutdown"):
+                    self._shutdown.set()
+                if reply.get("reregister"):
+                    await self.gcs.call("Gcs", "register_node",
+                                        {"info": self.node_info()})
+            except Exception:
+                misses += 1
+                if misses > 10:
+                    logger.error("GCS unreachable; hostd exiting")
+                    self._shutdown.set()
+            await asyncio.sleep(gcs_mod.HEARTBEAT_INTERVAL_S)
+
+    async def _reaper_loop(self):
+        """Detect dead/idle-expired workers; report dead actor workers."""
+        while not self._shutdown.is_set():
+            now = time.monotonic()
+            for handle in list(self.workers.values()):
+                if handle.proc.poll() is not None:
+                    self.workers.pop(handle.proc.pid, None)
+                    self._unreserve(handle.lease_resources)
+                    if handle.state == "actor" and handle.actor_id is not None:
+                        try:
+                            await self.gcs.call(
+                                "Gcs", "report_actor_death",
+                                {"actor_id": handle.actor_id,
+                                 "reason": f"worker exited "
+                                           f"({handle.proc.returncode})"},
+                                timeout=2)
+                        except Exception:
+                            pass
+                elif (handle.state == "idle"
+                      and now - handle.idle_since > IDLE_WORKER_TTL_S):
+                    self._kill_worker(handle)
+            await asyncio.sleep(0.2)
+
+    async def start(self, port: int = 0) -> int:
+        self.server.register("NodeManager", "WorkerReady", self.worker_ready)
+        self.server.register("NodeManager", "LeaseWorker", self.lease_worker)
+        self.server.register("NodeManager", "ReturnWorker", self.return_worker)
+        self.server.register("NodeManager", "LeaseWorkerForActor",
+                             self.lease_worker_for_actor)
+        self.server.register("NodeManager", "PullObject", self.pull_object)
+        self.server.register("NodeManager", "PushObject", self.push_object)
+        self.server.register("NodeManager", "FreeObject", self.free_object)
+        self.server.register("NodeManager", "StoreStats", self.store_stats)
+        self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
+        port = await self.server.start(port)
+        await self.gcs.call("Gcs", "register_node", {"info": self.node_info()},
+                            timeout=10)
+        self._tasks = [asyncio.ensure_future(self._heartbeat_loop()),
+                       asyncio.ensure_future(self._reaper_loop())]
+        return port
+
+    def install_signal_handlers(self):
+        import signal
+        loop = asyncio.get_event_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, self._shutdown.set)
+            except (NotImplementedError, RuntimeError):
+                pass
+
+    async def run_until_shutdown(self):
+        await self._shutdown.wait()
+        for t in self._tasks:
+            t.cancel()
+        for handle in list(self.workers.values()):
+            self._kill_worker(handle)
+        deadline = time.monotonic() + 3
+        for handle in list(self.workers.values()):
+            try:
+                handle.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                handle.proc.kill()
+        await self.server.stop()
+        await self.pool.close_all()
+        await self.gcs.close()
+        self.store.close()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--ready-file", default="")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", default="")  # "k=v,k=v"
+    parser.add_argument("--store-capacity", type=int, default=256 << 20)
+    parser.add_argument("--head", action="store_true")
+    parser.add_argument("--session-dir", default="/tmp/ray_tpu")
+    args = parser.parse_args()
+    logging.basicConfig(level=os.environ.get("RAY_TPU_LOGLEVEL", "INFO"), format="%(asctime)s.%(msecs)03d %(message)s", datefmt="%H:%M:%S")
+
+    resources = detect_resources()
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    if args.num_tpus is not None:
+        if args.num_tpus > 0:
+            resources["TPU"] = args.num_tpus
+        else:
+            resources.pop("TPU", None)
+    for kv in filter(None, args.resources.split(",")):
+        k, v = kv.split("=")
+        resources[k] = float(v)
+
+    async def run():
+        daemon = NodeDaemon(args.gcs, resources, args.store_capacity,
+                            is_head=args.head, host=args.host,
+                            session_dir=args.session_dir)
+        port = await daemon.start(args.port)
+        daemon.install_signal_handlers()
+        if args.ready_file:
+            tmp = args.ready_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{port}\n{daemon.node_id.hex()}\n{daemon.store_path}")
+            os.replace(tmp, args.ready_file)
+        logger.info("hostd %s on port %d resources=%s",
+                    daemon.node_id.hex()[:8], port, resources)
+        await daemon.run_until_shutdown()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

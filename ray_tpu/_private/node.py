@@ -1,0 +1,111 @@
+"""Cluster process bootstrap
+(reference: python/ray/_private/node.py Node.start_ray_processes +
+services.py start_gcs_server/start_raylet).
+
+start_head() spawns the GCS and a head hostd as subprocesses; add_node()
+spawns additional hostds (the in-process multi-node simulation the reference
+provides via python/ray/cluster_utils.py:99 Cluster).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _daemon_env() -> dict:
+    """Ensure spawned daemons can import ray_tpu regardless of driver cwd."""
+    env = dict(os.environ)
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if _PKG_ROOT not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([_PKG_ROOT] + parts)
+    return env
+
+
+class ProcessGroup:
+    """Tracks daemons this process spawned so shutdown can reap them."""
+
+    def __init__(self):
+        self.procs: list[subprocess.Popen] = []
+
+    def reap(self, timeout: float = 5.0):
+        # Reverse order: hostds before the GCS, so each hostd can still kill
+        # its workers and deregister while the control plane is up.
+        for p in reversed(self.procs):
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + timeout
+        for p in reversed(self.procs):
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs.clear()
+
+
+def _wait_ready_file(path: str, proc: subprocess.Popen, timeout: float = 30.0,
+                     what: str = "daemon") -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read()
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{what} exited with code {proc.returncode} during startup "
+                f"(logs in session dir)")
+        time.sleep(0.02)
+    raise TimeoutError(f"{what} did not become ready in {timeout}s")
+
+
+def new_session_dir() -> str:
+    d = os.path.join(tempfile.gettempdir(), "ray_tpu",
+                     f"session_{int(time.time())}_{uuid.uuid4().hex[:6]}")
+    os.makedirs(os.path.join(d, "logs"), exist_ok=True)
+    return d
+
+
+def start_gcs(session_dir: str, group: ProcessGroup, host="127.0.0.1") -> str:
+    ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}")
+    log = open(os.path.join(session_dir, "logs", "gcs.err"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.gcs",
+         "--host", host, "--ready-file", ready],
+        stdout=log, stderr=log, env=_daemon_env())
+    group.procs.append(proc)
+    port = _wait_ready_file(ready, proc, what="GCS").strip()
+    return f"{host}:{port}"
+
+
+def start_hostd(gcs_address: str, session_dir: str, group: ProcessGroup,
+                *, num_cpus=None, num_tpus=None, resources=None,
+                store_capacity=256 << 20, head=False,
+                host="127.0.0.1") -> dict:
+    ready = os.path.join(session_dir, f"hostd_ready_{uuid.uuid4().hex[:6]}")
+    log = open(os.path.join(session_dir, "logs",
+                            f"hostd_{uuid.uuid4().hex[:6]}.err"), "ab")
+    cmd = [sys.executable, "-m", "ray_tpu._private.hostd",
+           "--gcs", gcs_address, "--host", host,
+           "--ready-file", ready, "--session-dir", session_dir,
+           "--store-capacity", str(store_capacity)]
+    if num_cpus is not None:
+        cmd += ["--num-cpus", str(num_cpus)]
+    if num_tpus is not None:
+        cmd += ["--num-tpus", str(num_tpus)]
+    if resources:
+        cmd += ["--resources", ",".join(f"{k}={v}" for k, v in resources.items())]
+    if head:
+        cmd.append("--head")
+    proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=_daemon_env())
+    group.procs.append(proc)
+    port, node_id, store_path = _wait_ready_file(
+        ready, proc, what="hostd").strip().split("\n")
+    return {"address": f"{host}:{port}", "node_id": node_id,
+            "store_path": store_path, "proc": proc}

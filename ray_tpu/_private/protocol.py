@@ -1,0 +1,160 @@
+"""Wire-level task/actor specs and options.
+
+Reference parity: src/ray/common/task/task_spec.h + python/ray/_private/
+ray_option_utils.py (option surface) — trimmed to the fields the runtime
+uses today; every field name matches the reference concept it mirrors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID, TaskID
+
+# Results smaller than this return inline in the PushTask reply and live in
+# the owner's memory store (reference: task returns "in plasma" vs "direct").
+INLINE_LIMIT = 100 * 1024
+
+
+@dataclass
+class Resources:
+    """Logical resource demand. TPU is first-class (the reference only knows
+    GPU; accelerators live in python/ray/util/accelerators/accelerators.py)."""
+
+    cpu: float = 1.0
+    tpu: float = 0.0
+    memory: float = 0.0
+    custom: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = dict(self.custom)
+        if self.cpu:
+            d["CPU"] = self.cpu
+        if self.tpu:
+            d["TPU"] = self.tpu
+        if self.memory:
+            d["memory"] = self.memory
+        return d
+
+    @classmethod
+    def from_options(cls, opts: dict, default_cpu: float = 1.0) -> "Resources":
+        # NB: options default to None (unset), which must mean "default", not
+        # zero — otherwise every task demands nothing and admission control
+        # stops gating concurrency.
+        cpu = opts.get("num_cpus")
+        tpu = opts.get("num_tpus")
+        mem = opts.get("memory")
+        return cls(
+            cpu=default_cpu if cpu is None else float(cpu),
+            tpu=0.0 if tpu is None else float(tpu),
+            memory=0.0 if mem is None else float(mem),
+            custom=dict(opts.get("resources") or {}),
+        )
+
+
+# An argument is either an inline serialized value or an object reference.
+@dataclass
+class ValueArg:
+    data: bytes
+    metadata: bytes
+
+
+@dataclass
+class RefArg:
+    id_binary: bytes
+    owner_address: str
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    name: str                     # human-readable function/method name
+    fn_key: str                   # GCS KV key of the pickled function/class
+    args: list                    # list[ValueArg | RefArg]
+    kwargs: dict                  # name -> ValueArg | RefArg
+    num_returns: int = 1
+    resources: Resources = field(default_factory=Resources)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    owner_address: str = ""       # RPC address of the submitting worker
+    # Actor fields
+    actor_id: Optional[ActorID] = None       # set for actor method calls
+    actor_creation: bool = False             # this task constructs an actor
+    method_name: str = ""
+    seq_no: int = 0               # per-handle ordering for actor tasks
+    # Scheduling hints
+    placement_group: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    node_affinity: Optional[NodeID] = None
+    node_affinity_soft: bool = True
+    scheduling_strategy: str = "DEFAULT"     # DEFAULT | SPREAD
+    runtime_env: dict = field(default_factory=dict)
+
+
+@dataclass
+class ActorInfo:
+    """GCS actor-table record (reference: gcs_actor_manager.h state machine)."""
+
+    actor_id: ActorID
+    name: str = ""
+    namespace: str = "default"
+    class_name: str = ""
+    state: str = "PENDING"  # PENDING/ALIVE/RESTARTING/DEAD
+    address: str = ""       # worker RPC address when ALIVE
+    node_id: Optional[NodeID] = None
+    owner_address: str = ""
+    max_restarts: int = 0
+    num_restarts: int = 0
+    death_cause: str = ""
+    lifetime_detached: bool = False
+    creation_spec: Optional[TaskSpec] = None
+    resources: Resources = field(default_factory=Resources)
+    version: int = 0        # bumped on every state change (client cache inval)
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str            # hostd RPC address
+    store_path: str         # shm segment path (same-host attach)
+    hostname: str = ""
+    resources_total: dict = field(default_factory=dict)
+    resources_available: dict = field(default_factory=dict)
+    alive: bool = True
+    is_head: bool = False
+
+
+def option_defaults(for_actor: bool = False) -> dict:
+    """The @remote option surface (reference: _private/ray_option_utils.py)."""
+    common = {
+        "num_cpus": None, "num_tpus": None, "memory": None, "resources": None,
+        "runtime_env": None, "scheduling_strategy": None, "name": None,
+        "placement_group": None, "placement_group_bundle_index": -1,
+        "_node_id": None,
+    }
+    if for_actor:
+        common.update({
+            "max_restarts": 0, "max_task_retries": 0, "lifetime": None,
+            "namespace": None, "max_concurrency": 1, "get_if_exists": False,
+        })
+    else:
+        common.update({
+            "num_returns": 1, "max_retries": 3, "retry_exceptions": False,
+        })
+    return common
+
+
+def validate_options(opts: dict, for_actor: bool) -> dict:
+    allowed = option_defaults(for_actor)
+    merged = dict(allowed)
+    for k, v in opts.items():
+        if k not in allowed:
+            kind = "actor" if for_actor else "task"
+            raise ValueError(f"invalid {kind} option {k!r}; allowed: {sorted(allowed)}")
+        merged[k] = v
+    return merged
+
+
+Any  # keep typing import alive for doc tooling

@@ -1,0 +1,65 @@
+"""Scheduling policies over the cluster resource view.
+
+Reference parity: src/ray/raylet/scheduling/policy/ —
+hybrid_scheduling_policy.cc (default: pack until a utilization threshold,
+then best node), spread_scheduling_policy.cc, node_affinity_scheduling_policy.cc.
+The admission decision stays with each node's daemon (leases can still be
+rejected and rescheduled), so this view only has to be approximately fresh —
+same split as raylet spillback.
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.protocol import NodeInfo
+
+HYBRID_THRESHOLD = 0.5  # reference: RAY_scheduler_spread_threshold default
+
+
+def _fits(available: dict, demand: dict) -> bool:
+    for k, v in demand.items():
+        if v > 0 and available.get(k, 0.0) + 1e-9 < v:
+            return False
+    return True
+
+
+def _utilization(node: NodeInfo) -> float:
+    worst = 0.0
+    for k, total in node.resources_total.items():
+        if total > 0:
+            used = total - node.resources_available.get(k, 0.0)
+            worst = max(worst, used / total)
+    return worst
+
+
+def pick_node(nodes: list[NodeInfo], demand: dict, strategy: str = "DEFAULT",
+              exclude: set | None = None, affinity=None,
+              affinity_soft: bool = True) -> NodeInfo | None:
+    """Returns the target node, or None only if NO node's total capacity can
+    ever satisfy the demand (infeasible).  When everything is momentarily
+    busy, a feasible node is still returned — the lease queues at its daemon,
+    matching the reference's raylet dispatch queues."""
+    exclude = exclude or set()
+    candidates = [n for n in nodes if n.node_id not in exclude
+                  and _fits(n.resources_available, demand)]
+    if not candidates:
+        candidates = [n for n in nodes if n.node_id not in exclude
+                      and _fits(n.resources_total, demand)]
+    if affinity is not None:
+        for n in candidates:
+            if n.node_id == affinity:
+                return n
+        if not affinity_soft:
+            return None
+    if not candidates:
+        return None
+    if strategy == "SPREAD":
+        # Least utilized first (spread_scheduling_policy.cc round-robins over
+        # feasible nodes; least-utilized achieves the same steady state).
+        return min(candidates, key=_utilization)
+    # Hybrid/DEFAULT: pack onto already-busy nodes while below the threshold
+    # so small tasks don't fragment the fleet, else fall back to best
+    # (least-utilized) node.
+    below = [n for n in candidates if _utilization(n) < HYBRID_THRESHOLD]
+    if below:
+        return max(below, key=_utilization)
+    return min(candidates, key=_utilization)

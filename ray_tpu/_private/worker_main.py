@@ -1,0 +1,67 @@
+"""Worker process entrypoint
+(reference: python/ray/_private/workers/default_worker.py).
+
+Connects to the node's shm store + GCS, reports readiness to its hostd, and
+blocks in the task execution loop.  Exits if its hostd disappears (orphan
+protection, reference: raylet death → worker suicide).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--hostd", required=True)
+    parser.add_argument("--store", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--job-id", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=os.environ.get("RAY_TPU_LOGLEVEL", "INFO"))
+
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.ids import JobID, NodeID
+    from ray_tpu._private.rpc import RpcClient
+
+    cw = CoreWorker(
+        mode="worker",
+        gcs_address=args.gcs,
+        store_path=args.store,
+        node_id=NodeID.from_hex(args.node_id),
+        hostd_address=args.hostd,
+        job_id=JobID(args.job_id.to_bytes(4, "little")),
+    )
+
+    # Tasks call ray_tpu.get/put/remote through the process-global worker.
+    from ray_tpu import api
+    api._worker = cw
+
+    hostd = RpcClient(args.hostd)
+    cw.io.run(hostd.call("NodeManager", "WorkerReady", {
+        "pid": os.getpid(),
+        "worker_id": cw.worker_id,
+        "address": cw.address,
+    }, timeout=10))
+
+    parent = os.getppid()
+
+    def orphan_watch():
+        while True:
+            if os.getppid() != parent:
+                logging.warning("hostd died; worker exiting")
+                os._exit(1)
+            time.sleep(1.0)
+
+    threading.Thread(target=orphan_watch, daemon=True).start()
+    cw.run_task_loop()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,320 @@
+"""Public API surface.
+
+Reference parity: python/ray/_private/worker.py (ray.init:1108, get:2411,
+put:2544, wait:2606, remote:3034, kill:2763, get_actor:2728, shutdown),
+python/ray/remote_function.py and python/ray/actor.py (@remote wrapping,
+.options(), ActorHandle/ActorMethod).
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import inspect
+import threading
+
+from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu._private.ids import ActorID, JobID
+from ray_tpu._private.protocol import validate_options
+
+_global_lock = threading.Lock()
+_worker = None          # CoreWorker of this process (driver or task worker)
+_cluster = None         # dict describing processes we spawned (head only)
+
+
+def is_initialized() -> bool:
+    return _worker is not None
+
+
+def _get_worker():
+    global _worker
+    if _worker is None:
+        # Inside a task-executing worker process the core worker already
+        # exists; find it via the worker_main-installed global.
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _worker
+
+
+def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
+         resources=None, namespace: str = "default",
+         object_store_memory: int = 256 << 20, ignore_reinit_error=False,
+         log_to_driver: bool = True, _system_config=None):
+    """Connect to (or bootstrap) a cluster.  Reference: worker.py ray.init:1108."""
+    global _worker, _cluster
+    with _global_lock:
+        if _worker is not None:
+            if ignore_reinit_error:
+                return _connection_info()
+            raise RuntimeError("ray_tpu.init() called twice")
+        from ray_tpu._private import node as node_mod
+        from ray_tpu._private.core_worker import CoreWorker
+        from ray_tpu._private.rpc import RpcClient
+
+        group = None
+        if address is None:
+            session_dir = node_mod.new_session_dir()
+            group = node_mod.ProcessGroup()
+            try:
+                gcs_address = node_mod.start_gcs(session_dir, group)
+                head = node_mod.start_hostd(
+                    gcs_address, session_dir, group,
+                    num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+                    store_capacity=object_store_memory, head=True)
+            except Exception:
+                group.reap()
+                raise
+            _cluster = {"group": group, "gcs": gcs_address,
+                        "session_dir": session_dir, "owned": True}
+        else:
+            gcs_address = address
+            # Find a hostd on this machine to use as our home node.
+            import asyncio
+
+            async def find_home():
+                gcs = RpcClient(gcs_address)
+                try:
+                    reply = await gcs.call("Gcs", "get_nodes", {}, timeout=10)
+                finally:
+                    await gcs.close()
+                import socket
+                hostname = socket.gethostname()
+                alive = [n for n in reply["nodes"] if n.alive]
+                for n in alive:
+                    if n.hostname == hostname:
+                        return n
+                raise RuntimeError(
+                    "no alive node on this host; start one with "
+                    "`ray_tpu start --address=...`")
+            head_info = asyncio.run(find_home())
+            head = {"address": head_info.address,
+                    "node_id": head_info.node_id.hex(),
+                    "store_path": head_info.store_path}
+            _cluster = {"group": None, "gcs": gcs_address, "owned": False}
+
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu._private.rpc import RpcClient as _Rpc
+        import asyncio as _aio
+
+        try:
+            async def next_job():
+                gcs = _Rpc(gcs_address)
+                try:
+                    reply = await gcs.call("Gcs", "next_job_id", {}, timeout=10)
+                    return reply["job_id"]
+                finally:
+                    await gcs.close()
+            job_int = _aio.run(next_job())
+
+            _worker = CoreWorker(
+                mode="driver",
+                gcs_address=gcs_address,
+                store_path=head["store_path"],
+                node_id=NodeID.from_hex(head["node_id"]),
+                hostd_address=head["address"],
+                job_id=JobID(job_int.to_bytes(4, "little")),
+            )
+        except Exception:
+            _cluster = None
+            if group is not None:
+                group.reap()
+            raise
+        atexit.register(shutdown)
+        return _connection_info()
+
+
+def _connection_info():
+    return {"gcs_address": _cluster["gcs"] if _cluster else None,
+            "session_dir": (_cluster or {}).get("session_dir")}
+
+
+def shutdown():
+    """Disconnect; if we bootstrapped the cluster, tear it down."""
+    global _worker, _cluster
+    with _global_lock:
+        if _worker is None:
+            return
+        cluster, worker = _cluster, _worker
+        _worker = None
+        _cluster = None
+    try:
+        if cluster and cluster.get("owned"):
+            try:
+                worker.io.run(worker.gcs.call("Gcs", "shutdown_cluster", {}),
+                              timeout=5)
+            except Exception:
+                pass
+    finally:
+        worker.shutdown()
+        if cluster and cluster.get("owned") and cluster.get("group"):
+            cluster["group"].reap()
+
+
+def put(value) -> ObjectRef:
+    return _get_worker().put(value)
+
+
+def get(refs, *, timeout: float | None = None):
+    return _get_worker().get(refs, timeout)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: float | None = None,
+         fetch_local: bool = True):
+    if not isinstance(refs, list):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return _get_worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True):
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    _get_worker().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref, *, force: bool = False, recursive: bool = True):
+    raise NotImplementedError("task cancellation lands with the C++ transport")
+
+
+def get_actor(name: str, namespace: str = "default") -> "ActorHandle":
+    info = _get_worker().get_named_actor(name, namespace)
+    if info is None or info.state == "DEAD":
+        raise ValueError(f"actor {name!r} not found in namespace {namespace!r}")
+    return ActorHandle(info.actor_id, info.class_name, None)
+
+
+def cluster_resources() -> dict:
+    w = _get_worker()
+    return w.io.run(w.gcs.call("Gcs", "cluster_resources", {}))["total"]
+
+
+def available_resources() -> dict:
+    w = _get_worker()
+    return w.io.run(w.gcs.call("Gcs", "cluster_resources", {}))["available"]
+
+
+def nodes() -> list:
+    w = _get_worker()
+    reply = w.io.run(w.gcs.call("Gcs", "get_nodes", {}))
+    return [
+        {"NodeID": n.node_id.hex(), "Alive": n.alive, "Address": n.address,
+         "Resources": n.resources_total, "IsHead": n.is_head}
+        for n in reply["nodes"]
+    ]
+
+
+# ---------------------------------------------------------------------------
+# @remote
+# ---------------------------------------------------------------------------
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: dict):
+        self._fn = fn
+        self._options = validate_options(options, for_actor=False)
+        functools.update_wrapper(self, fn)
+
+    def remote(self, *args, **kwargs):
+        refs = _get_worker().submit_task(self._fn, args, kwargs, self._options)
+        return refs[0] if self._options.get("num_returns", 1) == 1 else refs
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(opts)  # constructor re-validates the merged set
+        return RemoteFunction(self._fn, merged)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__} cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        refs = _get_worker().submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            {"num_returns": self._num_returns,
+             "max_task_retries": self._handle._max_task_retries})
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 method_meta: dict | None, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_meta = method_meta or {}
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name,
+                           self._method_meta.get(name, {}).get("num_returns", 1))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_meta, self._max_task_retries))
+
+
+class ActorClass:
+    def __init__(self, cls, options: dict):
+        self._cls = cls
+        self._options = validate_options(options, for_actor=True)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = _get_worker()
+        actor_id = worker.create_actor(self._cls, args, kwargs, self._options)
+        meta = {}
+        for name, fn in inspect.getmembers(self._cls, inspect.isfunction):
+            meta[name] = {"num_returns": 1}
+        return ActorHandle(actor_id, self._cls.__name__, meta,
+                           self._options.get("max_task_retries", 0))
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"actor class {self._cls.__name__} cannot be "
+                        f"instantiated directly; use .remote()")
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for tasks and actors (reference: worker.py:3034)."""
+    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0])
+                                          or inspect.isclass(args[0])):
+        return _make_remote(args[0], {})
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+
+    def wrap(obj):
+        return _make_remote(obj, kwargs)
+    return wrap
+
+
+def _make_remote(obj, options: dict):
+    if inspect.isclass(obj):
+        return ActorClass(obj, options)
+    if inspect.isfunction(obj) or callable(obj):
+        return RemoteFunction(obj, options)
+    raise TypeError(f"@remote cannot wrap {obj!r}")
+
+
+def method(num_returns: int = 1):
+    """@method decorator inside actor classes (num_returns for methods)."""
+    def wrap(fn):
+        fn._num_returns = num_returns
+        return fn
+    return wrap

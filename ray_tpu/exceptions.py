@@ -29,6 +29,9 @@ class TaskError(RayTpuError):
         self.cause = cause
         super().__init__(f"task {function_name} failed:\n{traceback_str}")
 
+    def __reduce__(self):
+        return (type(self), (self.function_name, self.traceback_str, self.cause))
+
 
 class WorkerCrashedError(RayTpuError):
     """The worker executing the task died unexpectedly."""
@@ -47,6 +50,9 @@ class ActorDiedError(ActorError):
         self.reason = reason
         super().__init__(f"actor {actor_id} died: {reason}")
 
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.reason))
+
 
 class ActorUnavailableError(ActorError):
     """The actor is restarting; the call may be retried."""
@@ -57,7 +63,11 @@ class ObjectLostError(RayTpuError):
 
     def __init__(self, object_id=None, reason: str = ""):
         self.object_id = object_id
+        self.reason = reason
         super().__init__(f"object {object_id} lost: {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, self.reason))
 
 
 class ObjectStoreFullError(RayTpuError):

@@ -1,0 +1,90 @@
+"""ObjectRef: a distributed future naming an object and its owner.
+
+Reference parity: python/ray/_raylet.pyx ObjectRef + ownership model from
+src/ray/core_worker/reference_count.h — every ref carries the owner's RPC
+address so any holder can (a) resolve the value, (b) report borrows back to
+the owner, which runs the distributed refcount.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private.ids import ObjectID
+
+# Set by the core worker when a process connects; used for GC callbacks.
+_ref_hooks = None
+
+
+def _install_hooks(hooks):
+    global _ref_hooks
+    _ref_hooks = hooks
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_skip_gc", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = "",
+                 _register: bool = True):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._skip_gc = not _register
+        if _register and _ref_hooks is not None:
+            _ref_hooks.on_ref_created(self)
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        if _ref_hooks is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return _ref_hooks.as_future(self)
+
+    def __await__(self):
+        """Allow `await ref` inside async actors."""
+        if _ref_hooks is None:
+            raise RuntimeError("ray_tpu not initialized")
+        return _ref_hooks.await_ref(self).__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Serialization of a ref is a *borrow*: the serializer's thread-local
+        # collector records it so the owner learns about the new holder.
+        from ray_tpu._private import serialization as _ser
+        collector = _ser.current_ref_collector()
+        if collector is not None:
+            collector.append(self)
+        return (_deserialize_ref, (self.id.binary(), self.owner_address))
+
+    def __del__(self):
+        if not self._skip_gc and _ref_hooks is not None:
+            try:
+                _ref_hooks.on_ref_deleted(self)
+            except Exception:
+                pass
+
+
+def _deserialize_ref(id_binary: bytes, owner_address: str) -> "ObjectRef":
+    ref = ObjectRef(ObjectID(id_binary), owner_address, _register=False)
+    if _ref_hooks is not None:
+        _ref_hooks.on_ref_deserialized(ref)
+        ref._skip_gc = False
+    return ref
+
+
+Any  # silence linters about unused import in docs builds
