@@ -1,0 +1,301 @@
+"""Flagship model: decoder-only transformer (GPT family), TPU-first.
+
+Design (no reference counterpart — Ray hosts models, it doesn't ship them;
+this repo's north star BASELINE.md requires a GPT-2-125M fine-tune and a 7B
+config):
+  * pure functional: params are a pytree, forward is a jittable function —
+    plays directly with pjit/GSPMD and donation;
+  * layers are STACKED on a leading dim and applied with `lax.scan` — one
+    compiled block regardless of depth (fast compiles, small HLO);
+  * every param leaf has a logical sharding spec (parallel.sharding rules
+    decide DP/FSDP/TP placement);
+  * attention = flash (Pallas) on one chip, ring attention when the mesh has
+    a seq axis > 1;
+  * optional Switch-style MoE MLP for expert parallelism;
+  * `jax.checkpoint` (remat) on the block when configured — trades FLOPs for
+    HBM, the standard TPU memory lever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.sharding import (
+    logical_to_spec, named_sharding, tree_shardings, with_logical_constraint)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # GPT-2 vocab padded to a multiple of 128
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16        # activation dtype (params kept fp32)
+    n_experts: int = 0               # 0 = dense MLP; >0 = Switch MoE
+    capacity_factor: float = 1.25
+    remat: bool = False
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# Preset configs (BASELINE.md targets).
+CONFIGS = {
+    "nano": GPTConfig(vocab_size=512, n_layers=2, d_model=64, n_heads=4,
+                      d_ff=128, max_seq_len=128, dtype=jnp.float32),
+    "nano-moe": GPTConfig(vocab_size=512, n_layers=2, d_model=64, n_heads=4,
+                          d_ff=128, max_seq_len=128, n_experts=4,
+                          dtype=jnp.float32),
+    "gpt2-small": GPTConfig(),       # 124M
+    "gpt2-medium": GPTConfig(n_layers=24, d_model=1024, n_heads=16,
+                             d_ff=4096),
+    "gpt2-xl": GPTConfig(n_layers=48, d_model=1600, n_heads=25, d_ff=6400),
+    "7b": GPTConfig(vocab_size=32000, n_layers=32, d_model=4096, n_heads=32,
+                    d_ff=11008, max_seq_len=4096, remat=True),
+}
+
+
+def param_specs(config: GPTConfig) -> dict:
+    """Logical sharding spec tree, congruent with init_params output."""
+    blocks = {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "kv"),
+        "wk": ("layers", "embed", "heads", "kv"),
+        "wv": ("layers", "embed", "heads", "kv"),
+        "wo": ("layers", "heads", "kv", "embed"),
+        "ln2_scale": ("layers", "embed"),
+        "ln2_bias": ("layers", "embed"),
+    }
+    if config.n_experts:
+        blocks.update({
+            "router": ("layers", "embed", "experts"),
+            "w_up": ("layers", "experts", "embed", "expert_mlp"),
+            "w_down": ("layers", "experts", "expert_mlp", "embed"),
+        })
+    else:
+        blocks.update({
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        })
+    specs = {
+        "tok_embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "blocks": blocks,
+        "final_ln_scale": ("embed",),
+        "final_ln_bias": ("embed",),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = ("embed", "vocab")
+    return specs
+
+
+def init_params(config: GPTConfig, key: jax.Array) -> dict:
+    c = config
+    n, d, h, dh, f = c.n_layers, c.d_model, c.n_heads, c.head_dim, c.d_ff
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in))
+
+    blocks = {
+        "ln1_scale": jnp.ones((n, d)),
+        "ln1_bias": jnp.zeros((n, d)),
+        "wq": dense(next(keys), (n, d, h, dh), d),
+        "wk": dense(next(keys), (n, d, h, dh), d),
+        "wv": dense(next(keys), (n, d, h, dh), d),
+        # Residual-branch outputs scaled per GPT-2 (1/sqrt(2*n_layers)).
+        "wo": dense(next(keys), (n, h, dh, d), h * dh) / np.sqrt(2 * n),
+        "ln2_scale": jnp.ones((n, d)),
+        "ln2_bias": jnp.zeros((n, d)),
+    }
+    if c.n_experts:
+        e = c.n_experts
+        blocks["router"] = dense(next(keys), (n, d, e), d)
+        blocks["w_up"] = dense(next(keys), (n, e, d, f), d)
+        blocks["w_down"] = dense(next(keys), (n, e, f, d), f) / np.sqrt(2 * n)
+    else:
+        blocks["w_up"] = dense(next(keys), (n, d, f), d)
+        blocks["w_down"] = dense(next(keys), (n, f, d), f) / np.sqrt(2 * n)
+
+    params = {
+        "tok_embed": jax.random.normal(next(keys), (c.vocab_size, d)) * 0.02,
+        "pos_embed": jax.random.normal(next(keys), (c.max_seq_len, d)) * 0.01,
+        "blocks": blocks,
+        "final_ln_scale": jnp.ones((d,)),
+        "final_ln_bias": jnp.zeros((d,)),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (d, c.vocab_size), d)
+    return params
+
+
+def shard_params(params: dict, mesh, config: GPTConfig, rules=None) -> dict:
+    return jax.device_put(params,
+                          tree_shardings(mesh, param_specs(config), rules))
+
+
+def num_params(config: GPTConfig) -> int:
+    shapes = jax.eval_shape(partial(init_params, config), jax.random.key(0))
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _moe_mlp(x, router, w_up, w_down, config: GPTConfig, mesh):
+    """Switch-style top-1 MoE with dense dispatch (einsum one-hot masks —
+    static shapes, XLA-friendly; no sort/scatter)."""
+    b, l, d = x.shape
+    e = config.n_experts
+    t = b * l
+    cap = int(math.ceil(t / e * config.capacity_factor))
+    xt = x.reshape(t, d)
+
+    logits = (xt.astype(jnp.float32) @ router.astype(jnp.float32))  # [T,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, -1)                      # [T]
+    expert = jnp.argmax(probs, -1)                 # [T]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)       # [T,E]
+    # Position of each token within its expert's queue.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # [T,E]
+    keep = (pos < cap) & (onehot > 0)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap) * keep[..., None]
+    dispatch = pos_oh                                            # [T,E,C]
+
+    ex_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    ex_in = with_logical_constraint(ex_in, ("experts", None, "embed"),
+                                    mesh=mesh)
+    hidden = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", ex_in,
+                                    w_up.astype(x.dtype)))
+    ex_out = jnp.einsum("ecf,efd->ecd", hidden, w_down.astype(x.dtype))
+    combine = dispatch * gate[:, None, None]
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ex_out)
+
+    # Load-balancing aux loss (Switch eq. 4): mean prob * mean assignment.
+    density = jnp.mean(onehot, 0)
+    density_prob = jnp.mean(probs, 0)
+    aux = e * jnp.sum(density * density_prob)
+    return out.reshape(b, l, d), aux
+
+
+def _block(x, p, config: GPTConfig, mesh):
+    c = config
+    h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
+    q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(h.dtype))
+    k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(h.dtype))
+    v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(h.dtype))
+    q = with_logical_constraint(q, ("batch", "length", "heads", "kv"),
+                                mesh=mesh)
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        attn = ring_attention(q, k, v, mesh=mesh, causal=True)
+    else:
+        attn = flash_attention(q, k, v, causal=True)
+    attn_out = jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(h.dtype))
+    x = x + attn_out
+
+    h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
+    if c.n_experts:
+        mlp_out, aux = _moe_mlp(h, p["router"], p["w_up"], p["w_down"], c,
+                                mesh)
+    else:
+        hidden = jax.nn.gelu(
+            jnp.einsum("bld,df->blf", h, p["w_up"].astype(h.dtype)))
+        hidden = with_logical_constraint(hidden, ("batch", "length", "mlp"),
+                                         mesh=mesh)
+        mlp_out = jnp.einsum("blf,fd->bld", hidden,
+                             p["w_down"].astype(h.dtype))
+        aux = jnp.zeros((), jnp.float32)
+    x = x + mlp_out
+    x = with_logical_constraint(x, ("batch", "length", "act_embed"), mesh=mesh)
+    return x, aux
+
+
+def forward(params: dict, tokens: jax.Array, config: GPTConfig,
+            mesh=None) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, L] int32 -> (logits [B, L, V], moe_aux_loss scalar)."""
+    c = config
+    b, l = tokens.shape
+    x = params["tok_embed"][tokens].astype(c.dtype)
+    x = x + params["pos_embed"][:l][None].astype(c.dtype)
+    x = with_logical_constraint(x, ("batch", "length", "act_embed"), mesh=mesh)
+
+    block = partial(_block, config=c, mesh=mesh)
+    if c.remat:
+        block = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(x, layer_params):
+        x, aux = block(x, layer_params)
+        return x, aux
+
+    x, auxes = jax.lax.scan(body, x, params["blocks"])
+    x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    head = (params["tok_embed"].T if c.tie_embeddings
+            else params["lm_head"]).astype(c.dtype)
+    logits = jnp.einsum("bld,dv->blv", x, head)
+    logits = with_logical_constraint(logits, ("batch", "length", "vocab"),
+                                     mesh=mesh)
+    return logits, jnp.sum(auxes)
+
+
+def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):
+    """batch = {"tokens": [B, L]} — next-token cross-entropy.
+
+    Runs the model on the FULL length L and shifts targets instead of
+    slicing inputs to L-1: the sequence dim must stay divisible by the
+    mesh's seq axis for ring attention, and L-1 never is.
+    """
+    tokens = batch["tokens"]
+    logits, aux = forward(params, tokens, config, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # Last position predicts the rolled-around token 0 — always masked.
+    valid = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        valid = valid * mask
+    loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+    return loss + 0.01 * aux
+
+
+def make_train_step(config: GPTConfig, optimizer, mesh=None):
+    """Returns (init_state, train_step).  train_step is jittable; under a
+    mesh, pass sharded state and XLA/GSPMD inserts the collectives."""
+    import optax
+
+    def init_state(key):
+        params = init_params(config, key)
+        return {"params": params, "opt_state": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch, config, mesh)
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    return init_state, train_step

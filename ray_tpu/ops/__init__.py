@@ -1,0 +1,4 @@
+"""TPU-native ops: Pallas kernels and sharded attention primitives."""
+
+from ray_tpu.ops.attention import flash_attention, reference_attention  # noqa: F401
+from ray_tpu.ops.ring_attention import ring_attention  # noqa: F401

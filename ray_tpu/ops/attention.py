@@ -1,0 +1,158 @@
+"""Attention ops: blockwise (flash) attention with a Pallas TPU kernel.
+
+No reference counterpart — Ray delegates compute to hosted frameworks
+(SURVEY.md §5 "Long-context: absent").  Here attention is a core op: the
+Pallas kernel keeps the softmax accumulation in VMEM (online softmax, never
+materialising the [L, L] score matrix in HBM) and tiles the contraction onto
+the MXU; a pure-jnp fallback covers CPU tests and odd shapes.
+
+Layouts: q/k/v are [batch, length, heads, head_dim] (BLHD) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, *, causal: bool = True,
+                        scale: Optional[float] = None,
+                        segment_ids=None) -> jax.Array:
+    """Plain XLA attention (fallback + ground truth for kernel tests)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = _build_mask(q.shape[1], k.shape[1], causal, segment_ids)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _build_mask(q_len, k_len, causal, segment_ids):
+    mask = None
+    if causal:
+        mask = jnp.tril(jnp.ones((q_len, k_len), bool),
+                        k=k_len - q_len)[None, None]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = seg if mask is None else (mask & seg)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_k: int, causal: bool, scale: float,
+                  n_kv_blocks: int):
+    """One (batch*head, q_block, kv_block) grid step: online softmax.
+
+    K/V arrive one VMEM block per grid step (the grid's last dim streams
+    them from HBM — memory is O(block), not O(kv_len)); softmax state
+    persists in VMEM scratch across the kv sweep for a given q block.
+    Refs: q [bq, d], k/v [block_k, d], o [bq, d]; scratch m/l [bq, 1] f32,
+    acc [bq, d] f32.
+    """
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+    bq = q_ref.shape[0]
+    q_offset = q_idx * bq
+    kv_offset = kv_idx * block_k
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        s = q @ k_blk.T                                        # [bq, block_k]
+        if causal:
+            q_pos = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = kv_offset + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + p @ v_blk
+
+    if causal:
+        # KV blocks strictly above the diagonal contribute nothing.
+        pl.when(q_offset + bq - 1 >= kv_offset)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kv_idx == n_kv_blocks - 1)
+    def _finalize():
+        o_ref[...] = (acc_ref[...]
+                      / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+try:  # Pallas import kept lazy-safe for platforms without it.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None, block_q: int = 256,
+                    block_k: int = 256, interpret: Optional[bool] = None):
+    """Blockwise attention via Pallas.  Falls back to XLA attention when the
+    shape does not tile (length % block != 0) or Pallas is unavailable."""
+    b, q_len, h, d = q.shape
+    kv_len = k.shape[1]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, kv_len)
+    if (not _HAS_PALLAS or q_len % block_q or kv_len % block_k
+            or d not in (64, 128, 256) or (causal and q_len != kv_len)):
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    n_kv_blocks = kv_len // block_k
+
+    # Fold batch and heads into the grid; kernel sees [len, d] slices.
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, q_len, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, kv_len, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, kv_len, d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                               scale=scale, n_kv_blocks=n_kv_blocks)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, q_len // block_q, n_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, q_len, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, q_len, d).transpose(0, 2, 1, 3)

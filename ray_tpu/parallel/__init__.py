@@ -1,0 +1,21 @@
+"""Parallelism layer: meshes, sharding rules, pipeline schedules.
+
+First-class DP/FSDP/TP/SP/EP/PP over one jax.sharding.Mesh (the reference
+delegates all of this to hosted frameworks — SURVEY.md §2.5)."""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    AXES,
+    MeshConfig,
+    create_mesh,
+    mesh_axis_size,
+    single_device_mesh,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    logical_to_spec,
+    named_sharding,
+    shard_batch,
+    tree_shardings,
+    with_logical_constraint,
+)
+from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401

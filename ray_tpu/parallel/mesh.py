@@ -1,0 +1,89 @@
+"""Device-mesh construction for multi-dimensional parallelism.
+
+The reference (Ray) has no first-class mesh concept — DP/TP/PP live in the
+hosted frameworks (SURVEY.md §2.5, reference release/alpa_tests/).  Here the
+mesh IS the first-class object: every parallelism strategy is an axis of one
+`jax.sharding.Mesh` and XLA/GSPMD compiles the collectives onto ICI.
+
+Axis vocabulary (MaxText-style, one mesh for the whole program):
+  data    — pure data parallelism (batch split, gradients psum over ICI/DCN)
+  fsdp    — data parallelism with sharded params/optimizer (ZeRO-3 style;
+            params all-gathered per layer, grads reduce-scattered)
+  expert  — expert parallelism for MoE layers (experts split across devices,
+            tokens routed via all-to-all)
+  seq     — sequence/context parallelism (ring attention over this axis)
+  tensor  — tensor (megatron) parallelism within attention/mlp blocks
+  stage   — pipeline stage axis (used by parallel.pipeline, not by GSPMD)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "fsdp", "expert", "seq", "tensor", "stage")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each parallelism axis; -1 means "absorb remaining devices".
+
+    At most one axis may be -1.  The product of resolved sizes must equal the
+    device count.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+    stage: int = 1
+
+    def resolve(self, n_devices: int) -> dict:
+        sizes = {a: getattr(self, a) for a in AXES}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes ({fixed})")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def create_mesh(config: Optional[MeshConfig] = None,
+                devices: Optional[Sequence[jax.Device]] = None,
+                axis_names: Sequence[str] = AXES) -> Mesh:
+    """Build a Mesh over `devices` (default: all) per `config`.
+
+    Device order follows jax.devices(), which JAX arranges so that adjacent
+    devices are ICI neighbours on TPU; trailing (fastest-varying) mesh axes
+    therefore get the best ICI locality — put `tensor` and `seq` last, which
+    the default axis order already does.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in axis_names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1-chip mesh with all axes size 1 — lets one jitted program serve
+    both single-chip and pod runs without branching."""
+    return create_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)

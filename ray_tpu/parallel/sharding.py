@@ -1,0 +1,105 @@
+"""Logical-axis sharding rules: annotate arrays by meaning, not mesh axis.
+
+Parameters and activations carry *logical* axis names ("embed", "mlp",
+"heads", "batch", "length", "experts", ...).  A rule table maps logical →
+mesh axes; changing the parallelism strategy is a rule-table swap, never a
+model edit.  This is the GSPMD/pjit idiom (scaling-book recipe): annotate,
+let XLA insert the collectives.
+
+No reference counterpart — Ray delegates sharding to hosted frameworks
+(SURVEY.md §2.5); here it is a core subsystem.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LogicalSpec = Tuple[Optional[str], ...]
+
+# Default rule table: logical axis -> mesh axis (or tuple of mesh axes).
+# Covers dense transformer + MoE.  "embed" deliberately maps to fsdp so that
+# ZeRO-3 style weight sharding engages when the fsdp axis is >1.
+DEFAULT_RULES: Mapping[str, Union[str, Tuple[str, ...], None]] = {
+    "batch": ("data", "fsdp"),   # global batch split over both DP axes
+    "length": "seq",             # sequence dim: context parallelism
+    "embed": "fsdp",             # param embed dim: FSDP shard
+    "act_embed": None,           # activation embed dim: full (batch already
+                                 # covers fsdp; XLA all-gathers params JIT)
+    "mlp": "tensor",             # ffn hidden: megatron column/row split
+    "heads": "tensor",           # attention heads: megatron split
+    "kv": None,                  # per-head dim: never sharded
+    "vocab": "tensor",           # embedding/logits vocab dim
+    "experts": "expert",         # MoE expert dim
+    "expert_mlp": "tensor",      # ffn hidden inside an expert
+    "layers": None,              # scanned layer dim (stacked params)
+    "stage": "stage",            # pipeline stage dim
+}
+
+
+def logical_to_spec(logical: LogicalSpec,
+                    rules: Optional[Mapping] = None,
+                    mesh: Optional[Mesh] = None) -> P:
+    """Translate a logical spec like ("batch", "length", "embed") to a
+    PartitionSpec using `rules`.  Mesh axes of size 1 (or absent) are dropped
+    so the same rules work on any mesh shape."""
+    rules = DEFAULT_RULES if rules is None else rules
+    out = []
+    for name in logical:
+        mapped = rules.get(name) if name is not None else None
+        if mapped is None:
+            out.append(None)
+            continue
+        axes = (mapped,) if isinstance(mapped, str) else tuple(mapped)
+        if mesh is not None:
+            axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    # Trailing Nones are redundant in a PartitionSpec.
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def named_sharding(mesh: Mesh, logical: LogicalSpec,
+                   rules: Optional[Mapping] = None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical, rules, mesh))
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any,
+                   rules: Optional[Mapping] = None) -> Any:
+    """Map a pytree of logical specs to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda spec: named_sharding(mesh, spec, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def with_logical_constraint(x, logical: LogicalSpec,
+                            rules: Optional[Mapping] = None,
+                            mesh: Optional[Mesh] = None):
+    """Inside jit: constrain an intermediate to its logical sharding.
+    Outside a mesh context (single chip) this is a no-op."""
+    if mesh is None or all(s <= 1 for s in mesh.shape.values()):
+        return x
+    spec = logical_to_spec(logical, rules, mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_batch(mesh: Mesh, batch: Any,
+                rules: Optional[Mapping] = None) -> Any:
+    """Device-put a host batch pytree with ("batch", "length") layout onto
+    the mesh, splitting over the data axes."""
+    def put(x):
+        logical = ("batch",) + (None,) * (x.ndim - 1)
+        if x.ndim >= 2:
+            logical = ("batch", "length") + (None,) * (x.ndim - 2)
+        return jax.device_put(x, named_sharding(mesh, logical, rules))
+    return jax.tree.map(put, batch)

@@ -9,13 +9,21 @@ slice, so every sharding/collective path is exercised without TPU hardware.
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere.  Force cpu even if the outer
+# environment selects a TPU platform — tests exercise shardings on the
+# virtual mesh; real-chip runs go through bench.py.  The env var alone is
+# not enough here: the image's sitecustomize registers a TPU PJRT plugin at
+# interpreter start, so also flip the jax config knob.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
