@@ -29,6 +29,7 @@ import numpy as np
 
 from ray_tpu.ops.attention import flash_attention
 from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.mesh import mesh_axis_size
 from ray_tpu.parallel.sharding import (
     logical_to_spec, named_sharding, tree_shardings, with_logical_constraint)
 
@@ -206,7 +207,7 @@ def _block(x, p, config: GPTConfig, mesh):
     v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(h.dtype))
     q = with_logical_constraint(q, ("batch", "length", "heads", "kv"),
                                 mesh=mesh)
-    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+    if mesh is not None and mesh_axis_size(mesh, "seq") > 1:
         attn = ring_attention(q, k, v, mesh=mesh, causal=True)
     else:
         attn = flash_attention(q, k, v, causal=True)
@@ -280,12 +281,20 @@ def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):
 
 def make_train_step(config: GPTConfig, optimizer, mesh=None):
     """Returns (init_state, train_step).  train_step is jittable; under a
-    mesh, pass sharded state and XLA/GSPMD inserts the collectives."""
+    mesh, init_state shards params AND optimizer state (ZeRO-3: Adam
+    moments inherit each param's sharding via GSPMD propagation through
+    jit(optimizer.init)) and XLA inserts the collectives."""
     import optax
 
     def init_state(key):
         params = init_params(config, key)
-        return {"params": params, "opt_state": optimizer.init(params),
+        opt_state = optimizer.init(params)
+        if mesh is not None:
+            from ray_tpu.parallel.sharding import shard_opt_state
+            shardings = tree_shardings(mesh, param_specs(config))
+            opt_state = shard_opt_state(opt_state, params, shardings, mesh)
+            params = shard_params(params, mesh, config)
+        return {"params": params, "opt_state": opt_state,
                 "step": jnp.zeros((), jnp.int32)}
 
     def train_step(state, batch):

@@ -118,7 +118,39 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None, block_q: int = 256,
                     block_k: int = 256, interpret: Optional[bool] = None):
     """Blockwise attention via Pallas.  Falls back to XLA attention when the
-    shape does not tile (length % block != 0) or Pallas is unavailable."""
+    shape does not tile (length % block != 0) or Pallas is unavailable.
+
+    Differentiable: Pallas forward + custom VJP whose backward recomputes
+    attention with the XLA path (flash-style Pallas backward kernel is a
+    planned optimisation; the recompute keeps forward memory O(block) and
+    correctness exact)."""
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward_impl(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward_impl(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference_attention(q, k, v, causal=causal,
+                                            scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_forward_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     b, q_len, h, d = q.shape
     kv_len = k.shape[1]
     block_q = min(block_q, q_len)

@@ -31,8 +31,10 @@ from ray_tpu.ops.attention import NEG_INF
 
 def _block_attend(q, k, v, scale, mask):
     """One q-shard x kv-block contribution: returns (m, l, acc) partials.
-    q [B,Lq,H,D], k/v [B,Lk,H,D]; mask [Lq,Lk] bool or None."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    q [B,Lq,H,D], k/v [B,Lk,H,D]; mask [Lq,Lk] bool or None.  acc stays
+    float32 across merges (matches the Pallas kernel's f32 accumulator)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)                                   # [B,H,Lq]
@@ -40,12 +42,13 @@ def _block_attend(q, k, v, scale, mask):
     m_safe = jnp.maximum(m, NEG_INF / 2)
     p = jnp.exp(s - m_safe[..., None])
     l = jnp.sum(p, axis=-1)                                   # [B,H,Lq]
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v) # [B,Lq,H,D]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)      # [B,Lq,H,D]
     return m, l, acc
 
 
 def _merge(m1, l1, a1, m2, l2, a2):
-    """Combine two online-softmax partial states."""
+    """Combine two online-softmax partial states (all f32)."""
     m = jnp.maximum(m1, m2)
     e1 = jnp.exp(m1 - m)
     e2 = jnp.exp(m2 - m)
@@ -53,7 +56,7 @@ def _merge(m1, l1, a1, m2, l2, a2):
     # e* are [B,H,Lq]; acc is [B,Lq,H,D] — transpose scale factors.
     s1 = e1.transpose(0, 2, 1)[..., None]
     s2 = e2.transpose(0, 2, 1)[..., None]
-    a = a1 * s1.astype(a1.dtype) + a2 * s2.astype(a2.dtype)
+    a = a1 * s1 + a2 * s2
     return m, l, a
 
 
@@ -64,14 +67,26 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "seq",
     mesh `axis`.  Inputs/outputs are global arrays [B, L, H, D]; sharding of
     the length dim over `axis` is applied via shard_map.
     """
-    n_ring = mesh.shape.get(axis, 1)
+    from ray_tpu.parallel.mesh import mesh_axis_size
+    from ray_tpu.parallel.sharding import DEFAULT_RULES
+
+    n_ring = mesh_axis_size(mesh, axis)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     if n_ring == 1:
         from ray_tpu.ops.attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
 
-    spec = P(None, axis, None, None)
+    # Batch stays sharded over the data axes and heads over tensor — only
+    # the length dim participates in the ring (otherwise every DP replica
+    # would recompute the full global batch).
+    def _mapped(name):
+        ax = DEFAULT_RULES.get(name)
+        axes = (ax,) if isinstance(ax, str) else tuple(ax or ())
+        axes = tuple(a for a in axes if mesh_axis_size(mesh, a) > 1)
+        return None if not axes else (axes[0] if len(axes) == 1 else axes)
+
+    spec = P(_mapped("batch"), axis, _mapped("heads"), None)
 
     def local(qs, ks, vs):
         r = jax.lax.axis_index(axis)

@@ -47,7 +47,14 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     total_steps = n_micro + n_stages - 1
 
     param_spec = P(axis)
-    io_spec = P()  # microbatch stream replicated over the stage axis
+    # Microbatch stream: replicated over the stage axis, but the per-
+    # microbatch batch dim stays sharded over the data axes (each data
+    # slice pipelines its own batch shard; P() here would make every
+    # slice redundantly compute the global batch).
+    from ray_tpu.parallel.mesh import mesh_axis_size
+    batch_axes = tuple(a for a in ("data", "fsdp")
+                       if mesh_axis_size(mesh, a) > 1)
+    io_spec = P(None, batch_axes if batch_axes else None)
 
     def per_stage(params, mb):
         # Inside shard_map: params leaves have leading dim 1 (this stage's

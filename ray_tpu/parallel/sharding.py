@@ -93,6 +93,35 @@ def with_logical_constraint(x, logical: LogicalSpec,
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def shard_opt_state(opt_state: Any, params: Any, param_shardings: Any,
+                    mesh: Mesh) -> Any:
+    """Place optimizer state on the mesh: any subtree congruent with the
+    params tree (Adam mu/nu, momentum, ...) inherits the param shardings
+    leaf-for-leaf; everything else (step counts, scalars) is replicated.
+    This is the ZeRO-3 half that `shard_params` alone misses."""
+    from jax.tree_util import default_registry
+
+    pstruct = jax.tree.structure(params)
+    replicated = NamedSharding(mesh, P())
+
+    def place(node):
+        if jax.tree.structure(node) == pstruct and pstruct.num_leaves > 1:
+            return jax.tree.map(jax.device_put, node, param_shardings)
+        try:
+            flat = default_registry.flatten_one_level(node)
+        except ValueError:
+            flat = None
+        if flat is None:  # a leaf (array or scalar)
+            return (jax.device_put(node, replicated)
+                    if hasattr(node, "shape") else node)
+        children, _ = flat
+        one_level = jax.tree.structure(node,
+                                       is_leaf=lambda x: x is not node)
+        return jax.tree.unflatten(one_level, [place(c) for c in children])
+
+    return place(opt_state)
+
+
 def shard_batch(mesh: Mesh, batch: Any,
                 rules: Optional[Mapping] = None) -> Any:
     """Device-put a host batch pytree with ("batch", "length") layout onto

@@ -106,7 +106,6 @@ def test_gpt_train_step_dp_fsdp_tp():
     init_state, train_step = gpt.make_train_step(cfg, opt, mesh)
 
     state = init_state(jax.random.key(0))
-    state["params"] = gpt.shard_params(state["params"], mesh, cfg)
     batch = shard_batch(mesh, _tiny_batch(cfg, batch=8))
 
     step = jax.jit(train_step, donate_argnums=0)
@@ -124,7 +123,6 @@ def test_gpt_moe_expert_parallel():
     opt = optax.sgd(1e-2)
     init_state, train_step = gpt.make_train_step(cfg, opt, mesh)
     state = init_state(jax.random.key(1))
-    state["params"] = gpt.shard_params(state["params"], mesh, cfg)
     batch = shard_batch(mesh, _tiny_batch(cfg, batch=8))
     state, metrics = jax.jit(train_step)(state, batch)
     assert np.isfinite(float(metrics["loss"]))
@@ -159,7 +157,6 @@ def test_gpt_train_step_seq_parallel():
     opt = optax.sgd(1e-2)
     init_state, train_step = gpt.make_train_step(cfg, opt, mesh)
     state = init_state(jax.random.key(0))
-    state["params"] = gpt.shard_params(state["params"], mesh, cfg)
     batch = shard_batch(mesh, _tiny_batch(cfg, batch=8))
     state, metrics = jax.jit(train_step)(state, batch)
     assert np.isfinite(float(metrics["loss"]))
@@ -179,3 +176,15 @@ def test_flash_attention_long_context_blocks():
     out = flash_attention(q, kv, kv, causal=True, block_q=128, block_k=64)
     ref = reference_attention(q, kv, kv, causal=True)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_opt_state_sharded_like_params():
+    # ZeRO-3: Adam moments must inherit each param's sharding, not stay
+    # replicated.
+    cfg = gpt.CONFIGS["nano"]
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    init_state, _ = gpt.make_train_step(cfg, optax.adam(1e-3), mesh)
+    state = init_state(jax.random.key(0))
+    p_shard = state["params"]["blocks"]["w_up"].sharding
+    mu = state["opt_state"][0].mu["blocks"]["w_up"]
+    assert mu.sharding.is_equivalent_to(p_shard, mu.ndim)
