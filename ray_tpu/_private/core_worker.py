@@ -46,6 +46,13 @@ from ray_tpu._private.protocol import (
 )
 from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcClient, RpcServer
 
+
+def _pg_id_of(pg):
+    """Accept a PlacementGroup handle, a PlacementGroupID, or None."""
+    if pg is None:
+        return None
+    return getattr(pg, "id", pg)
+
 logger = logging.getLogger("ray_tpu.worker")
 
 
@@ -111,11 +118,14 @@ class CoreWorker:
         self.job_id = job_id
         self.objects: dict[ObjectID, _ObjectState] = {}
         self.tasks: dict[TaskID, _PendingTask] = {}
+        self._pg_rr: dict = {}  # placement group -> round-robin counter
         self.actor_submitters: dict[ActorID, _ActorSubmitter] = {}
         self.borrowed: dict[ObjectID, str] = {}  # borrowed ref -> owner addr
         self._put_index = 0
         self._obj_lock = threading.RLock()
         self.current_task_id = TaskID.of()    # driver context task
+        self.current_task_spec = None
+        self.current_actor_pg = None          # PG the actor was created in
         self.address = ""
         self._shutdown = False
         # Execution side (worker mode)
@@ -506,6 +516,8 @@ class CoreWorker:
             owner_address=self.address,
             scheduling_strategy=opts.get("scheduling_strategy") or "DEFAULT",
             node_affinity=opts.get("_node_id"),
+            placement_group=_pg_id_of(opts.get("placement_group")),
+            bundle_index=opts.get("placement_group_bundle_index", -1),
         )
         self.tasks[task_id] = _PendingTask(
             spec=spec, retries_left=spec.max_retries, future=None, lineage=True)
@@ -566,14 +578,20 @@ class CoreWorker:
             return
 
     async def _submit_once(self, spec: TaskSpec, exclude):
-        # 1. pick node (GCS resource view; spillback = exclude + repick)
-        pick = await self.gcs.call("Gcs", "pick_node", {
-            "resources": spec.resources.to_dict(),
-            "strategy": spec.scheduling_strategy,
-            "exclude": exclude,
-            "node_affinity": spec.node_affinity,
-        })
-        node = pick["node"]
+        # 1. pick node.  Placement-group tasks go straight to the bundle's
+        # node (the PG already reserved the resources there); everything
+        # else asks the GCS resource view (spillback = exclude + repick).
+        bundle = None
+        if spec.placement_group is not None:
+            node, bundle = await self._resolve_bundle(spec)
+        else:
+            pick = await self.gcs.call("Gcs", "pick_node", {
+                "resources": spec.resources.to_dict(),
+                "strategy": spec.scheduling_strategy,
+                "exclude": exclude,
+                "node_affinity": spec.node_affinity,
+            })
+            node = pick["node"]
         if node is None:
             if exclude:
                 raise _RetryableSubmitError("all feasible nodes excluded",
@@ -586,7 +604,7 @@ class CoreWorker:
             lease = await self.pool.get(node.address).call(
                 "NodeManager", "LeaseWorker",
                 {"resources": spec.resources.to_dict(),
-                 "job_id": self._job_int()}, timeout=60)
+                 "job_id": self._job_int(), "bundle": bundle}, timeout=60)
         except Exception as e:
             raise _RetryableSubmitError(f"lease rpc failed: {e}", node.node_id)
         if not lease.get("granted"):
@@ -611,6 +629,55 @@ class CoreWorker:
                     {"lease_id": lease["lease_id"]}, timeout=5)
             except Exception:
                 pass
+
+    async def _resolve_bundle(self, spec: TaskSpec):
+        """Map (placement_group, bundle_index) to the bundle's node + lease
+        bundle key, waiting for the PG to finish scheduling."""
+        reply = await self.gcs.call(
+            "Gcs", "get_placement_group",
+            {"pg_id": spec.placement_group, "wait_s": 30})
+        info = reply.get("info")
+        if info is None or info.state == "REMOVED":
+            raise ValueError(
+                f"placement group {spec.placement_group.hex()[:8]} is "
+                f"{'missing' if info is None else 'removed'}")
+        if info.state != "CREATED":
+            raise _RetryableSubmitError("placement group not ready",
+                                        None, busy=True)
+        demand = spec.resources.to_dict()
+
+        def bundle_fits(b: dict) -> bool:
+            return all(b.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items() if v > 0)
+
+        idx = spec.bundle_index
+        if idx < 0:
+            # Any bundle whose RESERVATION can fit the demand; rotate for
+            # balance.  No bundle large enough = permanent infeasibility.
+            feasible = [i for i, b in enumerate(info.bundles)
+                        if bundle_fits(b)]
+            if not feasible:
+                raise ValueError(
+                    f"task {spec.name} demands {demand}, which exceeds "
+                    f"every bundle of placement group "
+                    f"{spec.placement_group.hex()[:8]}")
+            rr = self._pg_rr.get(spec.placement_group, 0)
+            idx = feasible[rr % len(feasible)]
+            self._pg_rr[spec.placement_group] = rr + 1
+        elif idx >= len(info.bundles):
+            raise ValueError(f"bundle index {idx} out of range "
+                             f"({len(info.bundles)} bundles)")
+        elif not bundle_fits(info.bundles[idx]):
+            raise ValueError(
+                f"task {spec.name} demands {demand}, which exceeds bundle "
+                f"{idx} ({info.bundles[idx]}) of placement group "
+                f"{spec.placement_group.hex()[:8]}")
+        nodes = await self.gcs.call("Gcs", "get_nodes", {})
+        node = next((n for n in nodes["nodes"]
+                     if n.node_id == info.bundle_nodes[idx]), None)
+        if node is None or not node.alive:
+            raise _RetryableSubmitError("bundle node dead", None, busy=True)
+        return node, (spec.placement_group.hex(), idx)
 
     def _complete_task_reply(self, spec: TaskSpec, reply):
         returns = reply.get("returns", [])
@@ -704,6 +771,8 @@ class CoreWorker:
             owner_address=self.address,
             actor_id=actor_id,
             actor_creation=True,
+            placement_group=_pg_id_of(opts.get("placement_group")),
+            bundle_index=opts.get("placement_group_bundle_index", -1),
         )
         info = ActorInfo(
             actor_id=actor_id,
@@ -722,6 +791,43 @@ class CoreWorker:
         if reply.get("existing") is not None:
             return reply["existing"].actor_id
         return actor_id
+
+    # ------------------------------------------------------------------
+    # Placement groups (client side)
+    # ------------------------------------------------------------------
+
+    def create_placement_group(self, bundles, strategy="PACK", name="",
+                               lifetime=None):
+        from ray_tpu._private.ids import PlacementGroupID
+        from ray_tpu._private.protocol import PlacementGroupInfo
+        pg_id = PlacementGroupID.from_random()
+        info = PlacementGroupInfo(
+            pg_id=pg_id, bundles=list(bundles), strategy=strategy, name=name,
+            creator_job=self._job_int(),
+            lifetime_detached=(lifetime == "detached"))
+        self.io.run(self.gcs.call("Gcs", "create_placement_group",
+                                  {"info": info}))
+        return pg_id
+
+    def wait_placement_group_ready(self, pg_id, timeout: float | None):
+        deadline = None if timeout is None else timeout
+        reply = self.io.run(self.gcs.call(
+            "Gcs", "get_placement_group",
+            {"pg_id": pg_id, "wait_s": 3600 if deadline is None else deadline}))
+        info = reply.get("info")
+        return info is not None and info.state == "CREATED"
+
+    def get_placement_group_info(self, pg_id):
+        return self.io.run(self.gcs.call(
+            "Gcs", "get_placement_group", {"pg_id": pg_id}))["info"]
+
+    def remove_placement_group(self, pg_id):
+        self.io.run(self.gcs.call("Gcs", "remove_placement_group",
+                                  {"pg_id": pg_id}))
+
+    def list_placement_groups(self):
+        return self.io.run(self.gcs.call(
+            "Gcs", "list_placement_groups", {}))["placement_groups"]
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
                           kwargs, opts) -> list[ObjectRef]:
@@ -920,8 +1026,10 @@ class CoreWorker:
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
             self.current_task_id = spec.task_id
+            self.current_task_spec = spec
             if spec.actor_creation:
                 cls = self.io.run(self.fn_manager.fetch(spec.fn_key))
+                self.current_actor_pg = spec.placement_group
                 self.actor_instance = cls(*args, **kwargs)
                 return {"returns": [], "error": None}
             if spec.actor_id is not None:
@@ -941,6 +1049,10 @@ class CoreWorker:
             else:
                 err = TaskError(spec.name, tb, None)
             return {"returns": [], "error": err}
+        finally:
+            # Don't leak this task's context (e.g. its placement group) to
+            # whatever runs on this reused worker next.
+            self.current_task_spec = None
 
     def _resolve_arg(self, arg):
         if isinstance(arg, ValueArg):
@@ -982,6 +1094,16 @@ class CoreWorker:
     def shutdown(self):
         self._shutdown = True
         object_ref_mod._install_hooks(None)
+        if self.mode == "driver":
+            # Job-scoped cleanup: non-detached placement groups (and their
+            # reserved bundles) die with the driver (reference: GCS job
+            # manager cleanup on driver exit).
+            try:
+                self.io.run(self.gcs.call(
+                    "Gcs", "cleanup_job", {"job_id": self._job_int()},
+                    timeout=10))
+            except Exception:
+                pass
         try:
             self.io.run(self.server.stop())
             self.io.run(self.pool.close_all())

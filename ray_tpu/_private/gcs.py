@@ -20,8 +20,8 @@ import logging
 import os
 import time
 
-from ray_tpu._private.ids import ActorID, NodeID
-from ray_tpu._private.protocol import ActorInfo, NodeInfo
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+from ray_tpu._private.protocol import ActorInfo, NodeInfo, PlacementGroupInfo
 from ray_tpu._private.rpc import ClientPool, RpcServer
 from ray_tpu._private import scheduler as sched
 
@@ -66,7 +66,7 @@ class GcsServer:
         self.node_heartbeat: dict[NodeID, float] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[tuple[str, str], ActorID] = {}
-        self.placement_groups = {}  # filled by PG manager (milestone: PGs)
+        self.placement_groups: dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.pool = ClientPool()
         self.server = RpcServer(host)
         self.next_job = 0
@@ -113,6 +113,8 @@ class GcsServer:
         for actor in list(self.actors.values()):
             if actor.node_id == nid and actor.state in ("ALIVE", "PENDING"):
                 await self._on_actor_interrupted(actor, f"node died: {reason}")
+        # Re-place bundles that lived there.
+        self._reschedule_pgs_for_dead_node(nid)
 
     async def _health_loop(self):
         while not self._shutdown.is_set():
@@ -162,12 +164,47 @@ class GcsServer:
         # but reserve only the declared demand (1-for-scheduling /
         # 0-for-running, as in the reference).
         pick_demand = demand or {"CPU": 1.0}
+        spec = info.creation_spec
+        pg_id = spec.placement_group if spec is not None else None
         tried: set[NodeID] = set()
-        for _ in range(100):
+        attempt = 0
+        # PG actors pend until the PG is removed (reference: PG-scheduled
+        # work queues on the bundle indefinitely); non-PG actors give up
+        # after 100 placement attempts.
+        while pg_id is not None or attempt < 100:
+            attempt += 1
             if info.state == "DEAD":
                 return
-            node = sched.pick_node(self._alive_nodes(), pick_demand,
-                                   strategy="DEFAULT", exclude=tried)
+            bundle = None
+            if pg_id is not None:
+                pg = self.placement_groups.get(pg_id)
+                if pg is None or pg.state == "REMOVED":
+                    info.state = "DEAD"
+                    info.death_cause = "placement group unavailable"
+                    info.version += 1
+                    return
+                if pg.state != "CREATED":
+                    await asyncio.sleep(0.1)
+                    continue
+                idx = spec.bundle_index
+                if idx >= len(pg.bundles):
+                    info.state = "DEAD"
+                    info.death_cause = (f"bundle index {idx} out of range "
+                                        f"({len(pg.bundles)} bundles)")
+                    info.version += 1
+                    return
+                if idx < 0:
+                    # Rotate across bundles so concurrent actors spread out
+                    # and a full bundle doesn't starve the rest.
+                    idx = (attempt - 1 + info.num_restarts) % len(pg.bundles)
+                node = self.nodes.get(pg.bundle_nodes[idx])
+                if node is None or not node.alive:
+                    await asyncio.sleep(0.2)
+                    continue
+                bundle = (pg_id.hex(), idx)
+            else:
+                node = sched.pick_node(self._alive_nodes(), pick_demand,
+                                       strategy="DEFAULT", exclude=tried)
             if node is None:
                 await asyncio.sleep(0.2)  # wait for capacity / new nodes
                 tried.clear()
@@ -179,14 +216,18 @@ class GcsServer:
                 lease = await self.pool.get(node.address).call(
                     "NodeManager", "LeaseWorkerForActor",
                     {"actor_id": info.actor_id, "resources": demand,
-                     "job_id": job_int},
+                     "job_id": job_int, "bundle": bundle},
                     timeout=30)
             except Exception as e:
                 logger.info("lease on %s failed: %s", node.address, e)
                 tried.add(node.node_id)
+                if pg_id is not None:  # fixed target: back off, don't spin
+                    await asyncio.sleep(0.2)
                 continue
             if not lease.get("granted"):
                 tried.add(node.node_id)
+                if pg_id is not None:
+                    await asyncio.sleep(0.2)
                 continue
             worker_addr = lease["worker_address"]
             try:
@@ -301,6 +342,232 @@ class GcsServer:
             except Exception:
                 pass
         return {"ok": True}
+
+    # ---------------- placement-group manager ----------------
+    # Reference: gcs_placement_group_manager.h (lifecycle) +
+    # gcs_placement_group_scheduler.h (bundle placement + 2PC against the
+    # per-node daemons).  Strategies: placement_group.h PACK/SPREAD/
+    # STRICT_PACK/STRICT_SPREAD.
+
+    async def create_placement_group(self, req):
+        info: PlacementGroupInfo = req["info"]
+        if not info.bundle_nodes:
+            info.bundle_nodes = [None] * len(info.bundles)
+            info.bundle_addresses = [""] * len(info.bundles)
+        self.placement_groups[info.pg_id] = info
+        asyncio.ensure_future(self._schedule_pg(info))
+        return {"ok": True}
+
+    def _plan_bundles(self, info: PlacementGroupInfo):
+        """Choose a node for every unplaced bundle against a scratch copy of
+        the cluster's available resources.  Returns {index: NodeInfo} or
+        None when currently infeasible."""
+        nodes = self._alive_nodes()
+        scratch = {n.node_id: dict(n.resources_available) for n in nodes}
+        by_id = {n.node_id: n for n in nodes}
+        used_nodes = {nid for nid in info.bundle_nodes if nid is not None}
+        pending = [i for i, nid in enumerate(info.bundle_nodes) if nid is None]
+
+        def fits(nid, demand):
+            avail = scratch[nid]
+            return all(avail.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items() if v > 0)
+
+        def take(nid, demand):
+            for k, v in demand.items():
+                if v > 0:
+                    scratch[nid][k] = scratch[nid].get(k, 0.0) - v
+
+        plan = {}
+        if info.strategy == "STRICT_PACK":
+            # All bundles on ONE node (for TPU: one bundle group = one host;
+            # a slice-atomic unit).
+            anchor = next(iter(used_nodes), None)
+            candidates = ([by_id[anchor]] if anchor in by_id else nodes)
+            for node in candidates:
+                trial = dict(scratch[node.node_id])
+                ok = True
+                for i in pending:
+                    d = info.bundles[i]
+                    if all(trial.get(k, 0.0) + 1e-9 >= v
+                           for k, v in d.items() if v > 0):
+                        for k, v in d.items():
+                            if v > 0:
+                                trial[k] = trial.get(k, 0.0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    for i in pending:
+                        plan[i] = node
+                    return plan
+            return None
+
+        prefer_spread = info.strategy in ("SPREAD", "STRICT_SPREAD")
+        for i in pending:
+            demand = info.bundles[i]
+            cands = [n for n in nodes if fits(n.node_id, demand)]
+            if prefer_spread:
+                fresh = [n for n in cands
+                         if n.node_id not in used_nodes
+                         and n.node_id not in {p.node_id for p in plan.values()}]
+                if fresh:
+                    cands = fresh
+                elif info.strategy == "STRICT_SPREAD":
+                    return None
+                # Spread: least-utilized first.
+                cands.sort(key=lambda n: -sum(scratch[n.node_id].values()))
+            else:
+                # PACK: prefer nodes already carrying bundles of this PG.
+                cands.sort(key=lambda n: (
+                    n.node_id not in used_nodes
+                    and n.node_id not in {p.node_id for p in plan.values()},
+                    sum(scratch[n.node_id].values())))
+            if not cands:
+                return None
+            node = cands[0]
+            take(node.node_id, demand)
+            plan[i] = node
+        return plan
+
+    async def _schedule_pg(self, info: PlacementGroupInfo):
+        # Pends until satisfiable or removed (reference: PGs wait for
+        # capacity indefinitely — e.g. created ahead of autoscaling).
+        while info.state != "REMOVED":
+            plan = self._plan_bundles(info)
+            if not plan:
+                await asyncio.sleep(0.2)
+                continue
+            # Phase 1: prepare every bundle; roll back all on any failure.
+            prepared = []
+            ok = True
+            for i, node in plan.items():
+                try:
+                    r = await self.pool.get(node.address).call(
+                        "NodeManager", "PrepareBundle",
+                        {"pg_id": info.pg_id.hex(), "index": i,
+                         "resources": info.bundles[i]}, timeout=10)
+                except Exception:
+                    ok = False
+                    break
+                if not r.get("ok"):
+                    ok = False
+                    break
+                prepared.append((i, node))
+            if not ok:
+                for i, node in prepared:
+                    try:
+                        await self.pool.get(node.address).call(
+                            "NodeManager", "CancelBundle",
+                            {"pg_id": info.pg_id.hex(), "index": i},
+                            timeout=10)
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.2)
+                continue
+            # Phase 2: commit.
+            for i, node in plan.items():
+                try:
+                    await self.pool.get(node.address).call(
+                        "NodeManager", "CommitBundle",
+                        {"pg_id": info.pg_id.hex(), "index": i}, timeout=10)
+                except Exception:
+                    pass  # the aliveness re-check below handles node death
+                info.bundle_nodes[i] = node.node_id
+                info.bundle_addresses[i] = node.address
+            # A planned node may have died while prepare/commit RPCs were in
+            # flight — its death event fired before bundle_nodes was written,
+            # so _reschedule_pgs_for_dead_node saw nothing.  Re-check here.
+            lost = [i for i, nid in enumerate(info.bundle_nodes)
+                    if nid is not None and (
+                        self.nodes.get(nid) is None
+                        or not self.nodes[nid].alive)]
+            if lost:
+                for i in lost:
+                    info.bundle_nodes[i] = None
+                    info.bundle_addresses[i] = ""
+                await asyncio.sleep(0.2)
+                continue
+            info.state = "CREATED"
+            info.version += 1
+            self._cluster_version += 1
+            logger.info("placement group %s created (%d bundles)",
+                        info.pg_id.hex()[:8], len(info.bundles))
+            return
+
+    async def remove_placement_group(self, req):
+        info = self.placement_groups.get(req["pg_id"])
+        if info is None:
+            return {"ok": False}
+        info.state = "REMOVED"
+        info.version += 1
+        self._cluster_version += 1
+        nodes = {nid for nid in info.bundle_nodes if nid is not None}
+        for nid in nodes:
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                continue
+            try:
+                await self.pool.get(node.address).call(
+                    "NodeManager", "CancelBundle",
+                    {"pg_id": info.pg_id.hex()}, timeout=10)
+            except Exception:
+                pass
+        # Actors created inside the PG die with it (reference semantics).
+        for actor in list(self.actors.values()):
+            spec = actor.creation_spec
+            if spec is not None and spec.placement_group == info.pg_id \
+                    and actor.state != "DEAD":
+                await self.kill_actor({"actor_id": actor.actor_id,
+                                       "no_restart": True})
+        return {"ok": True}
+
+    async def cleanup_job(self, req):
+        """Driver exit: tear down the job's non-detached placement groups
+        (reference: GcsPlacementGroupManager::CleanPlacementGroupIfNeeded-
+        WhenJobDead) and its non-detached actors."""
+        job = req["job_id"]
+        removed = 0
+        for info in list(self.placement_groups.values()):
+            if info.creator_job == job and not info.lifetime_detached \
+                    and info.state != "REMOVED":
+                await self.remove_placement_group({"pg_id": info.pg_id})
+                removed += 1
+        for actor in list(self.actors.values()):
+            spec = actor.creation_spec
+            if spec is not None and int.from_bytes(
+                    spec.job_id.binary(), "little") == job \
+                    and not actor.lifetime_detached \
+                    and actor.state not in ("DEAD",):
+                await self.kill_actor({"actor_id": actor.actor_id,
+                                       "no_restart": True})
+        return {"ok": True, "removed_pgs": removed}
+
+    async def get_placement_group(self, req):
+        info = self.placement_groups.get(req["pg_id"])
+        deadline = time.monotonic() + req.get("wait_s", 0)
+        while info is not None and info.state in ("PENDING", "RESCHEDULING") \
+                and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        return {"info": info}
+
+    async def list_placement_groups(self, req):
+        return {"placement_groups": list(self.placement_groups.values())}
+
+    def _reschedule_pgs_for_dead_node(self, nid: NodeID):
+        for info in self.placement_groups.values():
+            if info.state not in ("CREATED", "RESCHEDULING", "PENDING"):
+                continue
+            lost = [i for i, b in enumerate(info.bundle_nodes) if b == nid]
+            if not lost:
+                continue
+            for i in lost:
+                info.bundle_nodes[i] = None
+                info.bundle_addresses[i] = ""
+            if info.state == "CREATED":
+                info.state = "RESCHEDULING"
+                info.version += 1
+                asyncio.ensure_future(self._schedule_pg(info))
 
     # ---------------- scheduling service ----------------
 

@@ -54,6 +54,7 @@ class WorkerHandle:
         self.reserved = False    # pinned for the lease that spawned it
         self.lease_id: str | None = None
         self.lease_resources: dict = {}
+        self.lease_bundle: tuple | None = None  # (pg_hex, index) if in a PG
         self.actor_id = None
         self.idle_since = time.monotonic()
         self.ready = asyncio.Event()
@@ -76,6 +77,12 @@ class NodeDaemon:
         self.store = ObjectStore.create(self.store_path, store_capacity)
         self.resources_total = dict(resources or detect_resources())
         self.resources_available = dict(self.resources_total)
+        # Placement-group bundles reserved on this node:
+        # (pg_hex, index) -> {"reserved": demand, "available": remaining,
+        #                     "committed": bool}
+        # (reference: raylet PlacementGroupResourceManager 2PC,
+        #  placement_group_resource_manager.h:46)
+        self.bundles: dict[tuple, dict] = {}
         self.workers: dict[int, WorkerHandle] = {}  # pid -> handle
         self._lease_seq = 0
         self.server = RpcServer(host)
@@ -192,23 +199,65 @@ class NodeDaemon:
         except asyncio.TimeoutError:
             pass
 
+    def _bundle_reserve(self, bundle_key: tuple, demand: dict) -> bool:
+        """Charge a lease against a committed bundle's remaining capacity."""
+        b = self.bundles.get(bundle_key)
+        if b is None or not b["committed"]:
+            return False
+        avail = b["available"]
+        for k, v in demand.items():
+            if v > 0 and avail.get(k, 0.0) + 1e-9 < v:
+                return False
+        for k, v in demand.items():
+            if v > 0:
+                avail[k] = avail.get(k, 0.0) - v
+        return True
+
+    def _bundle_unreserve(self, bundle_key: tuple, demand: dict):
+        b = self.bundles.get(bundle_key)
+        if b is None:  # PG removed while the lease was out; nothing to refund
+            return
+        for k, v in demand.items():
+            if v > 0:
+                b["available"][k] = min(
+                    b["available"].get(k, 0.0) + v, b["reserved"].get(k, v))
+        self._notify_capacity()
+
+    def _release_lease(self, handle: "WorkerHandle"):
+        if handle.lease_bundle is not None:
+            self._bundle_unreserve(handle.lease_bundle,
+                                   handle.lease_resources)
+        else:
+            self._unreserve(handle.lease_resources)
+        handle.lease_resources = {}
+        handle.lease_bundle = None
+
     async def lease_worker(self, req):
         """Lease a worker for normal task execution; queues while the node is
         saturated (reference: RequestWorkerLease node_manager.proto:363 +
-        LocalTaskManager dispatch queue)."""
+        LocalTaskManager dispatch queue).  With req["bundle"]=(pg_hex, idx)
+        the demand is charged against that placement-group bundle."""
         demand = req.get("resources", {})
+        bundle = tuple(req["bundle"]) if req.get("bundle") else None
         job_id = req.get("job_id", 0)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + req.get("queue_timeout", 10.0)
         while True:
-            if self._reserve(demand):
+            reserved = (self._bundle_reserve(bundle, demand) if bundle
+                        else self._reserve(demand))
+            if reserved:
                 handle = await self._get_worker(job_id)
                 if handle is not None:
                     break
-                self._unreserve(demand)
+                if bundle:
+                    self._bundle_unreserve(bundle, demand)
+                else:
+                    self._unreserve(demand)
                 if not any(w.state == "idle" or w.proc.poll() is None
                            for w in self.workers.values()):
                     return {"granted": False, "reason": "no_worker"}
+            elif bundle and bundle not in self.bundles:
+                return {"granted": False, "reason": "no_bundle"}
             remaining = deadline - loop.time()
             if remaining <= 0:
                 return {"granted": False, "reason": "busy"}
@@ -219,16 +268,16 @@ class NodeDaemon:
         handle.state = "leased"
         handle.lease_id = lease_id
         handle.lease_resources = demand
+        handle.lease_bundle = bundle
         return {"granted": True, "worker_address": handle.address,
                 "lease_id": lease_id, "node_id": self.node_id}
 
     async def return_worker(self, req):
         for handle in self.workers.values():
             if handle.lease_id == req["lease_id"]:
-                self._unreserve(handle.lease_resources)
+                self._release_lease(handle)
                 logger.info("return lease %s pid=%d", req["lease_id"], handle.proc.pid)
                 handle.lease_id = None
-                handle.lease_resources = {}
                 if req.get("kill") or handle.proc.poll() is not None:
                     self._kill_worker(handle)
                 else:
@@ -241,17 +290,66 @@ class NodeDaemon:
         """Dedicated worker for an actor (reference: GcsActorScheduler leases
         via the same raylet path, gcs_actor_scheduler.h:111)."""
         demand = req.get("resources", {})
-        if not self._reserve(demand):
+        bundle = tuple(req["bundle"]) if req.get("bundle") else None
+        if bundle:
+            if not self._bundle_reserve(bundle, demand):
+                return {"granted": False, "reason": "resources"}
+        elif not self._reserve(demand):
             return {"granted": False, "reason": "resources"}
         handle = await self._get_worker(req.get("job_id", 0))
         if handle is None:
-            self._unreserve(demand)
+            if bundle:
+                self._bundle_unreserve(bundle, demand)
+            else:
+                self._unreserve(demand)
             return {"granted": False, "reason": "no_worker"}
         handle.state = "actor"
         handle.actor_id = req["actor_id"]
         handle.lease_resources = demand
+        handle.lease_bundle = bundle
         return {"granted": True, "worker_address": handle.address,
                 "node_id": self.node_id}
+
+    # ---------------- placement-group bundles (2PC) ----------------
+    # Reference: node_manager.proto:378 PrepareBundleResources /
+    # :382 CommitBundleResources / CancelResourceReserve + raylet
+    # placement_group_resource_manager.h:46.
+
+    async def prepare_bundle(self, req):
+        key = (req["pg_id"], req["index"])
+        demand = req["resources"]
+        if key in self.bundles:
+            return {"ok": True}  # idempotent re-prepare
+        if not self._reserve(demand):
+            return {"ok": False, "reason": "resources"}
+        self.bundles[key] = {"reserved": dict(demand),
+                             "available": dict(demand), "committed": False}
+        return {"ok": True}
+
+    async def commit_bundle(self, req):
+        b = self.bundles.get((req["pg_id"], req["index"]))
+        if b is None:
+            return {"ok": False}
+        b["committed"] = True
+        return {"ok": True}
+
+    async def cancel_bundle(self, req):
+        """Release one bundle (or all bundles of a PG when index is None).
+        Workers leased against the bundle are killed — their resources were
+        the bundle's (reference: raylet kills PG workers on removal)."""
+        pg_id = req["pg_id"]
+        index = req.get("index")
+        keys = [k for k in self.bundles
+                if k[0] == pg_id and (index is None or k[1] == index)]
+        for key in keys:
+            for handle in list(self.workers.values()):
+                if handle.lease_bundle == key:
+                    handle.lease_resources = {}
+                    handle.lease_bundle = None
+                    self._kill_worker(handle)
+            b = self.bundles.pop(key)
+            self._unreserve(b["reserved"])
+        return {"ok": True, "released": len(keys)}
 
     # ---------------- object transfer ----------------
 
@@ -334,7 +432,7 @@ class NodeDaemon:
             for handle in list(self.workers.values()):
                 if handle.proc.poll() is not None:
                     self.workers.pop(handle.proc.pid, None)
-                    self._unreserve(handle.lease_resources)
+                    self._release_lease(handle)
                     if handle.state == "actor" and handle.actor_id is not None:
                         try:
                             await self.gcs.call(
@@ -356,6 +454,12 @@ class NodeDaemon:
         self.server.register("NodeManager", "ReturnWorker", self.return_worker)
         self.server.register("NodeManager", "LeaseWorkerForActor",
                              self.lease_worker_for_actor)
+        self.server.register("NodeManager", "PrepareBundle",
+                             self.prepare_bundle)
+        self.server.register("NodeManager", "CommitBundle",
+                             self.commit_bundle)
+        self.server.register("NodeManager", "CancelBundle",
+                             self.cancel_bundle)
         self.server.register("NodeManager", "PullObject", self.pull_object)
         self.server.register("NodeManager", "PushObject", self.push_object)
         self.server.register("NodeManager", "FreeObject", self.free_object)

@@ -115,6 +115,28 @@ class ActorInfo:
 
 
 @dataclass
+class PlacementGroupInfo:
+    """GCS placement-group table record.
+
+    Reference parity: src/ray/gcs/gcs_server/gcs_placement_group_manager.h
+    (lifecycle) + gcs_placement_group_scheduler.h (bundle 2PC against
+    raylets, node_manager.proto:378 Prepare/CommitBundleResources).
+    """
+
+    pg_id: PlacementGroupID
+    bundles: list                 # list[dict] resource demand per bundle
+    strategy: str = "PACK"        # PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
+    name: str = ""
+    state: str = "PENDING"        # PENDING/CREATED/RESCHEDULING/REMOVED
+    # Per-bundle placement, filled when scheduled (None = unplaced).
+    bundle_nodes: list = field(default_factory=list)      # list[NodeID|None]
+    bundle_addresses: list = field(default_factory=list)  # list[str]
+    creator_job: int = 0
+    lifetime_detached: bool = False
+    version: int = 0
+
+
+@dataclass
 class NodeInfo:
     node_id: NodeID
     address: str            # hostd RPC address

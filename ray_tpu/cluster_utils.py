@@ -1,0 +1,102 @@
+"""In-process multi-node cluster for tests and local experiments.
+
+Reference parity: python/ray/cluster_utils.py:99 `class Cluster`
+(add_node:165) — N full nodes (each its own hostd daemon + shm store +
+worker pool) on one machine sharing one GCS; the workhorse for distributed
+tests (failover, spillback, placement groups, reconstruction).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ray_tpu._private import node as node_mod
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, connect: bool = False,
+                 head_node_args: Optional[dict] = None):
+        self.session_dir = node_mod.new_session_dir()
+        self.group = node_mod.ProcessGroup()
+        self.gcs_address = node_mod.start_gcs(self.session_dir, self.group)
+        self.nodes: list[dict] = []
+        self._connected = False
+        if initialize_head:
+            self.add_node(head=True, **(head_node_args or {}))
+        if connect:
+            self.connect()
+
+    @property
+    def address(self) -> str:
+        return self.gcs_address
+
+    def add_node(self, *, num_cpus: float = 2, num_tpus: float | None = None,
+                 resources: Optional[dict] = None,
+                 object_store_memory: int = 64 << 20,
+                 head: bool = False) -> dict:
+        node = node_mod.start_hostd(
+            self.gcs_address, self.session_dir, self.group,
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            store_capacity=object_store_memory, head=head)
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: dict, allow_graceful: bool = False):
+        """Kill a node's daemon (and with it, its workers).  Hard kill by
+        default — this is the chaos path (reference: NodeKillerActor,
+        test_utils.py:1337)."""
+        proc = node["proc"]
+        if allow_graceful:
+            proc.terminate()
+        else:
+            proc.kill()
+        proc.wait(timeout=10)
+        if node in self.nodes:
+            self.nodes.remove(node)
+        if proc in self.group.procs:
+            self.group.procs.remove(proc)
+
+    def wait_for_nodes(self, timeout: float = 30):
+        """Block until every added node is alive in the GCS view."""
+        import asyncio
+
+        from ray_tpu._private.rpc import RpcClient
+
+        async def poll():
+            gcs = RpcClient(self.gcs_address)
+            try:
+                deadline = time.monotonic() + timeout
+                want = {n["node_id"] for n in self.nodes}
+                while time.monotonic() < deadline:
+                    reply = await gcs.call("Gcs", "get_nodes", {}, timeout=5)
+                    alive = {n.node_id.hex() for n in reply["nodes"]
+                             if n.alive}
+                    if want <= alive:
+                        return
+                    await asyncio.sleep(0.1)
+                raise TimeoutError(
+                    f"nodes not alive after {timeout}s: {want - alive}")
+            finally:
+                await gcs.close()
+
+        asyncio.run(poll())
+
+    def connect(self):
+        import ray_tpu
+        ray_tpu.init(address=self.gcs_address)
+        self._connected = True
+
+    def shutdown(self):
+        import ray_tpu
+        if self._connected and ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+            self._connected = False
+        self.group.reap()
+        self.nodes.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

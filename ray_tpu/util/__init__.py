@@ -1,0 +1,9 @@
+"""ray_tpu.util — utilities over the core primitives (reference: ray/util/)."""
+
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup,
+    get_current_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)

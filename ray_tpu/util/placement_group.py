@@ -1,0 +1,102 @@
+"""Placement groups: gang-reserve resource bundles across nodes.
+
+Reference parity: python/ray/util/placement_group.py (placement_group(),
+PlacementGroup.ready()/wait(), remove_placement_group,
+placement_group_table) over the GCS manager's 2PC bundle protocol
+(gcs_placement_group_manager.h, node_manager.proto:378-382).
+
+TPU idiom: a STRICT_PACK group is one TPU host; a SPREAD group with one
+bundle per host of a slice gang-reserves the whole slice for an SPMD job.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu import api
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    """Handle to a placement group (reference: util/placement_group.py)."""
+
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: Optional[List[dict]] = None):
+        self.id = pg_id
+        self._bundles = bundles
+
+    def ready(self) -> bool:
+        """Block until scheduled; True when CREATED.  (The reference returns
+        an ObjectRef; here readiness is a control-plane wait — objects never
+        get involved.)"""
+        return api._get_worker().wait_placement_group_ready(self.id, None)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return api._get_worker().wait_placement_group_ready(
+            self.id, timeout_seconds)
+
+    @property
+    def bundle_specs(self) -> List[dict]:
+        if self._bundles is None:
+            info = api._get_worker().get_placement_group_info(self.id)
+            self._bundles = list(info.bundles) if info else []
+        return self._bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self._bundles))
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id.hex()[:12]})"
+
+
+def placement_group(bundles: List[dict], strategy: str = "PACK",
+                    name: str = "", lifetime: Optional[str] = None
+                    ) -> PlacementGroup:
+    """Gang-reserve `bundles` (list of resource dicts) across the cluster."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or not all(isinstance(b, dict) and b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty "
+                         "resource dicts")
+    for b in bundles:
+        if any(v < 0 for v in b.values()):
+            raise ValueError(f"negative resource in bundle {b}")
+    pg_id = api._get_worker().create_placement_group(
+        [dict(b) for b in bundles], strategy, name, lifetime)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles])
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    api._get_worker().remove_placement_group(pg.id)
+
+
+def placement_group_table() -> dict:
+    out = {}
+    for info in api._get_worker().list_placement_groups():
+        out[info.pg_id.hex()] = {
+            "name": info.name,
+            "strategy": info.strategy,
+            "state": info.state,
+            "bundles": {i: b for i, b in enumerate(info.bundles)},
+            "bundle_nodes": [n.hex() if n else None
+                             for n in info.bundle_nodes],
+        }
+    return out
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    """The PG of the currently executing task/actor, if any."""
+    worker = api._get_worker()
+    spec = getattr(worker, "current_task_spec", None)
+    if spec is not None and spec.placement_group is not None:
+        return PlacementGroup(spec.placement_group)
+    actor_pg = getattr(worker, "current_actor_pg", None)
+    if actor_pg is not None:
+        return PlacementGroup(actor_pg)
+    return None
