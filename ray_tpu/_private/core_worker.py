@@ -53,6 +53,13 @@ def _pg_id_of(pg):
         return None
     return getattr(pg, "id", pg)
 
+
+@dataclass
+class _BundleNode:
+    """Lease target resolved from a placement-group bundle record."""
+    address: str
+    node_id: object
+
 logger = logging.getLogger("ray_tpu.worker")
 
 
@@ -672,11 +679,13 @@ class CoreWorker:
                 f"task {spec.name} demands {demand}, which exceeds bundle "
                 f"{idx} ({info.bundles[idx]}) of placement group "
                 f"{spec.placement_group.hex()[:8]}")
-        nodes = await self.gcs.call("Gcs", "get_nodes", {})
-        node = next((n for n in nodes["nodes"]
-                     if n.node_id == info.bundle_nodes[idx]), None)
-        if node is None or not node.alive:
-            raise _RetryableSubmitError("bundle node dead", None, busy=True)
+        # The PG record already carries the bundle's node and address — no
+        # extra get_nodes round-trip; a dead node surfaces as a failed
+        # lease RPC, which is retryable anyway.
+        node_id, address = info.bundle_nodes[idx], info.bundle_addresses[idx]
+        if node_id is None or not address:
+            raise _RetryableSubmitError("bundle unplaced", None, busy=True)
+        node = _BundleNode(address=address, node_id=node_id)
         return node, (spec.placement_group.hex(), idx)
 
     def _complete_task_reply(self, spec: TaskSpec, reply):

@@ -193,10 +193,26 @@ class GcsServer:
                                         f"({len(pg.bundles)} bundles)")
                     info.version += 1
                     return
+
+                def fits(b: dict) -> bool:
+                    return all(b.get(k, 0.0) + 1e-9 >= v
+                               for k, v in demand.items() if v > 0)
+
+                candidates = [idx] if idx >= 0 else \
+                    [i for i in range(len(pg.bundles)) if fits(pg.bundles[i])]
+                if (idx >= 0 and not fits(pg.bundles[idx])) or not candidates:
+                    info.state = "DEAD"
+                    info.death_cause = (
+                        f"actor demands {demand}, which exceeds "
+                        f"{'bundle %d' % idx if idx >= 0 else 'every bundle'}"
+                        f" of its placement group")
+                    info.version += 1
+                    return
                 if idx < 0:
-                    # Rotate across bundles so concurrent actors spread out
-                    # and a full bundle doesn't starve the rest.
-                    idx = (attempt - 1 + info.num_restarts) % len(pg.bundles)
+                    # Rotate across feasible bundles so concurrent actors
+                    # spread out and a full bundle doesn't starve the rest.
+                    idx = candidates[(attempt - 1 + info.num_restarts)
+                                     % len(candidates)]
                 node = self.nodes.get(pg.bundle_nodes[idx])
                 if node is None or not node.alive:
                     await asyncio.sleep(0.2)
@@ -455,26 +471,36 @@ class GcsServer:
                     break
                 prepared.append((i, node))
             if not ok:
-                for i, node in prepared:
-                    try:
-                        await self.pool.get(node.address).call(
-                            "NodeManager", "CancelBundle",
-                            {"pg_id": info.pg_id.hex(), "index": i},
-                            timeout=10)
-                    except Exception:
-                        pass
+                # Roll back on EVERY planned node, not just confirmed
+                # prepares: a Prepare whose reply was lost still reserved
+                # server-side (CancelBundle on an unprepared key is a no-op).
+                await self._cancel_bundles_on(plan.items(), info)
                 await asyncio.sleep(0.2)
                 continue
-            # Phase 2: commit.
+            # Phase 2: commit.  A failed commit on a live node leaves the
+            # bundle unusable (leases check committed=True) — cancel it and
+            # re-place rather than shipping a wedged CREATED group.
+            failed = []
             for i, node in plan.items():
                 try:
                     await self.pool.get(node.address).call(
                         "NodeManager", "CommitBundle",
                         {"pg_id": info.pg_id.hex(), "index": i}, timeout=10)
                 except Exception:
-                    pass  # the aliveness re-check below handles node death
+                    failed.append((i, node))
+                    continue
                 info.bundle_nodes[i] = node.node_id
                 info.bundle_addresses[i] = node.address
+            if info.state == "REMOVED":
+                # Removed while we were preparing/committing: the removal
+                # saw empty bundle_nodes and had nothing to cancel — undo
+                # everything we just reserved.
+                await self._cancel_bundles_on(plan.items(), info)
+                return
+            if failed:
+                await self._cancel_bundles_on(failed, info)
+                await asyncio.sleep(0.2)
+                continue
             # A planned node may have died while prepare/commit RPCs were in
             # flight — its death event fired before bundle_nodes was written,
             # so _reschedule_pgs_for_dead_node saw nothing.  Re-check here.
@@ -494,6 +520,17 @@ class GcsServer:
             logger.info("placement group %s created (%d bundles)",
                         info.pg_id.hex()[:8], len(info.bundles))
             return
+
+    async def _cancel_bundles_on(self, pairs, info: PlacementGroupInfo):
+        for i, node in pairs:
+            try:
+                await self.pool.get(node.address).call(
+                    "NodeManager", "CancelBundle",
+                    {"pg_id": info.pg_id.hex(), "index": i}, timeout=10)
+            except Exception:
+                pass
+            info.bundle_nodes[i] = None
+            info.bundle_addresses[i] = ""
 
     async def remove_placement_group(self, req):
         info = self.placement_groups.get(req["pg_id"])

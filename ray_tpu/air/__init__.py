@@ -1,0 +1,13 @@
+"""ray_tpu.air — shared config/checkpoint/session types.
+
+Reference parity: python/ray/air/ (SURVEY.md §2.3 "Ray AIR glue").
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train import session  # noqa: F401

@@ -1,0 +1,74 @@
+"""Checkpoint: dict <-> directory interconvertible training state.
+
+Reference parity: python/ray/air/checkpoint.py:63 (Checkpoint with
+from_dict/to_dict/from_directory/to_directory/uri forms).  TPU idiom: the
+dict form holds host numpy pytrees (device arrays are fetched before
+checkpointing — orbax-style async device-to-host saving hooks in later).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Optional
+
+_DICT_FILE = "checkpoint.pkl"
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[dict] = None,
+                 directory: Optional[str] = None):
+        if (data is None) == (directory is None):
+            raise ValueError("exactly one of data/directory required")
+        self._data = data
+        self._dir = directory
+
+    # -------- constructors --------
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(directory=path)
+
+    # -------- accessors --------
+
+    def to_dict(self) -> dict:
+        if self._data is not None:
+            return dict(self._data)
+        with open(os.path.join(self._dir, _DICT_FILE), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = os.path.join(tempfile.gettempdir(), "ray_tpu_ckpt",
+                                uuid.uuid4().hex[:12])
+        os.makedirs(path, exist_ok=True)
+        if self._dir is not None:
+            if os.path.abspath(self._dir) != os.path.abspath(path):
+                shutil.copytree(self._dir, path, dirs_exist_ok=True)
+        else:
+            tmp = path + ".tmp"
+            os.makedirs(tmp, exist_ok=True)
+            with open(os.path.join(tmp, _DICT_FILE), "wb") as f:
+                pickle.dump(self._data, f)
+            for name in os.listdir(tmp):
+                os.replace(os.path.join(tmp, name), os.path.join(path, name))
+            os.rmdir(tmp)
+        return path
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir={self._dir}"
+        return f"Checkpoint({kind})"
+
+    def __reduce__(self):
+        # Ship as dict form so checkpoints survive crossing process
+        # boundaries even when the directory is node-local.
+        return (Checkpoint.from_dict, (self.to_dict(),))

@@ -1,0 +1,70 @@
+"""Shared run/scaling/failure/checkpoint configs.
+
+Reference parity: python/ray/air/config.py — ScalingConfig:80,
+FailureConfig:508, CheckpointConfig:567, RunConfig:695.  TPU twist:
+`ScalingConfig` thinks in TPU hosts and slice topologies, not GPU counts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers, and what each one holds.
+
+    One worker == one process == one jax host (which may drive several TPU
+    chips).  `use_tpu` reserves `tpus_per_worker` TPU resources per worker;
+    `topology` (e.g. "v5p-128") lets a pod provisioner gang-schedule whole
+    slices (a slice is atomic — reference GPUs scale per-device, TPU pods
+    don't).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 1.0
+    resources_per_worker: Optional[dict] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None
+
+    def worker_resources(self) -> dict:
+        if self.resources_per_worker is not None:
+            res = dict(self.resources_per_worker)
+        else:
+            res = {"CPU": 1.0}
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = self.tpus_per_worker
+        return res
+
+    def as_placement_group_bundles(self) -> list[dict]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """Reference: air/config.py:508.  max_failures=-1 -> retry forever."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: air/config.py:567."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    """Reference: air/config.py:695."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    verbose: int = 1

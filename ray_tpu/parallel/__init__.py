@@ -12,9 +12,11 @@ from ray_tpu.parallel.mesh import (  # noqa: F401
 )
 from ray_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,
+    global_batch,
     logical_to_spec,
     named_sharding,
     shard_batch,
+    shard_opt_state,
     tree_shardings,
     with_logical_constraint,
 )

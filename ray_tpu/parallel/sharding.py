@@ -122,13 +122,34 @@ def shard_opt_state(opt_state: Any, params: Any, param_shardings: Any,
     return place(opt_state)
 
 
+def _batch_logical(x) -> LogicalSpec:
+    if x.ndim >= 2:
+        return ("batch", "length") + (None,) * (x.ndim - 2)
+    return ("batch",) + (None,) * (x.ndim - 1)
+
+
 def shard_batch(mesh: Mesh, batch: Any,
                 rules: Optional[Mapping] = None) -> Any:
     """Device-put a host batch pytree with ("batch", "length") layout onto
-    the mesh, splitting over the data axes."""
+    the mesh, splitting over the data axes.  Single-controller form — every
+    process must hold the full global batch; use `global_batch` in
+    multi-controller (one-process-per-host) programs."""
     def put(x):
-        logical = ("batch",) + (None,) * (x.ndim - 1)
-        if x.ndim >= 2:
-            logical = ("batch", "length") + (None,) * (x.ndim - 2)
-        return jax.device_put(x, named_sharding(mesh, logical, rules))
+        return jax.device_put(x, named_sharding(mesh, _batch_logical(x),
+                                                rules))
     return jax.tree.map(put, batch)
+
+
+def global_batch(mesh: Mesh, local_batch: Any,
+                 rules: Optional[Mapping] = None) -> Any:
+    """Multi-controller batch assembly: each process contributes its LOCAL
+    shard (stacked on dim 0) of a global batch sharded over the data axes.
+    The global batch dim = local batch dim * process_count."""
+    nproc = jax.process_count()
+
+    def put(x):
+        sharding = named_sharding(mesh, _batch_logical(x), rules)
+        global_shape = (x.shape[0] * nproc,) + tuple(x.shape[1:])
+        return jax.make_array_from_process_local_data(sharding, x,
+                                                      global_shape)
+    return jax.tree.map(put, local_batch)

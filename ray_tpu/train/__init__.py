@@ -1,0 +1,35 @@
+"""ray_tpu.train — distributed training on TPU gangs.
+
+Reference parity: python/ray/train/ (SURVEY.md §2.3).  The execution
+skeleton matches (Trainer -> BackendExecutor -> WorkerGroup of actors under
+a placement group, session.report streaming); the collective fabric is
+jax.distributed + XLA collectives instead of torch.distributed/NCCL.
+"""
+
+from ray_tpu.train.backend import (  # noqa: F401
+    Backend,
+    BackendConfig,
+    TpuBackend,
+    TpuConfig,
+)
+from ray_tpu.train.backend_executor import (  # noqa: F401
+    BackendExecutor,
+    TrainingFailedError,
+)
+from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+)
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    get_local_rank,
+    get_local_world_size,
+    get_node_rank,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup  # noqa: F401

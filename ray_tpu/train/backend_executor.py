@@ -1,0 +1,106 @@
+"""BackendExecutor: owns the worker gang and drives the training lifecycle.
+
+Reference parity: python/ray/train/_internal/backend_executor.py —
+BackendExecutor:43 (start:94 creates PG + WorkerGroup, start_training:325,
+get_with_failure_handling:522, _restart:583 elastic restart).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger("ray_tpu.train")
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig,
+                 max_failures: int = 0):
+        self._backend_config = backend_config
+        self._backend: Backend = backend_config.backend_cls()()
+        self._scaling = scaling_config
+        self._max_failures = max_failures
+        self._num_failures = 0
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self):
+        self.worker_group = WorkerGroup(
+            self._scaling.num_workers,
+            self._scaling.worker_resources(),
+            self._scaling.placement_strategy)
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def start_training(self, train_fn: Callable[[], None],
+                       checkpoint: Optional[Checkpoint] = None):
+        wg = self.worker_group
+        self._backend.on_training_start(wg, self._backend_config)
+        local = wg.local_ranks()
+        node_ranks = wg.node_ranks()
+        refs = []
+        for rank, worker in enumerate(wg.workers):
+            ctx = TrainContext(
+                world_rank=rank,
+                world_size=len(wg),
+                local_rank=local[rank][0],
+                local_world_size=local[rank][1],
+                node_rank=node_ranks[rank])
+            refs.append(worker.actor.init_session.remote(
+                train_fn, ctx, checkpoint))
+        ray_tpu.get(refs, timeout=120)
+
+    def get_next_results(self, timeout: float = 600.0) -> Optional[List]:
+        """One report from EVERY worker, or None when all finished.
+        Raises on worker failure (the caller decides on restart)."""
+        wg = self.worker_group
+        refs = [w.actor.get_next.remote(timeout) for w in wg.workers]
+        results = ray_tpu.get(refs, timeout=timeout + 60)
+        dones = [r is None for r in results]
+        if all(dones):
+            return None
+        if any(dones):
+            raise TrainingFailedError(
+                "some workers finished while others are still reporting — "
+                "the train loop must be SPMD (same number of report() calls "
+                "on every worker)")
+        return results
+
+    def finish_training(self):
+        wg = self.worker_group
+        ray_tpu.get([w.actor.finish_session.remote() for w in wg.workers],
+                    timeout=120)
+
+    def can_restart(self) -> bool:
+        return (self._max_failures == -1
+                or self._num_failures < self._max_failures)
+
+    def restart(self):
+        """Elastic restart: tear the gang down, rebuild, re-rendezvous
+        (reference: backend_executor.py:583).  On TPU a lost host means the
+        slice re-forms as a whole — per-worker restart is not a thing."""
+        self._num_failures += 1
+        logger.warning("restarting worker group (failure %d/%s)",
+                       self._num_failures, self._max_failures)
+        self.shutdown()
+        self.start()
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group,
+                                          self._backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,141 @@
+"""Worker-side training session.
+
+Reference parity: python/ray/train/_internal/session.py — _TrainSession:63
+(user fn in a thread, result_queue(1)/error_queue :119-125, report:322,
+checkpoint:284) and python/ray/air/session.py (the public accessors).
+
+The user's train loop runs in a thread on the worker actor; `report()`
+blocks the loop on a depth-1 queue until the driver consumes the result —
+natural backpressure, exactly the reference's design.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_session: Optional["_TrainSession"] = None
+
+
+@dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    experiment_name: str = ""
+    trial_name: str = ""
+
+
+class _TrainSession:
+    def __init__(self, train_fn: Callable[[], Any], context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None):
+        self.context = context
+        self.loaded_checkpoint = checkpoint
+        self.result_queue: queue.Queue = queue.Queue(maxsize=1)
+        self.continue_event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.finished = False
+        self._stop = False
+
+        def run():
+            global _session
+            _session = self
+            try:
+                train_fn()
+            except StopIteration:
+                pass
+            except BaseException as e:  # noqa: BLE001
+                self.error = e
+            finally:
+                self.finished = True
+                # Wake a driver blocked in get_next().
+                try:
+                    self.result_queue.put(("__done__", None), timeout=0)
+                except queue.Full:
+                    pass
+
+        self.thread = threading.Thread(target=run, daemon=True)
+
+    def start(self):
+        self.thread.start()
+
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        if self._stop:
+            raise StopIteration  # unblocks and ends the user loop
+        self.result_queue.put((metrics, checkpoint))  # blocks when full
+        self.continue_event.wait()
+        self.continue_event.clear()
+        if self._stop:
+            raise StopIteration
+
+    def get_next(self, timeout: float = 600.0):
+        """Driver side (via actor RPC): next report, or None when done."""
+        if self.finished and self.result_queue.empty():
+            return None
+        item = self.result_queue.get(timeout=timeout)
+        if item == ("__done__", None):
+            if self.error is not None:
+                raise self.error
+            return None
+        self.continue_event.set()
+        return item
+
+    def finish(self, timeout: float = 60.0):
+        self.thread.join(timeout)
+        if self.error is not None:
+            raise self.error
+
+    def stop(self):
+        self._stop = True
+        self.continue_event.set()
+
+
+def get_session() -> "_TrainSession":
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — this API must be called inside a "
+            "train_loop_per_worker launched by a Trainer")
+    return _session
+
+
+# ---------------------------------------------------------------------------
+# Public session API (reference: ray.air.session / ray.train.*)
+# ---------------------------------------------------------------------------
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
+    get_session().report(dict(metrics), checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().loaded_checkpoint
+
+
+def get_context() -> TrainContext:
+    return get_session().context
+
+
+def get_world_rank() -> int:
+    return get_session().context.world_rank
+
+
+def get_world_size() -> int:
+    return get_session().context.world_size
+
+
+def get_local_rank() -> int:
+    return get_session().context.local_rank
+
+
+def get_local_world_size() -> int:
+    return get_session().context.local_world_size
+
+
+def get_node_rank() -> int:
+    return get_session().context.node_rank

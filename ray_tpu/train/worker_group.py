@@ -1,0 +1,140 @@
+"""WorkerGroup: a gang of training-worker actors under one placement group.
+
+Reference parity: python/ray/train/_internal/worker_group.py — WorkerGroup:92
+(execute/execute_async over a fleet of RayTrainWorker:17 actors).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    PlacementGroup, placement_group, remove_placement_group)
+
+
+@ray_tpu.remote
+class RayTrainWorker:
+    """One training worker process (reference: worker_group.py:17).  Holds
+    the per-worker _TrainSession; generic `run` executes arbitrary fns so
+    backends can do env setup / rendezvous on the worker."""
+
+    def run(self, fn, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def set_env(self, env: dict):
+        import os
+        os.environ.update({k: str(v) for k, v in env.items()})
+        return True
+
+    def init_session(self, train_fn, context, checkpoint=None):
+        from ray_tpu.train import session as session_mod
+        sess = session_mod._TrainSession(train_fn, context, checkpoint)
+        session_mod._session = sess
+        self._session = sess
+        sess.start()
+        return True
+
+    def get_next(self, timeout: float = 600.0):
+        return self._session.get_next(timeout)
+
+    def finish_session(self):
+        self._session.finish()
+        return True
+
+    def node_id(self):
+        import os
+        return os.environ.get("RAY_TPU_NODE_ID", "")
+
+    def pid(self):
+        import os
+        return os.getpid()
+
+
+@dataclass
+class Worker:
+    actor: Any
+    rank: int
+    node_id: str = ""
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: dict,
+                 placement_strategy: str = "PACK"):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self._pg: Optional[PlacementGroup] = placement_group(
+            [dict(resources_per_worker) for _ in range(num_workers)],
+            strategy=placement_strategy)
+        if not self._pg.wait(120):
+            remove_placement_group(self._pg)
+            raise RuntimeError(
+                f"could not reserve {num_workers} x {resources_per_worker} "
+                f"(strategy {placement_strategy}) within 120s")
+        res = dict(resources_per_worker)
+        cpu = res.pop("CPU", 0)
+        tpu = res.pop("TPU", None)
+        actor_cls = RayTrainWorker.options(
+            num_cpus=cpu, num_tpus=tpu, resources=res or None)
+        self.workers: List[Worker] = []
+        for rank in range(num_workers):
+            actor = actor_cls.options(
+                placement_group=self._pg,
+                placement_group_bundle_index=rank).remote()
+            self.workers.append(Worker(actor=actor, rank=rank))
+        # Resolve worker placement (node ids) for local-rank assignment.
+        node_ids = ray_tpu.get(
+            [w.actor.node_id.remote() for w in self.workers], timeout=120)
+        for w, nid in zip(self.workers, node_ids):
+            w.node_id = nid
+
+    def __len__(self):
+        return len(self.workers)
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> list:
+        return [w.actor.run.remote(fn, *args, **kwargs)
+                for w in self.workers]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> list:
+        return ray_tpu.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(
+            self.workers[rank].actor.run.remote(fn, *args, **kwargs))
+
+    def local_ranks(self) -> list[tuple[int, int]]:
+        """(local_rank, local_world_size) per worker, grouped by node."""
+        by_node: dict[str, int] = {}
+        counts: dict[str, int] = {}
+        for w in self.workers:
+            counts[w.node_id] = counts.get(w.node_id, 0) + 1
+        out = []
+        for w in self.workers:
+            lr = by_node.get(w.node_id, 0)
+            by_node[w.node_id] = lr + 1
+            out.append((lr, counts[w.node_id]))
+        return out
+
+    def node_ranks(self) -> list[int]:
+        order: dict[str, int] = {}
+        out = []
+        for w in self.workers:
+            if w.node_id not in order:
+                order[w.node_id] = len(order)
+            out.append(order[w.node_id])
+        return out
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w.actor)
+            except Exception:
+                pass
+        self.workers.clear()
+        if self._pg is not None:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
