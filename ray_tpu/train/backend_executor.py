@@ -60,12 +60,13 @@ class BackendExecutor:
                 train_fn, ctx, checkpoint))
         ray_tpu.get(refs, timeout=120)
 
-    def get_next_results(self, timeout: float = 600.0) -> Optional[List]:
+    def get_next_results(self) -> Optional[List]:
         """One report from EVERY worker, or None when all finished.
-        Raises on worker failure (the caller decides on restart)."""
+        Blocks until reports arrive; a dead worker surfaces as an RPC
+        error (the caller decides on restart)."""
         wg = self.worker_group
-        refs = [w.actor.get_next.remote(timeout) for w in wg.workers]
-        results = ray_tpu.get(refs, timeout=timeout + 60)
+        refs = [w.actor.get_next.remote(None) for w in wg.workers]
+        results = ray_tpu.get(refs)
         dones = [r is None for r in results]
         if all(dones):
             return None

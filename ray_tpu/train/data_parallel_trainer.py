@@ -55,7 +55,6 @@ class BaseTrainer:
         trainer = self
 
         def trainable(config: dict):
-            from ray_tpu.train import session as session_mod
             import copy
             t = copy.copy(trainer)
             if config:
@@ -63,7 +62,10 @@ class BaseTrainer:
             result = t.fit()
             if result.error is not None:
                 raise result.error
-            return result.metrics or {}
+            # Surface the run's final metrics (+ checkpoint) as this
+            # trial's report, as the reference's trainable wrapper does.
+            from ray_tpu.train import session
+            session.report(result.metrics or {}, result.checkpoint)
 
         trainable.__name__ = type(self).__name__
         return trainable

@@ -74,8 +74,11 @@ class _TrainSession:
         if self._stop:
             raise StopIteration
 
-    def get_next(self, timeout: float = 600.0):
-        """Driver side (via actor RPC): next report, or None when done."""
+    def get_next(self, timeout: float | None = None):
+        """Driver side (via actor RPC): next report, or None when done.
+        Blocks indefinitely by default — worker DEATH surfaces as an RPC
+        failure to the caller, not as a queue timeout, so a long-running
+        train step must not be mistaken for a failure."""
         if self.finished and self.result_queue.empty():
             return None
         item = self.result_queue.get(timeout=timeout)

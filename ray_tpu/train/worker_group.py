@@ -36,7 +36,7 @@ class RayTrainWorker:
         sess.start()
         return True
 
-    def get_next(self, timeout: float = 600.0):
+    def get_next(self, timeout: float | None = None):
         return self._session.get_next(timeout)
 
     def finish_session(self):

@@ -1,0 +1,36 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Reference parity: python/ray/tune/ (SURVEY.md §2.3): Tuner/tune.run event
+loop over trial actors, ASHA/median/PBT schedulers, grid/random search with
+pluggable Searcher interface, Train integration (a Trainer is a trainable).
+"""
+
+from ray_tpu.tune.controller import Trial, TuneController  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    Categorical,
+    ConcurrencyLimiter,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    run,
+)
+
+# Worker-side reporting inside trainables (reference: ray.tune.report /
+# ray.air.session inside function trainables).
+from ray_tpu.train.session import get_checkpoint, report  # noqa: F401

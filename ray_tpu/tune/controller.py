@@ -1,0 +1,207 @@
+"""Trial lifecycle: each trial is a session-running actor; one controller
+event loop multiplexes reports, applies scheduler decisions, and handles
+failures.
+
+Reference parity: python/ray/tune/execution/tune_controller.py:47 (step:228,
+actor-event driven) + ray_trial_executor.py:185 (trial = remote actor under
+the trial's resources); trials reuse the train session actor machinery the
+same way the reference's function trainables reuse _TrainSession.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import RayTrainWorker
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search import Searcher
+
+logger = logging.getLogger("ray_tpu.tune")
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    state: str = "PENDING"   # PENDING/RUNNING/TERMINATED/ERROR
+    actor: Any = None
+    pending_ref: Any = None  # in-flight get_next ref
+    last_result: Optional[dict] = None
+    results: List[dict] = field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[BaseException] = None
+    iteration: int = 0
+    reached_rungs: set = field(default_factory=set)
+    # PBT exploit/explore decision recorded by the scheduler:
+    exploit_from: Any = None
+    explored_config: Optional[dict] = None
+    restarts: int = 0
+
+
+class TuneController:
+    def __init__(self, trainable: Callable[[dict], Any], *,
+                 searcher: Searcher,
+                 scheduler: Optional[sched_mod.TrialScheduler] = None,
+                 max_concurrent: int = 8,
+                 resources_per_trial: Optional[dict] = None,
+                 run_config: Optional[RunConfig] = None,
+                 max_failures_per_trial: int = 0):
+        self._trainable = trainable
+        self._searcher = searcher
+        self._scheduler = scheduler or sched_mod.FIFOScheduler()
+        self._max_concurrent = max_concurrent
+        self._resources = dict(resources_per_trial or {"CPU": 1})
+        self._run_config = run_config or RunConfig()
+        self._max_failures = max_failures_per_trial
+        self.trials: List[Trial] = []
+        self._next_index = 0
+
+    # ------------------------------------------------------------------
+
+    def _make_trial(self) -> Optional[Trial]:
+        trial_id = f"trial_{self._next_index:05d}_{uuid.uuid4().hex[:6]}"
+        config = self._searcher.suggest(trial_id)
+        if config is None:
+            return None
+        self._next_index += 1
+        trial = Trial(trial_id=trial_id, config=config)
+        self.trials.append(trial)
+        return trial
+
+    def _start_trial(self, trial: Trial):
+        res = dict(self._resources)
+        cpu = res.pop("CPU", 1)
+        tpu = res.pop("TPU", None)
+        trial.actor = RayTrainWorker.options(
+            num_cpus=cpu, num_tpus=tpu, resources=res or None).remote()
+        fn = self._trainable
+        config = dict(trial.config)
+
+        def run_fn():
+            fn(config)
+
+        ctx = TrainContext(world_rank=0, world_size=1, local_rank=0,
+                           local_world_size=1, node_rank=0,
+                           trial_name=trial.trial_id)
+        ray_tpu.get(trial.actor.init_session.remote(
+            run_fn, ctx, trial.checkpoint), timeout=120)
+        trial.state = "RUNNING"
+        trial.pending_ref = trial.actor.get_next.remote(None)
+
+    def _stop_trial(self, trial: Trial, state: str = "TERMINATED",
+                    error: Optional[BaseException] = None):
+        trial.state = state
+        trial.error = error
+        self._teardown_actor(trial)
+        self._searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error is not None)
+        self._scheduler.on_trial_complete(trial, trial.last_result)
+
+    # ------------------------------------------------------------------
+
+    def _running(self) -> List[Trial]:
+        return [t for t in self.trials if t.state == "RUNNING"]
+
+    def step(self) -> bool:
+        """One controller iteration; False when everything is done."""
+        # 1. Launch new/pending trials up to the concurrency cap.
+        while len(self._running()) < self._max_concurrent:
+            pending = next((t for t in self.trials if t.state == "PENDING"),
+                           None)
+            if pending is None:
+                pending = self._make_trial()
+            if pending is None:
+                break
+            try:
+                self._start_trial(pending)
+            except Exception as e:
+                self._stop_trial(pending, "ERROR", e)
+
+        running = self._running()
+        if not running:
+            return False
+
+        # 2. Wait for any trial to produce a report (or finish).
+        refs = [t.pending_ref for t in running]
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=1.0)
+        for t in running:
+            if t.pending_ref not in ready:
+                continue
+            try:
+                item = ray_tpu.get(t.pending_ref)
+            except Exception as e:
+                self._on_trial_error(t, e)
+                continue
+            if item is None:  # finished cleanly
+                self._stop_trial(t, "TERMINATED")
+                continue
+            metrics, checkpoint = item
+            t.iteration += 1
+            metrics.setdefault("training_iteration", t.iteration)
+            metrics["trial_id"] = t.trial_id
+            t.last_result = metrics
+            t.results.append(metrics)
+            if checkpoint is not None:
+                t.checkpoint = checkpoint
+            decision = self._scheduler.on_trial_result(t, metrics)
+            if decision == sched_mod.STOP:
+                if t.explored_config is not None:
+                    self._exploit_explore(t)
+                else:
+                    self._stop_trial(t, "TERMINATED")
+            else:
+                t.pending_ref = t.actor.get_next.remote(None)
+        return True
+
+    def _on_trial_error(self, trial: Trial, error: BaseException):
+        if trial.restarts < self._max_failures or self._max_failures == -1:
+            trial.restarts += 1
+            logger.warning("trial %s failed (%s); restarting (%d/%s)",
+                           trial.trial_id, error, trial.restarts,
+                           self._max_failures)
+            self._teardown_actor(trial)
+            try:
+                self._start_trial(trial)
+            except Exception as e:
+                self._stop_trial(trial, "ERROR", e)
+        else:
+            self._stop_trial(trial, "ERROR", error)
+
+    def _exploit_explore(self, trial: Trial):
+        """PBT restart: adopt donor checkpoint + explored config."""
+        donor = trial.exploit_from
+        logger.info("PBT: %s exploits %s", trial.trial_id, donor.trial_id)
+        trial.config = trial.explored_config
+        trial.checkpoint = donor.checkpoint
+        trial.exploit_from = None
+        trial.explored_config = None
+        self._teardown_actor(trial)
+        try:
+            self._start_trial(trial)
+        except Exception as e:
+            self._stop_trial(trial, "ERROR", e)
+
+    def _teardown_actor(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.pending_ref = None
+
+    def run(self, deadline_s: Optional[float] = None):
+        start = time.monotonic()
+        while self.step():
+            if deadline_s and time.monotonic() - start > deadline_s:
+                for t in self._running():
+                    self._stop_trial(t, "TERMINATED")
+                break

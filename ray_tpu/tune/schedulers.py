@@ -1,0 +1,190 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Reference parity: python/ray/tune/schedulers/ — FIFOScheduler,
+ASHAScheduler (async_hyperband.py), MedianStoppingRule
+(median_stopping_rule.py), PopulationBasedTraining (pbt.py).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]):
+        """Adopt TuneConfig's metric/mode unless this scheduler was
+        constructed with explicit ones (reference: schedulers propagate
+        metric/mode from tune.run)."""
+        if self.metric is None:
+            self.metric = metric
+        if self.mode is None:
+            self.mode = mode or "min"
+
+    def _require_metric(self):
+        if self.metric is None:
+            raise ValueError(
+                f"{type(self).__name__} needs a metric — pass metric= to "
+                f"the scheduler or set TuneConfig.metric")
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[dict]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference: async_hyperband.py).
+
+    Rung milestones r, r*eta, r*eta^2, ... up to max_t; at each rung a
+    trial continues only if its metric is in the top 1/eta of results
+    recorded at that rung so far (async: no waiting for full brackets).
+    """
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4,
+                 time_attr: str = "training_iteration"):
+        assert mode in (None, "min", "max")
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self._recorded: Dict[int, list] = {r: [] for r in self.rungs}
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        self._require_metric()
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self.rungs):
+            if t >= rung and rung not in trial.reached_rungs:
+                trial.reached_rungs.add(rung)
+                recorded = self._recorded[rung]
+                recorded.append(value)
+                if len(recorded) < self.eta:
+                    return CONTINUE  # too few peers to judge
+                ordered = sorted(recorded, reverse=(self.mode == "max"))
+                cutoff = ordered[max(0, len(ordered) // self.eta - 1)]
+                good = (value >= cutoff if self.mode == "max"
+                        else value <= cutoff)
+                return CONTINUE if good else STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop trials whose best result is worse than the median of running
+    averages (reference: median_stopping_rule.py)."""
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 min_samples_required: int = 3, grace_period: int = 1,
+                 time_attr: str = "training_iteration"):
+        assert mode in (None, "min", "max")
+        self.metric, self.mode = metric, mode
+        self.min_samples = min_samples_required
+        self.grace = grace_period
+        self.time_attr = time_attr
+        self._avgs: Dict[str, list] = {}
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        self._require_metric()
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self._avgs.setdefault(trial.trial_id, []).append(value)
+        if t < self.grace or len(self._avgs) < self.min_samples:
+            return CONTINUE
+        import statistics
+        running = [statistics.fmean(v) for v in self._avgs.values()]
+        median = statistics.median(running)
+        mine = statistics.fmean(self._avgs[trial.trial_id])
+        ok = mine >= median if self.mode == "max" else mine <= median
+        return CONTINUE if ok else STOP
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: pbt.py): at each perturbation interval, bottom-
+    quantile trials exploit (clone checkpoint + config of a top-quantile
+    trial) and explore (perturb hyperparams).  The controller performs the
+    actual restart; this scheduler records the decision on the trial."""
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 perturbation_factors=(0.8, 1.2), seed: Optional[int] = None,
+                 time_attr: str = "training_iteration"):
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.factors = perturbation_factors
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._latest: Dict[str, dict] = {}
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        self._require_metric()
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self._latest[trial.trial_id] = {"value": value, "trial": trial}
+        if t == 0 or t % self.interval:
+            return CONTINUE
+        peers = sorted(self._latest.values(), key=lambda e: e["value"],
+                       reverse=(self.mode == "max"))
+        n = len(peers)
+        k = max(1, int(n * self.quantile))
+        if n < 2 * k:
+            return CONTINUE
+        bottom = {e["trial"].trial_id for e in peers[-k:]}
+        if trial.trial_id not in bottom:
+            return CONTINUE
+        donor = self._rng.choice(peers[:k])["trial"]
+        if donor.checkpoint is None:
+            return CONTINUE
+        trial.exploit_from = donor
+        trial.explored_config = self._explore(dict(donor.config))
+        return STOP  # controller restarts it with the new config+checkpoint
+
+    def on_trial_complete(self, trial, result=None):
+        # Dead trials must not occupy quantile slots or act as donors.
+        self._latest.pop(trial.trial_id, None)
+
+    def _explore(self, config: dict) -> dict:
+        for key, spec in self.mutations.items():
+            if key not in config:
+                continue
+            if isinstance(spec, list):
+                config[key] = self._rng.choice(spec)
+            elif callable(spec):
+                config[key] = spec()
+            else:  # numeric: scale by a perturbation factor
+                config[key] = config[key] * self._rng.choice(self.factors)
+        return config

@@ -1,0 +1,137 @@
+"""Search spaces and suggestion: samplers, grid expansion, concurrency cap.
+
+Reference parity: python/ray/tune/search/ — basic_variant.py
+(BasicVariantGenerator: grid_search x num_samples expansion),
+sample.py (uniform/loguniform/choice/randint/...), concurrency_limiter.py.
+Plugin searchers (optuna/hyperopt/...) slot in behind the same Searcher
+interface.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, low: float, high: float, log: bool = False):
+        self.low, self.high, self.log = low, high, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+            return math.exp(rng.uniform(math.log(self.low),
+                                        math.log(self.high)))
+        return rng.uniform(self.low, self.high)
+
+
+class Integer(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: list):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+def uniform(low: float, high: float) -> Float:
+    return Float(low, high)
+
+
+def loguniform(low: float, high: float) -> Float:
+    return Float(low, high, log=True)
+
+
+def randint(low: int, high: int) -> Integer:
+    return Integer(low, high)
+
+
+def choice(categories: list) -> Categorical:
+    return Categorical(categories)
+
+
+def grid_search(values: list) -> dict:
+    return {"grid_search": list(values)}
+
+
+def _expand_grid(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cartesian product over every grid_search entry (reference:
+    basic_variant.py variant generation)."""
+    grids = [(k, v["grid_search"]) for k, v in space.items()
+             if isinstance(v, dict) and "grid_search" in v]
+    variants = [{}]
+    for key, values in grids:
+        variants = [dict(v, **{key: val}) for v in variants for val in values]
+    return variants
+
+
+class Searcher:
+    """Interface for suggestion algorithms (reference: search/searcher.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._space = space
+        self._rng = random.Random(seed)
+        self._queue: List[dict] = []
+        for _ in range(num_samples):
+            for variant in _expand_grid(space):
+                cfg = {}
+                for k, v in space.items():
+                    if k in variant:
+                        cfg[k] = variant[k]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    elif isinstance(v, dict) and "grid_search" in v:
+                        pass  # covered by variant
+                    else:
+                        cfg[k] = v
+                self._queue.append(cfg)
+
+    @property
+    def total(self) -> int:
+        return len(self._queue)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        return self._queue.pop(0) if self._queue else None
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions (reference: concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

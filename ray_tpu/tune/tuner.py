@@ -1,0 +1,140 @@
+"""Tuner / tune.run / ResultGrid — the public Tune surface.
+
+Reference parity: python/ray/tune/tuner.py (Tuner.fit), tune.py:232
+(tune.run), result_grid.py (ResultGrid), tune_config.py (TuneConfig).
+Train integration as in the reference: a Trainer is just a trainable
+(base_trainer.py:557 wraps fit into a single-trial tune run).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.train.data_parallel_trainer import BaseTrainer, Result
+from ray_tpu.tune.controller import TuneController
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+
+
+@dataclass
+class TuneConfig:
+    """Reference: tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 8
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+    time_budget_s: Optional[float] = None
+
+
+class ResultGrid:
+    """Reference: tune/result_grid.py."""
+
+    def __init__(self, results: list, metric: Optional[str], mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> list:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        scored = [r for r in self._results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+        return pd.DataFrame([r.metrics for r in self._results if r.metrics])
+
+
+class Tuner:
+    """Reference: tune/tuner.py."""
+
+    def __init__(self, trainable: Union[Callable, BaseTrainer], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[dict] = None):
+        if isinstance(trainable, BaseTrainer):
+            # Trial actor only orchestrates; the trainer's own WorkerGroup
+            # holds the real resources.  Callers can still override.
+            self._resources = dict(resources_per_trial or {"CPU": 0.5})
+            trainable = trainable.as_trainable()
+        else:
+            self._resources = dict(resources_per_trial or {"CPU": 1})
+        self._trainable = trainable
+        self._param_space = dict(param_space or {})
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self._param_space, num_samples=tc.num_samples, seed=tc.seed)
+        if tc.scheduler is not None:
+            tc.scheduler.set_search_properties(tc.metric, tc.mode)
+        controller = TuneController(
+            self._trainable,
+            searcher=searcher,
+            scheduler=tc.scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            resources_per_trial=self._resources,
+            run_config=self._run_config,
+            max_failures_per_trial=(
+                self._run_config.failure_config.max_failures))
+        controller.run(deadline_s=tc.time_budget_s)
+        results = []
+        for trial in controller.trials:
+            results.append(Result(
+                metrics=(dict(trial.last_result, config=trial.config)
+                         if trial.last_result else None),
+                checkpoint=trial.checkpoint,
+                error=trial.error,
+                metrics_history=trial.results))
+        return ResultGrid(results, tc.metric, tc.mode)
+
+
+def run(trainable: Callable, *, config: Optional[dict] = None,
+        num_samples: int = 1, metric: Optional[str] = None,
+        mode: str = "min", scheduler: Optional[TrialScheduler] = None,
+        search_alg: Optional[Searcher] = None,
+        max_concurrent_trials: int = 8,
+        resources_per_trial: Optional[dict] = None,
+        time_budget_s: Optional[float] = None,
+        run_config: Optional[RunConfig] = None) -> ResultGrid:
+    """Reference: tune/tune.py:232 tune.run."""
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples,
+            scheduler=scheduler, search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+            time_budget_s=time_budget_s),
+        run_config=run_config,
+        resources_per_trial=resources_per_trial,
+    ).fit()

@@ -1,0 +1,136 @@
+"""Tune tests: grid/random search, ASHA early stopping, PBT exploit/explore,
+trainer integration, failure handling.
+
+Reference coverage model: python/ray/tune/tests/ (test_tune_*.py,
+test_trial_scheduler*.py) over a real single-node cluster.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import Checkpoint, RunConfig, FailureConfig
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, object_store_memory=64 << 20)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_grid_and_random_search(cluster):
+    def objective(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"a": tune.grid_search([1, 2, 3]),
+                     "b": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(metric="score", mode="max", seed=7),
+        resources_per_trial={"CPU": 1},
+    ).fit()
+    assert len(grid) == 3
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["config"]["a"] == 3
+    assert 30 <= best.metrics["score"] <= 31
+
+
+def test_num_samples_random(cluster):
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.5) ** 2})
+
+    results = tune.run(objective, config={"x": tune.uniform(0, 1)},
+                       num_samples=6, metric="loss", mode="min",
+                       resources_per_trial={"CPU": 1})
+    assert len(results) == 6
+    best = results.get_best_result()
+    assert best.metrics["loss"] <= min(
+        r.metrics["loss"] for r in results if r.metrics)
+
+
+def test_asha_stops_bad_trials_early(cluster):
+    def objective(config):
+        for step in range(20):
+            # Bad configs plateau high; good ones descend.
+            loss = config["lr"] * (20 - step if config["lr"] < 0.5 else 20)
+            tune.report({"loss": loss})
+
+    scheduler = tune.ASHAScheduler(metric="loss", mode="min", max_t=20,
+                                   grace_period=2, reduction_factor=2)
+    results = tune.run(
+        objective, config={"lr": tune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        scheduler=scheduler, metric="loss", mode="min",
+        resources_per_trial={"CPU": 1})
+    assert len(results) == 4
+    iters = {r.metrics["config"]["lr"]: len(r.metrics_history)
+             for r in results if r.metrics}
+    # The bad (plateauing) configs must have been cut before 20 iterations.
+    assert iters[1.0] < 20 or iters[0.9] < 20
+    # At least one good config ran to completion.
+    assert max(len(r.metrics_history) for r in results) == 20
+
+
+def test_trial_error_isolated(cluster):
+    def objective(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        tune.report({"ok": 1})
+
+    results = tune.run(objective, config={"x": tune.grid_search([0, 1, 2])},
+                       resources_per_trial={"CPU": 1})
+    assert len(results) == 3
+    assert len(results.errors) == 1
+    assert sum(1 for r in results if r.error is None) == 2
+
+
+def test_pbt_exploit_explore(cluster):
+    def objective(config):
+        from ray_tpu.tune import get_checkpoint
+        start, inherited = 0, config["lr"]
+        ckpt = get_checkpoint()
+        if ckpt is not None:
+            d = ckpt.to_dict()
+            start = d["step"] + 1
+        for step in range(start, 12):
+            # High lr -> good score; PBT should migrate low-lr trials up.
+            tune.report({"score": config["lr"] * (step + 1)},
+                        checkpoint=Checkpoint.from_dict(
+                            {"step": step, "lr": config["lr"]}))
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.5, 1.0, 2.0]}, seed=3,
+        quantile_fraction=0.34)
+    results = tune.run(
+        objective, config={"lr": tune.grid_search([0.1, 1.0, 2.0])},
+        scheduler=pbt, metric="score", mode="max",
+        resources_per_trial={"CPU": 1})
+    assert len(results) == 3
+    assert not results.errors
+    # The originally-worst trial should have been perturbed off lr=0.1.
+    final_lrs = [r.metrics["config"]["lr"] for r in results if r.metrics]
+    assert any(lr != 0.1 for lr in final_lrs)
+    best = results.get_best_result()
+    assert best.metrics["score"] >= 12  # lr >= 1.0 for 12 steps
+
+
+def test_tuner_over_trainer(cluster):
+    from ray_tpu.air import ScalingConfig
+    from ray_tpu.train import DataParallelTrainer
+
+    def loop(config):
+        from ray_tpu.train import session
+        session.report({"final": config["scale"] * 2})
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={"scale": 0},
+        scaling_config=ScalingConfig(num_workers=1))
+    results = tune.Tuner(
+        trainer,
+        param_space={"scale": tune.grid_search([1, 5])},
+        tune_config=tune.TuneConfig(metric="final", mode="max"),
+    ).fit()
+    assert len(results) == 2
+    assert results.get_best_result().metrics["final"] == 10
