@@ -1,0 +1,22 @@
+"""ray_tpu.data — distributed Arrow-blocked datasets.
+
+Reference parity: python/ray/data/ (SURVEY.md §2.3): lazy plans with stage
+fusion, streaming execution with backpressure, map/map_batches/shuffle/
+sort/groupby, parquet/csv/json/numpy/text IO, split() for per-worker
+ingest.
+"""
+
+from ray_tpu.data.dataset import Dataset, GroupedData  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)

@@ -1,0 +1,133 @@
+"""Blocks: the unit of distributed data (one Arrow table per block).
+
+Reference parity: python/ray/data/block.py + _internal/arrow_block.py —
+blocks live in the object store as Arrow tables; batch views convert to
+numpy / pandas / pyarrow on demand.  TPU angle: the "numpy" batch format is
+the default (feeds jax.device_put / global_batch directly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+ITEM_COLUMN = "item"  # reference: from_items wraps scalars in {"item": v}
+
+
+@dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+
+
+def rows_to_block(rows: List[Any]) -> pa.Table:
+    """Normalize a list of rows (dicts or scalars) into an Arrow table."""
+    if not rows:
+        return pa.table({})
+    if isinstance(rows[0], dict):
+        cols: Dict[str, list] = {}
+        for r in rows:
+            for k in r:
+                cols.setdefault(k, [])
+        for r in rows:
+            for k in cols:
+                cols[k].append(r.get(k))
+        return pa.table({k: _to_array(v) for k, v in cols.items()})
+    return pa.table({ITEM_COLUMN: _to_array(list(rows))})
+
+
+def _to_array(values: list) -> pa.Array:
+    if values and isinstance(values[0], np.ndarray):
+        # Tensor column: fixed-shape tensor extension type preserves both
+        # dtype and per-row shape through the store and back to numpy.
+        arr = np.stack(values)
+        return pa.FixedShapeTensorArray.from_numpy_ndarray(arr)
+    return pa.array(values)
+
+
+def _ndarray_to_column(arr: np.ndarray) -> pa.Array:
+    """A batch column from an ndarray: rows along dim 0; ndim>1 becomes a
+    fixed-shape tensor column."""
+    if arr.ndim > 1:
+        return pa.FixedShapeTensorArray.from_numpy_ndarray(arr)
+    return pa.array(arr)
+
+
+def block_metadata(block: pa.Table) -> BlockMetadata:
+    return BlockMetadata(num_rows=block.num_rows,
+                         size_bytes=block.nbytes,
+                         schema=block.schema)
+
+
+def block_to_batch(block: pa.Table, batch_format: str):
+    """Convert a block to the requested batch format."""
+    if batch_format in ("default", "numpy"):
+        return {name: _column_to_numpy(block.column(name))
+                for name in block.column_names}
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format == "pyarrow":
+        return block
+    raise ValueError(f"unknown batch_format {batch_format!r} "
+                     f"(use numpy/pandas/pyarrow)")
+
+
+def _column_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    if isinstance(col.type, pa.FixedShapeTensorType):
+        merged = col.combine_chunks() if isinstance(
+            col, pa.ChunkedArray) else col
+        return merged.to_numpy_ndarray()
+    try:
+        return col.to_numpy(zero_copy_only=False)
+    except pa.ArrowInvalid:
+        return np.array(col.to_pylist(), dtype=object)
+
+
+def batch_to_block(batch: Any) -> pa.Table:
+    """Convert a user-returned batch back into an Arrow block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        cols = {}
+        for k, v in batch.items():
+            if isinstance(v, np.ndarray):
+                cols[k] = _ndarray_to_column(v)
+            elif isinstance(v, (pa.Array, pa.ChunkedArray)):
+                cols[k] = v
+            else:
+                cols[k] = _to_array(list(v))
+        return pa.table(cols)
+    try:
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return rows_to_block(batch)
+    raise TypeError(f"cannot convert batch of type {type(batch)} to a block")
+
+
+def block_rows(block: pa.Table) -> Iterable[dict]:
+    cols = block.column_names
+    if cols == [ITEM_COLUMN]:
+        for v in block.column(ITEM_COLUMN).to_pylist():
+            yield v
+    else:
+        for row in block.to_pylist():
+            yield row
+
+
+def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def slice_block(block: pa.Table, start: int, end: int) -> pa.Table:
+    return block.slice(start, end - start)

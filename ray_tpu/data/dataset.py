@@ -1,0 +1,468 @@
+"""Dataset: lazy, distributed, Arrow-blocked data.
+
+Reference parity: python/ray/data/dataset.py:169 — creation in read_api.py,
+transforms build a lazy plan (map/map_batches/filter/flat_map/repartition/
+random_shuffle/sort/limit/union/split/groupby), consumption executes it
+(take/count/iter_batches/iter_rows/to_pandas/write_*), streaming execution
+with backpressure in executor.py.
+
+TPU angle: `iter_batches(batch_format="numpy")` yields dicts of numpy
+arrays sized for `global_batch` ingestion, and `split(n)` hands each
+training worker its own shard — the sharded-ingest path for pods.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as blk
+from ray_tpu.data.executor import (
+    AllToAll, ExecPlan, OneToOne, execute, iter_output_refs)
+
+
+# ---------------- per-block remote helpers (driver stays thin) -------------
+
+
+@ray_tpu.remote
+def _block_meta(block):
+    return block.num_rows, block.schema
+
+
+@ray_tpu.remote
+def _agg_partial(block, col):
+    vals = np.asarray(block.column(col).to_pylist())
+    if vals.size == 0:
+        return (0, 0.0, 0.0, None, None)
+    v = vals.astype(np.float64)
+    return (int(v.size), float(v.sum()), float((v * v).sum()),
+            float(v.min()), float(v.max()))
+
+
+@ray_tpu.remote
+def _unique_partial(block, col):
+    return sorted(set(block.column(col).to_pylist()))
+
+
+@ray_tpu.remote
+def _hash_partition(block, key, n):
+    """Split a block into n hash partitions by key (stable hash)."""
+    import zlib
+    parts = [[] for _ in range(n)]
+    for row in block.to_pylist():
+        h = zlib.crc32(repr(row[key]).encode()) % n
+        parts[h].append(row)
+    out = tuple(blk.rows_to_block(p) for p in parts)
+    return out if n > 1 else out[0]
+
+
+@ray_tpu.remote
+def _concat_remote(*blocks):
+    return blk.concat_blocks(list(blocks))
+
+
+@ray_tpu.remote
+def _group_apply(block, key, fn):
+    """Group a partition's rows by key and apply fn per group."""
+    import collections
+    groups = collections.defaultdict(list)
+    for row in block.to_pylist():
+        groups[row[key]].append(row)
+    rows = []
+    for k in sorted(groups):
+        rows.extend(fn(groups[k]))
+    return blk.rows_to_block(rows)
+
+
+def _rechunk(table: pa.Table, n: int) -> List[pa.Table]:
+    """Slice a table into up to n near-equal pieces (empty tail dropped)."""
+    n = max(1, n)
+    if table.num_rows == 0:
+        return [table]
+    per = -(-table.num_rows // n)
+    return [blk.slice_block(table, i * per,
+                            min((i + 1) * per, table.num_rows))
+            for i in range(n) if i * per < table.num_rows]
+
+
+class Dataset:
+    def __init__(self, plan: ExecPlan):
+        self._plan = plan
+        self._materialized: Optional[List[Any]] = None
+
+    # ----------------------------------------------------------------
+    # transforms (lazy)
+    # ----------------------------------------------------------------
+
+    def _with_one_to_one(self, fn, name) -> "Dataset":
+        return Dataset(self._plan.with_stage(OneToOne(fn, name)))
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        def do(block):
+            return blk.rows_to_block([fn(r) for r in blk.block_rows(block)])
+        return self._with_one_to_one(do, "map")
+
+    def flat_map(self, fn: Callable[[dict], list]) -> "Dataset":
+        def do(block):
+            out = []
+            for r in blk.block_rows(block):
+                out.extend(fn(r))
+            return blk.rows_to_block(out)
+        return self._with_one_to_one(do, "flat_map")
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        def do(block):
+            return blk.rows_to_block(
+                [r for r in blk.block_rows(block) if fn(r)])
+        return self._with_one_to_one(do, "filter")
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    batch_size: Optional[int] = None,
+                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+        kwargs = fn_kwargs or {}
+
+        def do(block):
+            if block.num_rows == 0:
+                return block
+            size = batch_size or block.num_rows
+            outs = []
+            for start in range(0, block.num_rows, size):
+                piece = blk.slice_block(block, start,
+                                        min(start + size, block.num_rows))
+                batch = blk.block_to_batch(piece, batch_format)
+                outs.append(blk.batch_to_block(fn(batch, **kwargs)))
+            return blk.concat_blocks(outs)
+        return self._with_one_to_one(do, "map_batches")
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def do(batch):
+            batch[name] = fn(batch)
+            return batch
+        return self.map_batches(do, batch_format="pandas")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def do(block):
+            keep = [c for c in block.column_names if c not in cols]
+            return block.select(keep)
+        return self._with_one_to_one(do, "drop_columns")
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def do(block):
+            return block.select(cols)
+        return self._with_one_to_one(do, "select_columns")
+
+    # ------------------------- all-to-all ---------------------------
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def do(refs):
+            blocks = ray_tpu.get(list(refs))
+            whole = blk.concat_blocks(blocks)
+            n = max(1, num_blocks)
+            per = max(1, -(-whole.num_rows // n)) if whole.num_rows else 1
+            out = []
+            for i in range(n):
+                piece = blk.slice_block(whole, min(i * per, whole.num_rows),
+                                        min((i + 1) * per, whole.num_rows))
+                out.append(ray_tpu.put(piece))
+            return out
+        return Dataset(self._plan.with_stage(AllToAll(do, "repartition")))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        def do(refs):
+            blocks = ray_tpu.get(list(refs))
+            whole = blk.concat_blocks(blocks)
+            if whole.num_rows == 0:
+                return [ray_tpu.put(whole)]
+            rng = np.random.default_rng(seed)
+            shuffled = whole.take(pa.array(rng.permutation(whole.num_rows)))
+            return [ray_tpu.put(p) for p in _rechunk(shuffled, len(refs))]
+        return Dataset(self._plan.with_stage(AllToAll(do, "random_shuffle")))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def do(refs):
+            blocks = ray_tpu.get(list(refs))
+            whole = blk.concat_blocks(blocks)
+            if whole.num_rows == 0:
+                return [ray_tpu.put(whole)]
+            order = "descending" if descending else "ascending"
+            idx = pa.compute.sort_indices(whole, sort_keys=[(key, order)])
+            return [ray_tpu.put(p)
+                    for p in _rechunk(whole.take(idx), len(refs))]
+        return Dataset(self._plan.with_stage(AllToAll(do, "sort")))
+
+    def limit(self, n: int) -> "Dataset":
+        def do(refs):
+            out, seen = [], 0
+            for r in refs:
+                if seen >= n:
+                    break
+                b = ray_tpu.get(r)
+                take = min(b.num_rows, n - seen)
+                out.append(ray_tpu.put(blk.slice_block(b, 0, take)))
+                seen += take
+            return out
+        return Dataset(self._plan.with_stage(AllToAll(do, "limit")))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._execute())
+        for o in others:
+            refs.extend(o._execute())
+        return Dataset(ExecPlan(refs))
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Shard into n datasets (reference: dataset.split — per-worker
+        ingest)."""
+        refs = self._execute()
+        if equal:
+            whole = blk.concat_blocks(ray_tpu.get(list(refs)))
+            per = whole.num_rows // n
+            return [Dataset(ExecPlan([ray_tpu.put(
+                blk.slice_block(whole, i * per, (i + 1) * per))]))
+                for i in range(n)]
+        shards: List[List[Any]] = [[] for _ in range(n)]
+        for i, r in enumerate(refs):
+            shards[i % n].append(r)
+        return [Dataset(ExecPlan(s)) for s in shards]
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ----------------------------------------------------------------
+    # execution / consumption
+    # ----------------------------------------------------------------
+
+    def _execute(self) -> List[Any]:
+        if self._materialized is None:
+            self._materialized = execute(self._plan)
+        return self._materialized
+
+    def materialize(self) -> "Dataset":
+        return Dataset(ExecPlan(self._execute()))
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def count(self) -> int:
+        # Metadata-only: per-block remote num_rows, never full payloads.
+        metas = ray_tpu.get([_block_meta.remote(r) for r in self._execute()])
+        return sum(n for n, _ in metas)
+
+    def schema(self) -> Optional[pa.Schema]:
+        metas = ray_tpu.get([_block_meta.remote(r) for r in self._execute()])
+        for n, schema in metas:
+            if n or schema.names:
+                return schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for r in self._execute():
+            for row in blk.block_rows(ray_tpu.get(r)):
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Any]:
+        out = []
+        for r in self._execute():
+            out.extend(blk.block_rows(ray_tpu.get(r)))
+        return out
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for r in iter_output_refs(self._plan):
+            yield from blk.block_rows(ray_tpu.get(r))
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 4) -> Iterator[Any]:
+        """Streaming batches with block prefetch (backpressure via the
+        executor's in-flight window)."""
+        buffer: List[pa.Table] = []
+        buffered = 0
+        for r in iter_output_refs(self._plan, window=max(1, prefetch_blocks)):
+            b = ray_tpu.get(r)
+            if b.num_rows == 0:
+                continue
+            buffer.append(b)
+            buffered += b.num_rows
+            while buffered >= batch_size:
+                whole = blk.concat_blocks(buffer)
+                piece = blk.slice_block(whole, 0, batch_size)
+                rest = blk.slice_block(whole, batch_size, whole.num_rows)
+                buffer = [rest] if rest.num_rows else []
+                buffered = rest.num_rows
+                yield blk.block_to_batch(piece, batch_format)
+        if buffered and not drop_last:
+            yield blk.block_to_batch(blk.concat_blocks(buffer), batch_format)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        import torch
+        for batch in self.iter_batches(**kwargs):
+            yield {k: torch.as_tensor(np.asarray(v))
+                   for k, v in batch.items()}
+
+    def to_pandas(self):
+        return blk.concat_blocks(ray_tpu.get(self._execute())).to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return blk.concat_blocks(ray_tpu.get(self._execute()))
+
+    # ------------------------- aggregates ---------------------------
+    # Per-block remote partials, tiny driver-side combine — the driver
+    # never fetches block payloads.
+
+    def _partials(self, on: Optional[str]):
+        col = on or blk.ITEM_COLUMN
+        return ray_tpu.get([_agg_partial.remote(r, col)
+                            for r in self._execute()])
+
+    def sum(self, on: Optional[str] = None):
+        return sum(p[1] for p in self._partials(on))
+
+    def min(self, on: Optional[str] = None):
+        mins = [p[3] for p in self._partials(on) if p[3] is not None]
+        if not mins:
+            raise ValueError("min() of an empty dataset")
+        return min(mins)
+
+    def max(self, on: Optional[str] = None):
+        maxs = [p[4] for p in self._partials(on) if p[4] is not None]
+        if not maxs:
+            raise ValueError("max() of an empty dataset")
+        return max(maxs)
+
+    def mean(self, on: Optional[str] = None):
+        ps = self._partials(on)
+        n = sum(p[0] for p in ps)
+        if n == 0:
+            raise ValueError("mean() of an empty dataset")
+        return sum(p[1] for p in ps) / n
+
+    def std(self, on: Optional[str] = None):
+        ps = self._partials(on)
+        n = sum(p[0] for p in ps)
+        if n < 2:
+            raise ValueError("std() needs at least 2 rows")
+        total = sum(p[1] for p in ps)
+        sumsq = sum(p[2] for p in ps)
+        return float(np.sqrt((sumsq - total * total / n) / (n - 1)))
+
+    def unique(self, column: str) -> List[Any]:
+        parts = ray_tpu.get([_unique_partial.remote(r, column)
+                             for r in self._execute()])
+        out = set()
+        for p in parts:
+            out.update(p)
+        return sorted(out)
+
+    # ------------------------- writes -------------------------------
+
+    def write_parquet(self, path: str):
+        import os
+        import pyarrow.parquet as pq
+        os.makedirs(path, exist_ok=True)
+        for i, r in enumerate(self._execute()):
+            b = ray_tpu.get(r)
+            if b.num_rows:
+                pq.write_table(b, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str):
+        import os
+        import pyarrow.csv as pcsv
+        os.makedirs(path, exist_ok=True)
+        for i, r in enumerate(self._execute()):
+            b = ray_tpu.get(r)
+            if b.num_rows:
+                pcsv.write_csv(b, os.path.join(path, f"part-{i:05d}.csv"))
+
+    def write_json(self, path: str):
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, r in enumerate(self._execute()):
+            b = ray_tpu.get(r)
+            if b.num_rows:
+                with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                    for row in b.to_pylist():
+                        f.write(json.dumps(row) + "\n")
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._plan.input_refs)}+, "
+                f"stages={[getattr(s, 'name', '?') for s in self._plan.stages]})")
+
+
+class GroupedData:
+    """Hash-partitioned distributed groupby (reference:
+    data/grouped_data.py over push_based_shuffle.py): each block hash-
+    partitions by key remotely, partitions merge remotely (group keys are
+    disjoint across partitions), and per-group work runs as one task per
+    partition — the driver only touches refs and tiny aggregate rows."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _partitions(self) -> List[Any]:
+        refs = self._ds._execute()
+        n = max(1, len(refs))
+        if n == 1:
+            return list(refs)
+        parts = [_hash_partition.options(num_returns=n).remote(
+            r, self._key, n) for r in refs]
+        return [_concat_remote.remote(*[row[p] for row in parts])
+                for p in range(n)]
+
+    def _apply(self, fn: Callable[[list], list]) -> Dataset:
+        out = [_group_apply.remote(p, self._key, fn)
+               for p in self._partitions()]
+        result = Dataset(ExecPlan(out))
+        return result.sort(self._key)
+
+    def count(self) -> Dataset:
+        key = self._key
+        return self._apply(
+            lambda rows: [{key: rows[0][key], "count()": len(rows)}])
+
+    def sum(self, on: str) -> Dataset:
+        key = self._key
+        return self._apply(
+            lambda rows: [{key: rows[0][key],
+                           f"sum({on})": sum(r[on] for r in rows)}])
+
+    def mean(self, on: str) -> Dataset:
+        key = self._key
+        return self._apply(
+            lambda rows: [{key: rows[0][key],
+                           f"mean({on})": sum(r[on] for r in rows)
+                           / len(rows)}])
+
+    def min(self, on: str) -> Dataset:
+        key = self._key
+        return self._apply(
+            lambda rows: [{key: rows[0][key],
+                           f"min({on})": min(r[on] for r in rows)}])
+
+    def max(self, on: str) -> Dataset:
+        key = self._key
+        return self._apply(
+            lambda rows: [{key: rows[0][key],
+                           f"max({on})": max(r[on] for r in rows)}])
+
+    def map_groups(self, fn: Callable[[list], list]) -> Dataset:
+        out = [_group_apply.remote(p, self._key, fn)
+               for p in self._partitions()]
+        return Dataset(ExecPlan(out))

@@ -1,0 +1,142 @@
+"""Execution: fused per-block task chains with bounded in-flight windows.
+
+Reference parity: python/ray/data/_internal/execution/ —
+StreamingExecutor:41 (operator pipeline with backpressure) +
+the plan optimizer's stage fusion (_internal/logical/).  Design here:
+
+  * one-to-one stages (map/filter/flat_map/map_batches) FUSE into a single
+    remote task per block — one task launch + one object-store hop per
+    block regardless of chain length;
+  * all-to-all stages (repartition/shuffle/sort) are barriers that
+    materialize their input block list;
+  * streaming consumption (iter over blocks) keeps at most `window`
+    block-tasks in flight — backpressure without a separate control plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data import block as blk
+
+
+@dataclass
+class OneToOne:
+    """A fusable per-block transform."""
+
+    fn: Callable  # block -> block
+    name: str
+
+
+@dataclass
+class AllToAll:
+    """A barrier transform over the whole block list."""
+
+    fn: Callable  # (list[ref], ctx) -> list[ref]
+    name: str
+
+
+@dataclass
+class ExecPlan:
+    """Input block refs + stage list (logical plan)."""
+
+    input_refs: List[Any]
+    stages: List[Any] = field(default_factory=list)
+
+    def with_stage(self, stage) -> "ExecPlan":
+        return ExecPlan(list(self.input_refs), self.stages + [stage])
+
+
+def _fuse(chain: List[OneToOne]) -> Callable:
+    fns = [s.fn for s in chain]
+
+    def fused(block):
+        for f in fns:
+            block = f(block)
+        return block
+
+    return fused
+
+
+@ray_tpu.remote
+def _run_block(block, fused_fn):
+    return fused_fn(block)
+
+
+def _segments(stages: List[Any]) -> List[Tuple[str, Any]]:
+    """Group consecutive OneToOne stages into fused segments."""
+    segs: List[Tuple[str, Any]] = []
+    chain: List[OneToOne] = []
+    for s in stages:
+        if isinstance(s, OneToOne):
+            chain.append(s)
+        else:
+            if chain:
+                segs.append(("fused", _fuse(chain)))
+                chain = []
+            segs.append(("barrier", s))
+    if chain:
+        segs.append(("fused", _fuse(chain)))
+    return segs
+
+
+def execute(plan: ExecPlan, window: int = 16) -> List[Any]:
+    """Materialize: returns the final block refs."""
+    refs = list(plan.input_refs)
+    for kind, seg in _segments(plan.stages):
+        if kind == "fused":
+            out = []
+            pending = {}
+            for r in refs:
+                while len(pending) >= window:
+                    done, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                           timeout=None)
+                    for d in done:
+                        pending.pop(d, None)
+                task = _run_block.remote(r, seg)
+                pending[task] = True
+                out.append(task)
+            refs = out
+            # Let stragglers finish before a subsequent barrier counts rows.
+        else:
+            refs = seg.fn(refs)
+    return refs
+
+
+def iter_output_refs(plan: ExecPlan, window: int = 8) -> Iterator[Any]:
+    """Streaming: yield final block refs one at a time, launching at most
+    `window` fused tasks ahead of the consumer (backpressure)."""
+    segs = _segments(plan.stages)
+    # Barriers force materialization of everything before them; stream only
+    # the trailing fused segment.
+    refs = list(plan.input_refs)
+    trailing: Optional[Callable] = None
+    for i, (kind, seg) in enumerate(segs):
+        is_last = i == len(segs) - 1
+        if kind == "fused" and is_last:
+            trailing = seg
+            break
+        if kind == "fused":
+            refs = [_run_block.remote(r, seg) for r in refs]
+        else:
+            refs = seg.fn(refs)
+    if trailing is None:
+        yield from refs
+        return
+    in_flight: List[Any] = []
+    src = iter(refs)
+    try:
+        while True:
+            while len(in_flight) < window:
+                try:
+                    r = next(src)
+                except StopIteration:
+                    break
+                in_flight.append(_run_block.remote(r, trailing))
+            if not in_flight:
+                return
+            yield in_flight.pop(0)
+    finally:
+        pass

@@ -1,0 +1,154 @@
+"""Dataset creation (reference: python/ray/data/read_api.py — range,
+from_items, from_pandas/from_arrow/from_numpy, read_parquet/csv/json/
+numpy/text/binary over datasource/ file readers)."""
+
+from __future__ import annotations
+
+import builtins
+import glob as _glob
+import os
+from typing import Any, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as blk
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.executor import ExecPlan
+
+DEFAULT_BLOCK_ROWS = 1000
+
+
+def _from_blocks(blocks: List[pa.Table]) -> Dataset:
+    return Dataset(ExecPlan([ray_tpu.put(b) for b in blocks]))
+
+
+def _chunk(rows: list, parallelism: int) -> List[list]:
+    n = max(1, min(parallelism, len(rows)) if rows else 1)
+    per = -(-len(rows) // n) if rows else 1
+    return [rows[i * per:(i + 1) * per] for i in builtins.range(n)
+            if i * per < len(rows)] or [[]]
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return _from_blocks([blk.rows_to_block(c)
+                         for c in _chunk(list(items), parallelism)])
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    per = -(-n // max(1, parallelism)) if n else 1
+    blocks = []
+    for start in builtins.range(0, n, per):
+        stop = min(start + per, n)
+        blocks.append(pa.table({"id": pa.array(np.arange(start, stop))}))
+    return _from_blocks(blocks or [pa.table({"id": pa.array([], pa.int64())})])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _from_blocks([pa.Table.from_pandas(df, preserve_index=False)
+                         for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _from_blocks(list(tables))
+
+
+def from_numpy(arrays, column: str = "data") -> Dataset:
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    blocks = []
+    for arr in arrays:
+        blocks.append(blk.rows_to_block([{column: row} for row in arr]))
+    return _from_blocks(blocks)
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pattern = os.path.join(p, f"*{suffix}" if suffix else "*")
+            out.extend(sorted(_glob.glob(pattern)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    import pyarrow.parquet as pq
+    files = _expand_paths(paths, ".parquet")
+
+    @ray_tpu.remote
+    def load(path):
+        return pq.read_table(path, columns=columns)
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))
+
+
+def read_csv(paths) -> Dataset:
+    import pyarrow.csv as pcsv
+    files = _expand_paths(paths, ".csv")
+
+    @ray_tpu.remote
+    def load(path):
+        return pcsv.read_csv(path)
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))
+
+
+def read_json(paths) -> Dataset:
+    import json
+
+    files = _expand_paths(paths, ".json")
+
+    @ray_tpu.remote
+    def load(path):
+        with open(path) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        return blk.rows_to_block(rows)
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))
+
+
+def read_numpy(paths, column: str = "data") -> Dataset:
+    files = _expand_paths(paths, ".npy")
+
+    @ray_tpu.remote
+    def load(path):
+        arr = np.load(path)
+        return blk.rows_to_block([{column: row} for row in arr])
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))
+
+
+def read_text(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    @ray_tpu.remote
+    def load(path):
+        with open(path) as f:
+            return blk.rows_to_block(
+                [{"text": line.rstrip("\n")} for line in f])
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))
+
+
+def read_binary_files(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    @ray_tpu.remote
+    def load(path):
+        with open(path, "rb") as f:
+            return blk.rows_to_block([{"path": path, "bytes": f.read()}])
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))

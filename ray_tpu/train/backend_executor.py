@@ -60,21 +60,48 @@ class BackendExecutor:
                 train_fn, ctx, checkpoint))
         ray_tpu.get(refs, timeout=120)
 
+    # How long some workers may keep reporting after others finished before
+    # the SPMD-mismatch diagnostic fires (a finished worker never reports
+    # again, so this only delays an error, never a success).
+    MISMATCH_GRACE_S = 60.0
+
     def get_next_results(self) -> Optional[List]:
         """One report from EVERY worker, or None when all finished.
-        Blocks until reports arrive; a dead worker surfaces as an RPC
-        error (the caller decides on restart)."""
+        A dead worker surfaces as an RPC error (the caller decides on
+        restart); a worker that FINISHES while peers still report trips the
+        SPMD-mismatch diagnostic instead of hanging forever in a collective."""
+        import time as _time
+
         wg = self.worker_group
         refs = [w.actor.get_next.remote(None) for w in wg.workers]
-        results = ray_tpu.get(refs)
-        dones = [r is None for r in results]
-        if all(dones):
+        results: List = [None] * len(refs)
+        pending = {ref: i for i, ref in enumerate(refs)}
+        got: set = set()
+        first_done_at = None
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                    timeout=5.0)
+            for r in ready:
+                i = pending.pop(r)
+                results[i] = ray_tpu.get(r)
+                got.add(i)
+            finished = [i for i in got if results[i] is None]
+            if finished and first_done_at is None:
+                first_done_at = _time.monotonic()
+            if finished and pending and first_done_at is not None \
+                    and _time.monotonic() - first_done_at \
+                    > self.MISMATCH_GRACE_S:
+                raise TrainingFailedError(
+                    "some workers finished while others are still "
+                    "reporting — the train loop must be SPMD (same number "
+                    "of report() calls on every worker)")
+        if all(r is None for r in results):
             return None
-        if any(dones):
+        if any(r is None for r in results):
             raise TrainingFailedError(
                 "some workers finished while others are still reporting — "
-                "the train loop must be SPMD (same number of report() calls "
-                "on every worker)")
+                "the train loop must be SPMD (same number of report() "
+                "calls on every worker)")
         return results
 
     def finish_training(self):

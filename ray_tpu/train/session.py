@@ -53,12 +53,13 @@ class _TrainSession:
             except BaseException as e:  # noqa: BLE001
                 self.error = e
             finally:
-                self.finished = True
-                # Wake a driver blocked in get_next().
+                # Sentinel BEFORE the finished flag: a concurrent get_next
+                # must never see finished+empty while an error is pending.
                 try:
                     self.result_queue.put(("__done__", None), timeout=0)
                 except queue.Full:
                     pass
+                self.finished = True
 
         self.thread = threading.Thread(target=run, daemon=True)
 
@@ -80,6 +81,8 @@ class _TrainSession:
         failure to the caller, not as a queue timeout, so a long-running
         train step must not be mistaken for a failure."""
         if self.finished and self.result_queue.empty():
+            if self.error is not None:
+                raise self.error
             return None
         item = self.result_queue.get(timeout=timeout)
         if item == ("__done__", None):

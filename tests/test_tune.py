@@ -46,8 +46,12 @@ def test_num_samples_random(cluster):
                        resources_per_trial={"CPU": 1})
     assert len(results) == 6
     best = results.get_best_result()
-    assert best.metrics["loss"] <= min(
-        r.metrics["loss"] for r in results if r.metrics)
+    # get_best_result must return THE argmin trial (exercises mode="min").
+    all_losses = [r.metrics["loss"] for r in results if r.metrics]
+    assert best.metrics["loss"] == min(all_losses)
+    worst_x = max(results, key=lambda r: r.metrics["loss"]).metrics
+    assert abs(best.metrics["config"]["x"] - 0.5) <= abs(
+        worst_x["config"]["x"] - 0.5)
 
 
 def test_asha_stops_bad_trials_early(cluster):
