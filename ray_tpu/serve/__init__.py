@@ -1,0 +1,22 @@
+"""ray_tpu.serve — model serving on actor replicas.
+
+Reference parity: python/ray/serve/ (SURVEY.md §2.3): controller actor with
+deployment reconciliation, replica actors hosting user callables, handle
+router with max_concurrent_queries backpressure + failure healing, HTTP
+ingress proxy, deployment-graph composition via .bind(), @serve.batch
+dynamic batching.
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve._private import DeploymentHandle  # noqa: F401

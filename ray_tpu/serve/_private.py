@@ -1,0 +1,291 @@
+"""Serve internals: controller, replica, router/handle, HTTP proxy.
+
+Reference parity: python/ray/serve/_private/ — ServeController
+(controller.py:71) reconciles DeploymentState (deployment_state.py:1006);
+replicas host user code (replica.py:268); Router round-robins with
+max_concurrent_queries backpressure (router.py:224); HTTPProxy is the
+ASGI ingress (http_proxy.py:434).  Config propagation here is pull-based
+with revalidation on failure (the reference uses long-poll; same
+eventual-consistency contract, no blocked actor threads).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+
+
+@dataclass
+class DeploymentConfig:
+    name: str
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    ray_actor_options: dict = field(default_factory=dict)
+    user_config: Any = None
+    version: int = 0
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    """Hosts one copy of the user's callable (reference: replica.py:268)."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs, user_config=None):
+        import inspect
+        if inspect.isclass(cls_or_fn):
+            self._callable = cls_or_fn(*init_args, **(init_kwargs or {}))
+        else:
+            self._callable = cls_or_fn
+        if user_config is not None and hasattr(self._callable,
+                                               "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def handle_request(self, method_name, args, kwargs):
+        target = self._callable
+        if method_name and method_name != "__call__":
+            target = getattr(self._callable, method_name)
+        elif not callable(target):
+            raise TypeError("deployment object is not callable")
+        import asyncio
+        import inspect
+        result = target(*args, **(kwargs or {}))
+        if inspect.iscoroutine(result):
+            result = asyncio.run(result)
+        return result
+
+    def reconfigure(self, user_config):
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def ping(self):
+        return "pong"
+
+
+@ray_tpu.remote
+class ServeController:
+    """Deployment table + reconciliation (reference: controller.py:71,
+    DeploymentStateManager deployment_state.py:1864)."""
+
+    def __init__(self):
+        # name -> {"config": DeploymentConfig, "replicas": [handles],
+        #          "deployed_def": (cls, args, kwargs)}
+        self._deployments: Dict[str, dict] = {}
+        self._version = 0
+
+    def deploy(self, config: DeploymentConfig, cls_or_fn, init_args,
+               init_kwargs):
+        entry = self._deployments.get(config.name)
+        if entry is None:
+            entry = {"config": config, "replicas": [],
+                     "deployed_def": (cls_or_fn, init_args, init_kwargs)}
+            self._deployments[config.name] = entry
+        else:
+            entry["config"] = config
+            entry["deployed_def"] = (cls_or_fn, init_args, init_kwargs)
+        self._reconcile(config.name)
+        self._version += 1
+        return {"name": config.name, "replicas": len(entry["replicas"])}
+
+    def _reconcile(self, name: str):
+        entry = self._deployments[name]
+        config: DeploymentConfig = entry["config"]
+        cls_or_fn, args, kwargs = entry["deployed_def"]
+        replicas: List = entry["replicas"]
+        # Health-check existing replicas; drop the dead.
+        alive = []
+        for r in replicas:
+            try:
+                ray_tpu.get(r.ping.remote(), timeout=10)
+                alive.append(r)
+            except Exception:
+                pass
+        replicas[:] = alive
+        opts = dict(config.ray_actor_options)
+        while len(replicas) < config.num_replicas:
+            actor = ReplicaActor.options(
+                num_cpus=opts.get("num_cpus", 0.1),
+                num_tpus=opts.get("num_tpus"),
+                resources=opts.get("resources"),
+                max_restarts=2,
+            ).remote(cls_or_fn, args, kwargs, config.user_config)
+            replicas.append(actor)
+        while len(replicas) > config.num_replicas:
+            victim = replicas.pop()
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+        # Verify new replicas constructed (surface user __init__ errors).
+        for r in replicas:
+            ray_tpu.get(r.ping.remote(), timeout=120)
+
+    def get_routing(self, name: str):
+        entry = self._deployments.get(name)
+        if entry is None:
+            return None
+        return {"replicas": list(entry["replicas"]),
+                "max_concurrent_queries":
+                    entry["config"].max_concurrent_queries,
+                "version": self._version}
+
+    def list_deployments(self):
+        return {name: {"num_replicas": len(e["replicas"]),
+                       "target": e["config"].num_replicas}
+                for name, e in self._deployments.items()}
+
+    def delete_deployment(self, name: str):
+        entry = self._deployments.pop(name, None)
+        if entry is None:
+            return False
+        for r in entry["replicas"]:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._version += 1
+        return True
+
+    def heal(self, name: str):
+        """Router-reported replica failure: reconcile this deployment."""
+        if name in self._deployments:
+            self._reconcile(name)
+            self._version += 1
+        return True
+
+    def shutdown(self):
+        for name in list(self._deployments):
+            self.delete_deployment(name)
+        return True
+
+
+class DeploymentHandle:
+    """Client-side handle with round-robin + in-flight cap (reference:
+    handle.py over router.py:224-263).  Picklable: travels to replicas so
+    deployments can compose."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._name = deployment_name
+        self._method = method_name
+        self._lock = threading.Lock()
+        self._replicas: List = []
+        self._max_q = 100
+        self._rr = 0
+        self._in_flight: Dict[int, int] = {}
+        self._fetched_at = 0.0
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, method_name)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+    def _refresh(self, force=False):
+        with self._lock:
+            if not force and self._replicas \
+                    and time.monotonic() - self._fetched_at < 2.0:
+                return
+            controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+            routing = ray_tpu.get(
+                controller.get_routing.remote(self._name), timeout=30)
+            if routing is None:
+                raise ValueError(f"deployment {self._name!r} not found")
+            self._replicas = routing["replicas"]
+            self._max_q = routing["max_concurrent_queries"]
+            self._fetched_at = time.monotonic()
+
+    def remote(self, *args, **kwargs):
+        return self._call(self._method, args, kwargs)
+
+    def _call(self, method, args, kwargs):
+        self._refresh()
+        deadline = time.monotonic() + 60
+        while True:
+            with self._lock:
+                n = len(self._replicas)
+                order = [(self._rr + i) % n for i in range(n)] if n else []
+                self._rr += 1
+                pick = None
+                for idx in order:
+                    if self._in_flight.get(idx, 0) < self._max_q:
+                        pick = idx
+                        break
+            if pick is not None:
+                replica = self._replicas[pick]
+                with self._lock:
+                    self._in_flight[pick] = self._in_flight.get(pick, 0) + 1
+                ref = replica.handle_request.remote(method, args, kwargs)
+                return _TrackedRef(ref, self, pick, method, args, kwargs)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {self._name!r} under its "
+                    f"max_concurrent_queries cap within 60s")
+            time.sleep(0.01)  # every replica saturated: backpressure
+
+    def _done(self, idx: int):
+        with self._lock:
+            self._in_flight[idx] = max(0, self._in_flight.get(idx, 0) - 1)
+
+    def _on_replica_error(self):
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+            ray_tpu.get(controller.heal.remote(self._name), timeout=60)
+        except Exception:
+            pass
+        self._refresh(force=True)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, self._method))
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._call(self._method, args, kwargs)
+
+
+class _TrackedRef:
+    """Wraps the reply ref to release the in-flight slot on result() and
+    retry once through a healed replica set on replica death."""
+
+    def __init__(self, ref, handle: DeploymentHandle, idx: int,
+                 method: str, args, kwargs, retried: bool = False):
+        self._ref = ref
+        self._handle = handle
+        self._idx = idx
+        self._request = (method, args, kwargs)
+        self._retried = retried
+
+    def result(self, timeout: Optional[float] = None):
+        from ray_tpu.exceptions import ActorDiedError
+        try:
+            value = ray_tpu.get(self._ref, timeout=timeout)
+        except ActorDiedError:
+            self._handle._done(self._idx)
+            if self._retried:
+                raise
+            self._handle._on_replica_error()
+            method, args, kwargs = self._request
+            retry = self._handle._call(method, args, kwargs)
+            retry._retried = True
+            return retry.result(timeout)
+        except BaseException:
+            self._handle._done(self._idx)
+            raise
+        self._handle._done(self._idx)
+        return value
+
+    @property
+    def ref(self):
+        return self._ref

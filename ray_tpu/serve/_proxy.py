@@ -1,0 +1,82 @@
+"""HTTP ingress proxy (reference: serve/_private/http_proxy.py:434 —
+one proxy actor per node running an HTTP server; here aiohttp replaces
+uvicorn/ASGI).
+
+Routing: POST/GET /{deployment} — a JSON body becomes the callable's
+single argument; the JSON-encoded return value is the response.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class HTTPProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import asyncio
+
+        from aiohttp import web
+
+        from ray_tpu.serve._private import DeploymentHandle
+
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._ready = threading.Event()
+        self._port = None
+
+        handles = self._handles
+
+        async def dispatch(request: web.Request):
+            name = request.match_info["deployment"]
+            handle = handles.get(name)
+            if handle is None:
+                handle = DeploymentHandle(name)
+                handles[name] = handle
+            if request.can_read_body:
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    payload = (await request.read()).decode()
+            else:
+                payload = dict(request.query) or None
+            loop = asyncio.get_running_loop()
+
+            def call():
+                try:
+                    tracked = handle.remote(payload)
+                    return tracked.result(timeout=60), None
+                except ValueError as e:
+                    return None, (404, str(e))
+                except Exception as e:  # noqa: BLE001
+                    return None, (500, f"{type(e).__name__}: {e}")
+
+            result, err = await loop.run_in_executor(None, call)
+            if err is not None:
+                return web.json_response({"error": err[1]}, status=err[0])
+            return web.json_response({"result": result})
+
+        async def healthz(_):
+            return web.Response(text="ok")
+
+        def serve_forever():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            app = web.Application()
+            app.router.add_get("/-/healthz", healthz)
+            app.router.add_route("*", "/{deployment}", dispatch)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, host, port)
+            loop.run_until_complete(site.start())
+            self._port = site._server.sockets[0].getsockname()[1]
+            self._ready.set()
+            loop.run_forever()
+
+        threading.Thread(target=serve_forever, daemon=True).start()
+        self._ready.wait(30)
+
+    def address(self):
+        return self._port

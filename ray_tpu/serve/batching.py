@@ -1,0 +1,103 @@
+"""@serve.batch — dynamic request batching (reference: serve/batching.py).
+
+Calls made within `batch_wait_timeout_s` (or until `max_batch_size`
+accumulates) are combined into ONE invocation of the wrapped function with
+a list argument; each caller gets its own element of the returned list.
+TPU rationale: batching is how a replica keeps the MXU fed — many 1-item
+requests become one batched forward pass.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int, wait_s: float):
+        self._orig_fn = fn
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = wait_s
+        self._lock = threading.Lock()
+        self._items: List = []
+        self._events: List[threading.Event] = []
+        self._results: dict = {}
+        self._timer: Optional[threading.Timer] = None
+
+    def __reduce__(self):
+        # Locks/timers are process-local; a pickled queue restarts empty.
+        return (_BatchQueue, (self._orig_fn, self._max, self._wait))
+
+    def submit(self, item):
+        event = threading.Event()
+        with self._lock:
+            self._items.append(item)
+            self._events.append(event)
+            my_index = len(self._items) - 1
+            flush = len(self._items) >= self._max
+            if not flush and self._timer is None:
+                self._timer = threading.Timer(self._wait, self._flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if flush:
+            self._flush()
+        event.wait()
+        with self._lock:
+            outcome = self._results.pop(event)
+        if isinstance(outcome, BaseException):
+            raise outcome
+        return outcome
+
+    def _flush(self):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            items, events = self._items, self._events
+            self._items, self._events = [], []
+        if not items:
+            return
+        try:
+            outputs = self._fn(items)
+            if len(outputs) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(outputs)} results "
+                    f"for {len(items)} inputs")
+            outcomes = list(outputs)
+        except BaseException as e:  # noqa: BLE001
+            outcomes = [e] * len(items)
+        with self._lock:
+            for ev, out in zip(events, outcomes):
+                self._results[ev] = out
+        for ev in events:
+            ev.set()
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: fn(list_of_items) -> list_of_results becomes callable as
+    fn(item) -> result with automatic batching."""
+
+    def wrap(fn):
+        # The queue is created lazily per process (it holds locks/timers,
+        # which must never travel inside a pickled deployment class).
+        holder: dict = {"queue": None}
+
+        @functools.wraps(fn)
+        def wrapped(*args):
+            if holder["queue"] is None:
+                holder["queue"] = _BatchQueue(fn, max_batch_size,
+                                              batch_wait_timeout_s)
+            queue = holder["queue"]
+            # Support both free functions fn(items) and methods
+            # self.fn(items): the batched element is the LAST positional.
+            item = args[-1]
+            if len(args) == 2:  # bound method: rebind fn with self once
+                queue._fn = fn.__get__(args[0], type(args[0]))
+            return queue.submit(item)
+
+        return wrapped
+
+    return wrap(_fn) if _fn is not None else wrap
