@@ -17,6 +17,7 @@ main thread, fed by a queue from the RPC handlers.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import os
 import queue
@@ -130,9 +131,20 @@ class CoreWorker:
         self.borrowed: dict[ObjectID, str] = {}  # borrowed ref -> owner addr
         self._put_index = 0
         self._obj_lock = threading.RLock()
-        self.current_task_id = TaskID.of()    # driver context task
-        self.current_task_spec = None
+        # Per-task execution context.  ContextVars isolate it both across
+        # pool threads AND across interleaved coroutines on an async actor's
+        # event loop (each asyncio.Task runs in its own context copy) —
+        # thread-locals would be clobbered by concurrent async tasks.
+        self._ctx_task_id: contextvars.ContextVar = \
+            contextvars.ContextVar("raytpu_task_id", default=None)
+        self._ctx_task_spec: contextvars.ContextVar = \
+            contextvars.ContextVar("raytpu_task_spec", default=None)
+        self._default_task_id = TaskID.of()   # driver context task
         self.current_actor_pg = None          # PG the actor was created in
+        # Actor execution concurrency (set up at actor creation).
+        self._exec_pool = None                # ThreadPoolExecutor | None
+        self._async_loop = None               # asyncio loop thread | None
+        self._async_sem: asyncio.Semaphore | None = None
         self.address = ""
         self._shutdown = False
         # Execution side (worker mode)
@@ -145,6 +157,30 @@ class CoreWorker:
         port = self.io.run(self.server.start(0))
         self.address = f"{host}:{port}"
         object_ref_mod._install_hooks(_RefHooks(self))
+
+    # ---- per-task execution context ----------------------------------
+
+    @property
+    def current_task_id(self) -> TaskID:
+        tid = self._ctx_task_id.get()
+        return self._default_task_id if tid is None else tid
+
+    @current_task_id.setter
+    def current_task_id(self, value):
+        self._ctx_task_id.set(value)
+
+    @property
+    def current_task_spec(self):
+        return self._ctx_task_spec.get()
+
+    @current_task_spec.setter
+    def current_task_spec(self, value):
+        self._ctx_task_spec.set(value)
+
+    def _next_put_index(self) -> int:
+        with self._obj_lock:
+            self._put_index += 1
+            return self._put_index
 
     # ------------------------------------------------------------------
     # RPC services (owner + execution)
@@ -270,8 +306,7 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def put(self, value) -> ObjectRef:
-        self._put_index += 1
-        oid = ObjectID.for_put(self.current_task_id, self._put_index)
+        oid = ObjectID.for_put(self.current_task_id, self._next_put_index())
         sv = ser.serialize(value, ref_sink=self._pin_serialized_ref)
         self._store_owned_value(oid, sv)
         return ObjectRef(oid, self.address)
@@ -541,8 +576,7 @@ class CoreWorker:
         if sv.total_size >= INLINE_LIMIT:
             # Promote big args to the object store (reference: args >100KB go
             # through plasma, _raylet.pyx submit_task).
-            self._put_index += 1
-            oid = ObjectID.for_put(self.current_task_id, self._put_index)
+            oid = ObjectID.for_put(self.current_task_id, self._next_put_index())
             self._store_owned_value(oid, sv)
             st = self.objects[oid]
             st.pins += 1
@@ -780,6 +814,7 @@ class CoreWorker:
             owner_address=self.address,
             actor_id=actor_id,
             actor_creation=True,
+            max_concurrency=opts.get("max_concurrency") or 0,
             placement_group=_pg_id_of(opts.get("placement_group")),
             bundle_index=opts.get("placement_group_bundle_index", -1),
         )
@@ -1020,14 +1055,102 @@ class CoreWorker:
 
     def run_task_loop(self):
         """Blocks executing tasks until KillActor/shutdown
-        (reference: CoreWorker::RunTaskExecutionLoop via default_worker.py)."""
+        (reference: CoreWorker::RunTaskExecutionLoop via default_worker.py).
+
+        Actor tasks are dispatched by the actor's concurrency mode
+        (reference: transport/concurrency_group_manager.h):
+        - default: run inline on this thread, strictly serialized;
+        - max_concurrency>1: run on a thread pool of that size;
+        - async actor (any coroutine method): scheduled on a dedicated
+          asyncio loop, bounded by a semaphore.
+        """
         while True:
             item = self.exec_queue.get()
             if item is None:
                 break
             spec, done, loop = item
-            reply = self._execute_task(spec)
-            loop.call_soon_threadsafe(
+            is_actor_call = spec.actor_id is not None and not spec.actor_creation
+            if is_actor_call and self._async_loop is not None:
+                asyncio.run_coroutine_threadsafe(
+                    self._execute_actor_async(spec, done, loop),
+                    self._async_loop)
+            elif is_actor_call and self._exec_pool is not None:
+                self._exec_pool.submit(self._run_one, spec, done, loop)
+            else:
+                self._run_one(spec, done, loop)
+        if self._exec_pool is not None:
+            self._exec_pool.shutdown(wait=False)
+        if self._async_loop is not None:
+            self._async_loop.call_soon_threadsafe(self._async_loop.stop)
+
+    def _run_one(self, spec: TaskSpec, done, loop):
+        reply = self._execute_task(spec)
+        loop.call_soon_threadsafe(
+            lambda d=done, r=reply: d.done() or d.set_result(r))
+
+    def _setup_actor_execution(self, cls, spec: TaskSpec):
+        """Choose the actor's execution mode after __init__ succeeds.
+        spec.max_concurrency: 0 = unset; async actors then default to the
+        reference's 1000, sync actors to 1 (an EXPLICIT 1 on an async actor
+        serializes its tasks, as in the reference)."""
+        import inspect as _inspect
+        is_async = any(
+            _inspect.iscoroutinefunction(getattr(cls, name, None))
+            for name in dir(cls) if not name.startswith("__"))
+        mc = spec.max_concurrency
+        if is_async:
+            limit = mc if mc > 0 else 1000
+            loop = asyncio.new_event_loop()
+            self._async_loop = loop
+            self._async_sem = asyncio.Semaphore(limit)
+            threading.Thread(target=loop.run_forever, daemon=True,
+                             name="actor-async-exec").start()
+        elif mc > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            self._exec_pool = ThreadPoolExecutor(
+                max_workers=mc, thread_name_prefix="actor-exec")
+
+    def _pack_reply(self, spec: TaskSpec, result) -> dict:
+        return {"returns": self._pack_returns(spec, result), "error": None}
+
+    def _error_reply(self, spec: TaskSpec, e: BaseException) -> dict:
+        tb = traceback.format_exc()
+        logger.info("task %s failed:\n%s", spec.name, tb)
+        err = e if isinstance(e, (TaskError, ActorDiedError)) \
+            else TaskError(spec.name, tb, None)
+        return {"returns": [], "error": err}
+
+    async def _execute_actor_async(self, spec: TaskSpec, done, reply_loop):
+        """Async-actor execution path: every method runs on the actor's
+        event loop (reference semantics — a blocking sync method blocks the
+        loop; use a threaded actor for blocking work).  Arg resolution may
+        touch the network, so it runs in an executor, concurrently."""
+        import inspect as _inspect
+        async with self._async_sem:
+            try:
+                loop = asyncio.get_running_loop()
+                arg_vals, kw_vals = await asyncio.gather(
+                    asyncio.gather(*[
+                        loop.run_in_executor(None, self._resolve_arg, a)
+                        for a in spec.args]),
+                    asyncio.gather(*[
+                        loop.run_in_executor(None, self._resolve_arg, v)
+                        for v in spec.kwargs.values()]))
+                kwargs = dict(zip(spec.kwargs.keys(), kw_vals))
+                if self.actor_instance is None:
+                    raise ActorDiedError(spec.actor_id, "no instance")
+                self.current_task_id = spec.task_id
+                self.current_task_spec = spec
+                method = getattr(self.actor_instance, spec.method_name)
+                result = method(*arg_vals, **kwargs)
+                if _inspect.iscoroutine(result):
+                    result = await result
+                reply = self._pack_reply(spec, result)
+            except BaseException as e:  # noqa: BLE001
+                reply = self._error_reply(spec, e)
+            finally:
+                self.current_task_spec = None
+            reply_loop.call_soon_threadsafe(
                 lambda d=done, r=reply: d.done() or d.set_result(r))
 
     def _execute_task(self, spec: TaskSpec) -> dict:
@@ -1040,24 +1163,22 @@ class CoreWorker:
                 cls = self.io.run(self.fn_manager.fetch(spec.fn_key))
                 self.current_actor_pg = spec.placement_group
                 self.actor_instance = cls(*args, **kwargs)
+                self._setup_actor_execution(cls, spec)
                 return {"returns": [], "error": None}
             if spec.actor_id is not None:
                 if self.actor_instance is None:
                     raise ActorDiedError(spec.actor_id, "no instance")
                 method = getattr(self.actor_instance, spec.method_name)
                 result = method(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    # Sync-mode actor with an occasional async method.
+                    result = asyncio.run(result)
             else:
                 fn = self.io.run(self.fn_manager.fetch(spec.fn_key))
                 result = fn(*args, **kwargs)
-            return {"returns": self._pack_returns(spec, result), "error": None}
+            return self._pack_reply(spec, result)
         except BaseException as e:  # noqa: BLE001
-            tb = traceback.format_exc()
-            logger.info("task %s failed:\n%s", spec.name, tb)
-            if isinstance(e, (TaskError, ActorDiedError)):
-                err = e
-            else:
-                err = TaskError(spec.name, tb, None)
-            return {"returns": [], "error": err}
+            return self._error_reply(spec, e)
         finally:
             # Don't leak this task's context (e.g. its placement group) to
             # whatever runs on this reused worker next.

@@ -84,6 +84,10 @@ class TaskSpec:
     actor_creation: bool = False             # this task constructs an actor
     method_name: str = ""
     seq_no: int = 0               # per-handle ordering for actor tasks
+    # Execution concurrency for the created actor; 0 = unset, so the worker
+    # can apply per-mode defaults (async actors: 1000, sync: 1).  Reference:
+    # core_worker/transport/concurrency_group_manager.h + thread_pool.h.
+    max_concurrency: int = 0
     # Scheduling hints
     placement_group: Optional[PlacementGroupID] = None
     bundle_index: int = -1
@@ -159,7 +163,7 @@ def option_defaults(for_actor: bool = False) -> dict:
     if for_actor:
         common.update({
             "max_restarts": 0, "max_task_retries": 0, "lifetime": None,
-            "namespace": None, "max_concurrency": 1, "get_if_exists": False,
+            "namespace": None, "max_concurrency": None, "get_if_exists": False,
         })
     else:
         common.update({

@@ -114,6 +114,9 @@ class ServeController:
                 num_tpus=opts.get("num_tpus"),
                 resources=opts.get("resources"),
                 max_restarts=2,
+                # Replicas must execute up to max_concurrent_queries requests
+                # at once, or @serve.batch could never accumulate a batch.
+                max_concurrency=config.max_concurrent_queries,
             ).remote(cls_or_fn, args, kwargs, config.user_config)
             replicas.append(actor)
         while len(replicas) > config.num_replicas:
