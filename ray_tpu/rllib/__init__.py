@@ -1,0 +1,34 @@
+"""ray_tpu.rllib — the RL library: rollout-worker actor fleets + JAX learners.
+
+Reference parity: /root/reference/rllib/ (Algorithm:
+algorithms/algorithm.py:149, PPO: algorithms/ppo/ppo.py:343, IMPALA:
+algorithms/impala/impala.py:509, RolloutWorker:
+evaluation/rollout_worker.py:166, WorkerSet: evaluation/worker_set.py:79,
+SampleBatch: policy/sample_batch.py) re-architected TPU-first: learners are
+single jitted XLA programs (multi-chip via shard_map data-parallel
+learners), rollouts are natively vectorized numpy envs on CPU actors.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.env import (  # noqa: F401
+    CartPoleVector,
+    Env,
+    VectorEnv,
+    make_vector_env,
+    register_env,
+)
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerThread  # noqa: F401
+from ray_tpu.rllib.learner import JaxLearner, ppo_loss  # noqa: F401
+from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
+from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae  # noqa: F401
+from ray_tpu.rllib.vtrace import vtrace  # noqa: F401
+from ray_tpu.rllib.worker_set import WorkerSet  # noqa: F401
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "CartPoleVector", "Env", "VectorEnv",
+    "IMPALA", "IMPALAConfig", "JaxLearner", "JaxPolicy", "LearnerThread",
+    "PPO", "PPOConfig", "RolloutWorker", "SampleBatch", "WorkerSet",
+    "compute_gae", "make_vector_env", "ppo_loss", "register_env", "vtrace",
+]

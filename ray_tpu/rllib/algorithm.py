@@ -1,0 +1,196 @@
+"""Algorithm: the RL training driver, runnable standalone or under Tune.
+
+Reference parity: rllib/algorithms/algorithm.py:149 (extends tune.Trainable;
+setup:503, step:754, evaluate:847, save/restore) and
+rllib/algorithms/algorithm_config.py (typed fluent config).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.worker_set import WorkerSet
+
+
+class AlgorithmConfig:
+    """Fluent config.  Reference: algorithm_config.py
+    (.environment().rollouts().training().resources())."""
+
+    def __init__(self, algo_class=None):
+        self.algo_class = algo_class
+        self.env: Any = "CartPole-v1"
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 8
+        self.rollout_fragment_length = 64
+        self.num_cpus_per_worker = 1.0
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.lr = 3e-4
+        self.grad_clip = 0.5
+        self.train_batch_size = 1024
+        self.sgd_minibatch_size = 128
+        self.num_sgd_iter = 8
+        self.model_hidden = (64, 64)
+        self.seed = 0
+        self.extra: Dict[str, Any] = {}
+
+    # fluent setters ------------------------------------------------------
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def resources(self, *, num_cpus_per_worker: Optional[float] = None
+                  ) -> "AlgorithmConfig":
+        if num_cpus_per_worker is not None:
+            self.num_cpus_per_worker = num_cpus_per_worker
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: v for k, v in self.__dict__.items()
+             if k not in ("algo_class", "extra")}
+        d.update(self.extra)
+        return d
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use PPOConfig() etc.")
+        return self.algo_class(self)
+
+
+class Algorithm:
+    """Base RL driver: owns a WorkerSet + learner; .train() = one iteration."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        # Probe the env spec once, locally, to size the model.
+        probe = make_vector_env(config.env, 1, seed=config.seed)
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self.iteration = 0
+        self.total_env_steps = 0
+        self._episode_returns: collections.deque = collections.deque(
+            maxlen=100)
+        self._episode_lengths: collections.deque = collections.deque(
+            maxlen=100)
+        self._start = time.time()
+        self.setup()
+
+    # -- subclass hooks ----------------------------------------------------
+    def setup(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- public ------------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        """One training iteration.  Reference: Algorithm.step:754."""
+        result = self.training_step()
+        self.iteration += 1
+        rets = list(self._episode_returns)
+        result.update({
+            "training_iteration": self.iteration,
+            "timesteps_total": self.total_env_steps,
+            "episode_reward_mean": float(np.mean(rets)) if rets else np.nan,
+            "episode_reward_max": float(np.max(rets)) if rets else np.nan,
+            "episode_reward_min": float(np.min(rets)) if rets else np.nan,
+            "episode_len_mean": (float(np.mean(self._episode_lengths))
+                                 if self._episode_lengths else np.nan),
+            "episodes_this_iter": result.get("episodes_this_iter", 0),
+            "time_total_s": time.time() - self._start,
+        })
+        return result
+
+    def _record_metrics(self, metrics_list) -> int:
+        """Fold worker sample metrics into the running episode window."""
+        episodes = 0
+        for m in metrics_list:
+            self._episode_returns.extend(m.get("episode_returns", []))
+            self._episode_lengths.extend(m.get("episode_lengths", []))
+            episodes += len(m.get("episode_returns", []))
+            self.total_env_steps += m.get("env_steps", 0)
+        return episodes
+
+    def save_to_dict(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def save(self) -> Checkpoint:
+        """Reference: Algorithm.save / rllib/utils/checkpoints.py."""
+        state = self.save_to_dict()
+        state["iteration"] = self.iteration
+        state["total_env_steps"] = self.total_env_steps
+        return Checkpoint.from_dict(state)
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        state = checkpoint.to_dict()
+        self.iteration = state.get("iteration", 0)
+        self.total_env_steps = state.get("total_env_steps", 0)
+        self.restore_from_dict(state)
+
+    def stop(self) -> None:
+        if getattr(self, "workers", None) is not None:
+            self.workers.stop()
+
+    # -- Tune integration --------------------------------------------------
+    @classmethod
+    def as_trainable(cls, config: AlgorithmConfig, *,
+                     stop_iters: int = 1000,
+                     stop_reward: Optional[float] = None):
+        """Wrap into a Tune function trainable.
+
+        Reference: Algorithm IS a tune.Trainable (algorithm.py:149); here
+        Tune's unit is a session function, so the adapter loops train() and
+        reports each iteration.
+        """
+        from ray_tpu import tune
+
+        def _trainable(tune_config: Dict[str, Any]):
+            cfg = config
+            for k, v in (tune_config or {}).items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            algo = cls(cfg)
+            try:
+                for _ in range(stop_iters):
+                    result = algo.train()
+                    tune.report(result)
+                    if (stop_reward is not None
+                            and result["episode_reward_mean"] >= stop_reward):
+                        break
+            finally:
+                algo.stop()
+        return _trainable

@@ -1,0 +1,47 @@
+"""Policy/value networks for the RL stack, in flax.
+
+Reference parity: rllib/models/ (ModelCatalog fcnet defaults) and the
+minimal JAX stack the reference sketches in rllib/models/jax/fcnet.py —
+here the JAX model IS the primary stack, not an afterthought.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ActorCritic(nn.Module):
+    """Separate-trunk MLP actor-critic with orthogonal init (PPO-standard)."""
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        ortho = nn.initializers.orthogonal
+        x = obs
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h, kernel_init=ortho(np.sqrt(2)))(x))
+        logits = nn.Dense(self.num_actions, kernel_init=ortho(0.01))(x)
+
+        v = obs
+        for h in self.hidden:
+            v = nn.tanh(nn.Dense(h, kernel_init=ortho(np.sqrt(2)))(v))
+        value = nn.Dense(1, kernel_init=ortho(1.0))(v)
+        return logits, jnp.squeeze(value, axis=-1)
+
+
+def make_model(obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)):
+    """Returns (init_params(rng), apply(params, obs) -> (logits, value))."""
+    model = ActorCritic(num_actions=num_actions, hidden=tuple(hidden))
+
+    def init_params(rng: jax.Array):
+        dummy = jnp.zeros((1, obs_dim), jnp.float32)
+        return model.init(rng, dummy)
+
+    return init_params, model.apply

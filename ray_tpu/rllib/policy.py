@@ -1,0 +1,86 @@
+"""JaxPolicy: action sampling + weight get/set, shared by workers and
+learners.
+
+Reference parity: rllib/policy/policy.py (compute_actions,
+get_weights/set_weights) — reduced to the functional JAX shape: params are
+a pytree, inference is one jitted pure function.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.models import make_model
+
+
+class JaxPolicy:
+    """Categorical-action policy over an ActorCritic model.
+
+    Inference is pinned to the host CPU backend by default: rollout
+    policies are tiny, env stepping is CPU-bound, and a fleet of rollout
+    actors must never contend for (or round-trip through) the TPU chip —
+    the chip belongs to the learner.
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64), seed: int = 0,
+                 force_cpu: bool = True):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self._device = None
+        if force_cpu and jax.default_backend() != "cpu":
+            self._device = jax.local_devices(backend="cpu")[0]
+        init_params, self.apply = make_model(obs_dim, num_actions, hidden)
+
+        def _sample(params, obs, rng):
+            logits, value = self.apply(params, obs)
+            action = jax.random.categorical(rng, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(action.shape[0]), action]
+            return action, logp, value, logits
+
+        def _greedy(params, obs):
+            logits, value = self.apply(params, obs)
+            return jnp.argmax(logits, axis=-1), value
+
+        with self._ctx():
+            self.params = init_params(jax.random.key(seed))
+            self._rng = jax.random.key(seed + 1)
+            self._sample = jax.jit(_sample)
+            self._greedy = jax.jit(_greedy)
+
+    def _ctx(self):
+        if self._device is None:
+            return contextlib.nullcontext()
+        return jax.default_device(self._device)
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                   np.ndarray]:
+        """Returns (actions, logp, vf_preds, logits) as numpy."""
+        with self._ctx():
+            obs = jnp.asarray(obs, jnp.float32)
+            if explore:
+                self._rng, sub = jax.random.split(self._rng)
+                a, logp, v, logits = self._sample(self.params, obs, sub)
+                return (np.asarray(a), np.asarray(logp), np.asarray(v),
+                        np.asarray(logits))
+            a, v = self._greedy(self.params, obs)
+            z = np.zeros(len(obs), np.float32)
+            return np.asarray(a), z, np.asarray(v), None
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        _, _, v, _ = self.compute_actions(obs)
+        return v
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        with self._ctx():
+            self.params = jax.device_put(weights)

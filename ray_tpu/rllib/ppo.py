@@ -1,0 +1,87 @@
+"""PPO: Proximal Policy Optimization.
+
+Reference parity: rllib/algorithms/ppo/ppo.py:343 (training_step:384 —
+synchronous parallel sample -> standardize -> minibatch SGD -> weight
+broadcast) with the loss of ppo_torch_policy.py.  TPU-first difference:
+the whole SGD phase is one jitted XLA program (see learner.py) and weight
+broadcast is one object-store put.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import JaxLearner, ppo_loss
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.worker_set import WorkerSet
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        self.clip_param = 0.2
+        self.vf_clip_param = 100.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.005
+        self.lr = 5e-4
+        self.train_batch_size = 4096
+        self.sgd_minibatch_size = 256
+        self.num_sgd_iter = 10
+
+
+class PPO(Algorithm):
+    def setup(self) -> None:
+        cfg = self.config
+        self.workers = WorkerSet(
+            num_workers=cfg.num_rollout_workers,
+            num_cpus_per_worker=cfg.num_cpus_per_worker,
+            worker_kwargs=dict(
+                env=cfg.env, num_envs=cfg.num_envs_per_worker,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                gamma=cfg.gamma, lam=cfg.lambda_,
+                hidden=cfg.model_hidden, seed=cfg.seed, postprocess=True))
+        self.learner = JaxLearner(
+            self.obs_dim, self.num_actions, loss_fn=ppo_loss,
+            config={
+                "lr": cfg.lr, "grad_clip": cfg.grad_clip,
+                "num_sgd_iter": cfg.num_sgd_iter,
+                "sgd_minibatch_size": cfg.sgd_minibatch_size,
+                "clip_param": getattr(cfg, "clip_param", 0.2),
+                "vf_clip_param": getattr(cfg, "vf_clip_param", 10.0),
+                "vf_loss_coeff": getattr(cfg, "vf_loss_coeff", 0.5),
+                "entropy_coeff": getattr(cfg, "entropy_coeff", 0.0),
+            },
+            hidden=cfg.model_hidden, seed=cfg.seed)
+        self.workers.sync_weights(self.learner.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        """Reference: ppo.py:384."""
+        # 1. Synchronous parallel sampling until train_batch_size rows.
+        batches, all_metrics = [], []
+        rows = 0
+        while rows < self.config.train_batch_size:
+            bs, ms = self.workers.sample_sync()
+            batches.extend(bs)
+            all_metrics.extend(ms)
+            rows += sum(b.count for b in bs)
+        train_batch = SampleBatch.concat_samples(batches)
+        episodes = self._record_metrics(all_metrics)
+
+        # 2. Minibatch SGD — one jitted XLA program.
+        learner_metrics = self.learner.update(train_batch)
+
+        # 3. Weight broadcast via object store.
+        self.workers.sync_weights(self.learner.get_weights())
+
+        return {"sampled_rows": train_batch.count,
+                "episodes_this_iter": episodes,
+                **{f"learner/{k}": v for k, v in learner_metrics.items()}}
+
+    def save_to_dict(self) -> Dict[str, Any]:
+        return {"learner_state": self.learner.get_state(),
+                "config": self.config.to_dict()}
+
+    def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        self.learner.set_state(state["learner_state"])
+        self.workers.sync_weights(self.learner.get_weights())

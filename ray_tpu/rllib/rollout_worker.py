@@ -1,0 +1,159 @@
+"""RolloutWorker: experience collection on (CPU) actors.
+
+Reference parity: rllib/evaluation/rollout_worker.py:166 (sample:879,
+get_weights:1718/set_weights:1756) + sampler.py's env loop (_env_runner:529).
+Differences are deliberate and TPU-first: the env is natively vectorized
+(one numpy step for all sub-envs), the policy forward pass is one jitted
+call per timestep over the whole env batch, and postprocessing (GAE) is
+vectorized over the fragment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+
+def _force_cpu_platform_if_worker() -> None:
+    """Pin jax to the CPU platform inside remote worker processes.
+
+    Must run before the process's first jax computation (config changes
+    after backend init are ignored).  JAX_PLATFORMS env alone is not
+    enough: the TPU bootstrap re-selects its platform at import time.
+    """
+    try:
+        from ray_tpu import api
+        if api._worker is None or api._worker.mode != "worker":
+            return
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+class RolloutWorker:
+    """Steps a vectorized env with the current policy and emits SampleBatches.
+
+    Runs as a ray_tpu actor (one per CPU slot) but is also directly usable
+    in-process (the local-worker mode the reference uses for num_workers=0).
+    """
+
+    def __init__(self, env: Any, *, num_envs: int = 8,
+                 rollout_fragment_length: int = 64,
+                 gamma: float = 0.99, lam: float = 0.95,
+                 hidden=(64, 64), seed: int = 0,
+                 postprocess: bool = True):
+        # In a remote worker process, force the whole jax platform to CPU
+        # before the first jax use: rollout actors must not even initialize
+        # the TPU runtime (one chip, many actor processes).  In the driver
+        # the platform is left alone (the learner owns the chip) and the
+        # policy pins itself to the CPU backend instead.
+        _force_cpu_platform_if_worker()
+        self.env = make_vector_env(env, num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.fragment_length = rollout_fragment_length
+        self.gamma, self.lam = gamma, lam
+        self.postprocess = postprocess
+        self.policy = JaxPolicy(self.env.observation_dim,
+                                self.env.num_actions, hidden, seed=seed)
+        self.obs = self.env.reset_all(seed)
+        self._total_steps = 0
+
+    # -- weights -----------------------------------------------------------
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self) -> Tuple[SampleBatch, Dict]:
+        """Collect one fragment: [T, B] steps, T=fragment_length, B=num_envs.
+
+        Returns (batch, metrics).  With postprocess=True the batch is
+        flattened to [T*B] rows with GAE advantages/value targets (PPO
+        path); otherwise it stays time-major [T, B, ...] with behavior
+        logits (IMPALA/V-trace path).
+        """
+        T, B = self.fragment_length, self.num_envs
+        obs_buf = np.empty((T, B, self.env.observation_dim), np.float32)
+        act_buf = np.empty((T, B), np.int32)
+        rew_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), np.bool_)
+        trunc_buf = np.empty((T, B), np.bool_)
+        logp_buf = np.empty((T, B), np.float32)
+        vf_buf = np.empty((T, B), np.float32)
+        logits_buf = np.empty((T, B, self.env.num_actions), np.float32)
+
+        obs = self.obs
+        for t in range(T):
+            actions, logp, vf, logits = self.policy.compute_actions(obs)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            vf_buf[t] = vf
+            logits_buf[t] = logits
+            obs, rew, term, trunc = self.env.step(actions)
+            rew_buf[t] = rew
+            term_buf[t] = term
+            trunc_buf[t] = trunc
+        self.obs = obs
+        self._total_steps += T * B
+
+        rets, lens = self.env.drain_episode_metrics()
+        metrics = {"episode_returns": rets, "episode_lengths": lens,
+                   "env_steps": T * B, "total_env_steps": self._total_steps}
+
+        if not self.postprocess:
+            batch = SampleBatch({
+                SampleBatch.OBS: obs_buf, SampleBatch.ACTIONS: act_buf,
+                SampleBatch.REWARDS: rew_buf,
+                SampleBatch.TERMINATEDS: term_buf,
+                SampleBatch.TRUNCATEDS: trunc_buf,
+                SampleBatch.ACTION_LOGP: logp_buf,
+                SampleBatch.ACTION_LOGITS: logits_buf,
+                "bootstrap_obs": self.obs,
+            })
+            return batch, metrics
+
+        # GAE. Episodes end at terminated|truncated (auto-reset envs); a
+        # truncated boundary still cuts the advantage chain, which slightly
+        # underestimates returns there but keeps the fragment math simple.
+        done = term_buf | trunc_buf
+        _, _, bootstrap_vf, _ = self.policy.compute_actions(self.obs)
+        adv, targets = compute_gae(rew_buf, vf_buf, done, bootstrap_vf,
+                                   self.gamma, self.lam)
+        flat = lambda x: x.reshape((T * B,) + x.shape[2:])
+        batch = SampleBatch({
+            SampleBatch.OBS: flat(obs_buf),
+            SampleBatch.ACTIONS: flat(act_buf),
+            SampleBatch.ACTION_LOGP: flat(logp_buf),
+            SampleBatch.VF_PREDS: flat(vf_buf),
+            SampleBatch.ADVANTAGES: flat(adv),
+            SampleBatch.VALUE_TARGETS: flat(targets),
+        })
+        return batch, metrics
+
+    def evaluate(self, num_episodes: int = 10,
+                 max_steps: int = 1000) -> Dict:
+        """Greedy-policy evaluation rollouts."""
+        self.env.drain_episode_metrics()
+        returns: list = []
+        obs = self.obs
+        steps = 0
+        while len(returns) < num_episodes and steps < max_steps:
+            actions, _, _, _ = self.policy.compute_actions(obs, explore=False)
+            obs, _, _, _ = self.env.step(actions)
+            steps += 1
+            rets, _ = self.env.drain_episode_metrics()
+            returns.extend(rets)
+        self.obs = obs
+        return {"episode_returns": returns}
+
+    def ping(self) -> bool:
+        return True

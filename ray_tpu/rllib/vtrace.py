@@ -1,0 +1,55 @@
+"""V-trace off-policy correction (IMPALA), as a jittable lax.scan.
+
+Reference parity: rllib/algorithms/impala/vtrace_torch.py — the
+importance-weighted value targets and policy-gradient advantages of
+Espeholt et al. 2018, computed here as one reverse lax.scan over the
+time-major fragment so the whole thing fuses into the learner's XLA
+program.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class VTraceReturns(NamedTuple):
+    vs: jnp.ndarray            # [T, B] value targets
+    pg_advantages: jnp.ndarray  # [T, B]
+
+
+def vtrace(behavior_logp: jnp.ndarray, target_logp: jnp.ndarray,
+           rewards: jnp.ndarray, discounts: jnp.ndarray,
+           values: jnp.ndarray, bootstrap_value: jnp.ndarray,
+           clip_rho_threshold: float = 1.0,
+           clip_c_threshold: float = 1.0) -> VTraceReturns:
+    """All args time-major [T, B]; bootstrap_value [B].
+
+    discounts must already include termination masking
+    (gamma * (1 - done)).
+    """
+    log_rhos = target_logp - behavior_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho_threshold, rhos)
+    cs = jnp.minimum(clip_c_threshold, rhos)
+
+    values_tp1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    def backward(acc, xs):
+        delta_t, disc_t, c_t = xs
+        acc = delta_t + disc_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+    return VTraceReturns(vs=jax.lax.stop_gradient(vs),
+                         pg_advantages=jax.lax.stop_gradient(pg_advantages))

@@ -1,0 +1,146 @@
+"""WorkerSet: the actor fleet of RolloutWorkers.
+
+Reference parity: rllib/evaluation/worker_set.py:79 (sync_weights:384,
+foreach_worker:676, async foreach:776) and the fault-tolerance behavior of
+rllib/utils/actor_manager.py:189 (FaultTolerantActorManager): failed
+workers are detected on RPC error, replaced, and the fleet keeps going.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+logger = logging.getLogger("ray_tpu.rllib")
+
+
+class WorkerSet:
+    def __init__(self, *, num_workers: int, worker_kwargs: Dict[str, Any],
+                 num_cpus_per_worker: float = 1,
+                 restart_failed_workers: bool = True,
+                 max_failed_rounds: int = 3):
+        # Ship registered env creators by value: remote worker processes
+        # have a fresh registry, so a NAME would resolve there to whatever
+        # that process's registry holds (or nothing) — shipping the
+        # driver's creator keeps local and remote envs identical even when
+        # a built-in name was re-registered.  (Reference ships creators
+        # via tune registry + GCS KV.)
+        from ray_tpu.rllib import env as env_mod
+        env = worker_kwargs.get("env")
+        if isinstance(env, str) and env in env_mod._ENV_REGISTRY:
+            worker_kwargs = dict(worker_kwargs,
+                                 env=env_mod._ENV_REGISTRY[env])
+        self._worker_kwargs = worker_kwargs
+        self._max_failed_rounds = max_failed_rounds
+        self._consecutive_failed_rounds = 0
+        self._num_cpus = num_cpus_per_worker
+        self._restart = restart_failed_workers
+        self._remote_cls = ray_tpu.remote(num_cpus=num_cpus_per_worker)(
+            RolloutWorker)
+        self._workers: List[Any] = [
+            self._make_worker(i) for i in range(num_workers)]
+        # The local worker evaluates and holds canonical weights alongside
+        # the learner (reference: WorkerSet.local_worker()).
+        self.local_worker = RolloutWorker(**worker_kwargs)
+
+    def _make_worker(self, index: int):
+        kwargs = dict(self._worker_kwargs)
+        kwargs["seed"] = kwargs.get("seed", 0) + 1000 * (index + 1)
+        return self._remote_cls.remote(**kwargs)
+
+    @property
+    def num_remote_workers(self) -> int:
+        return len(self._workers)
+
+    def sync_weights(self, weights: Optional[Any] = None) -> None:
+        """Broadcast weights to every remote worker via one object-store put.
+
+        Reference: worker_set.py:384 — weights go through the object store
+        so the payload is stored once and each worker pulls it.
+        """
+        if weights is None:
+            weights = self.local_worker.get_weights()
+        else:
+            self.local_worker.set_weights(weights)
+        if not self._workers:
+            return
+        ref = ray_tpu.put(weights)
+        self._foreach_with_recovery(lambda w: w.set_weights.remote(ref))
+
+    def sample_sync(self) -> Tuple[List[SampleBatch], List[Dict]]:
+        """One synchronous sampling round across all remote workers.
+
+        Reference: rllib/execution/rollout_ops.py:21
+        (synchronous_parallel_sample).  With zero remote workers, samples
+        from the local worker (reference num_workers=0 mode).
+        """
+        if not self._workers:
+            batch, metrics = self.local_worker.sample()
+            return [batch], [metrics]
+        results = self._foreach_with_recovery(lambda w: w.sample.remote())
+        batches = [b for b, _ in results]
+        metrics = [m for _, m in results]
+        return batches, metrics
+
+    def sample_async(self) -> List[Tuple[Any, Any]]:
+        """Kick off sample() on every worker; returns [(worker, ref)]."""
+        return [(w, w.sample.remote()) for w in self._workers]
+
+    def foreach_worker(self, fn: Callable[[Any], Any]) -> List[Any]:
+        return self._foreach_with_recovery(fn)
+
+    def _foreach_with_recovery(self, fn) -> List[Any]:
+        refs = [(i, fn(w)) for i, w in enumerate(self._workers)]
+        results: List[Any] = []
+        failed: List[int] = []
+        last_error: Exception | None = None
+        for i, ref in refs:
+            try:
+                results.append(ray_tpu.get(ref))
+            except Exception as e:  # actor died: replace and continue
+                logger.warning("rollout worker %d failed: %s", i, e)
+                failed.append(i)
+                last_error = e
+        # A deterministic failure (bad env creator, unpicklable kwarg...)
+        # would otherwise loop forever replacing dead workers: surface it
+        # after max_failed_rounds rounds with zero survivors.
+        if results or not refs:
+            self._consecutive_failed_rounds = 0
+        else:
+            self._consecutive_failed_rounds += 1
+            if self._consecutive_failed_rounds >= self._max_failed_rounds:
+                raise RuntimeError(
+                    f"all {len(refs)} rollout workers failed "
+                    f"{self._consecutive_failed_rounds} rounds in a row; "
+                    f"last error: {last_error!r}") from last_error
+        if failed and self._restart:
+            for i in failed:
+                self._workers[i] = self._make_worker(i)
+                try:
+                    ref = ray_tpu.put(self.local_worker.get_weights())
+                    ray_tpu.get(self._workers[i].set_weights.remote(ref))
+                except Exception:
+                    pass
+        return results
+
+    def replace_worker(self, worker) -> Any:
+        """Replace a specific (failed) worker actor; returns the new one."""
+        i = self._workers.index(worker)
+        self._workers[i] = self._make_worker(i)
+        return self._workers[i]
+
+    def stop(self) -> None:
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self._workers = []
+
+    @property
+    def remote_workers(self) -> List[Any]:
+        return list(self._workers)
