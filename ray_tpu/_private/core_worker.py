@@ -22,7 +22,9 @@ import logging
 import os
 import queue
 import threading
+import time
 import traceback
+from collections import deque
 from dataclasses import dataclass, field
 
 from ray_tpu import object_ref as object_ref_mod
@@ -85,6 +87,8 @@ class _PendingTask:
     retries_left: int
     future: object                       # concurrent.futures.Future | None
     lineage: bool = False                # keep spec for reconstruction
+    cancelled: bool = False              # ray.cancel requested
+    worker_address: str | None = None    # where the task was pushed
 
 
 class _ActorSubmitter:
@@ -127,6 +131,16 @@ class CoreWorker:
         self.objects: dict[ObjectID, _ObjectState] = {}
         self.tasks: dict[TaskID, _PendingTask] = {}
         self._pg_rr: dict = {}  # placement group -> round-robin counter
+        # Lease pipelining (reference: direct_task_transport.h:53-55,151 —
+        # queued tasks with the same SchedulingKey reuse a held worker
+        # lease instead of paying pick_node+lease+return per task).
+        self._lease_cache: dict = {}      # sched_key -> _KeyScheduler
+        self._free_buffer: dict = {}      # node_id -> [oid binary]
+        self._free_flusher = None
+        # Execution-side cancellation state (reference: CancelTask:433).
+        self._cancelled_exec: set = set()
+        self._running_tasks: dict = {}    # TaskID -> executing thread id
+        self._cancel_lock = threading.Lock()
         self.actor_submitters: dict[ActorID, _ActorSubmitter] = {}
         self.borrowed: dict[ObjectID, str] = {}  # borrowed ref -> owner addr
         self._put_index = 0
@@ -189,6 +203,7 @@ class CoreWorker:
     def _register_services(self):
         s = self.server
         s.register("CoreWorker", "PushTask", self._rpc_push_task)
+        s.register("CoreWorker", "CancelTask", self._rpc_cancel_task)
         s.register("CoreWorker", "CreateActor", self._rpc_create_actor)
         s.register("CoreWorker", "KillActor", self._rpc_kill_actor)
         s.register("CoreWorker", "GetObjectStatus", self._rpc_get_object_status)
@@ -252,6 +267,29 @@ class CoreWorker:
         return {"ok": True}
 
     # ---- execution services ----
+
+    async def _rpc_cancel_task(self, req):
+        """Cancel a queued or running task on this worker (reference:
+        core_worker.proto CancelTask:433).  Queued -> dropped; running with
+        force -> process exit; running without force -> async exception
+        injected into the executing thread.  The injection happens under
+        _cancel_lock, which _execute_task also holds while registering/
+        deregistering, so the exception cannot target a thread that has
+        already moved on to a different task."""
+        from ray_tpu.exceptions import TaskCancelledError
+        task_id = TaskID(req["task_id"])
+        self._cancelled_exec.add(task_id)
+        with self._cancel_lock:
+            tid = self._running_tasks.get(task_id)
+            if tid is not None:
+                if req.get("force"):
+                    logger.info("force-cancel: exiting worker (task %s)",
+                                task_id)
+                    os._exit(1)
+                import ctypes
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError))
+        return {"ok": True, "running": tid is not None}
 
     async def _rpc_push_task(self, req):
         """Queue a task for the execution thread and await its result
@@ -583,14 +621,69 @@ class CoreWorker:
             return RefArg(oid.binary(), self.address)
         return ValueArg(sv.to_bytes(), sv.metadata)
 
+    def cancel_task(self, ref: ObjectRef, force: bool = False,
+                    recursive: bool = True):
+        """Cancel the task producing `ref` (reference: worker.py
+        ray.cancel:2793 + core_worker.proto CancelTask:433)."""
+        st = self.objects.get(ref.id)
+        if st is None or st.producing_task is None:
+            raise ValueError(
+                "ray_tpu.cancel() only supports task returns; use "
+                "ray_tpu.kill() for actors")
+        pending = self.tasks.get(st.producing_task)
+        if pending is None or not st.pending:
+            return  # already finished
+        pending.cancelled = True
+        self.io.run(self._cancel_pending(pending, force), timeout=15)
+
+    async def _cancel_pending(self, pending: _PendingTask, force: bool):
+        from ray_tpu.exceptions import TaskCancelledError
+        task_id = pending.spec.task_id
+        # Still queued client-side: drop it from its key scheduler.
+        for sched in list(self._lease_cache.values()):
+            for item in list(sched.queue):
+                spec, fut = item
+                if spec.task_id == task_id:
+                    try:
+                        sched.queue.remove(item)
+                    except ValueError:
+                        continue
+                    if not fut.done():
+                        fut.set_exception(TaskCancelledError(
+                            f"task {spec.name} cancelled"))
+                    sched._maybe_gc()
+                    return
+        # Already pushed: cancel at the executing worker.
+        if pending.worker_address:
+            try:
+                await self.pool.get(pending.worker_address).call(
+                    "CoreWorker", "CancelTask",
+                    {"task_id": task_id.binary(), "force": force},
+                    timeout=10)
+            except Exception:
+                pass
+
     async def _run_task_to_completion(self, task_id: TaskID):
+        from ray_tpu.exceptions import TaskCancelledError
         pending = self.tasks.get(task_id)
         spec = pending.spec
         exclude: list = []
         while True:
+            if pending.cancelled:
+                self._complete_task_error(
+                    spec, TaskCancelledError(f"task {spec.name} cancelled"))
+                return
             try:
                 reply = await self._submit_once(spec, exclude)
+            except TaskCancelledError as e:
+                self._complete_task_error(spec, e)
+                return
             except _RetryableSubmitError as e:
+                if pending.cancelled:
+                    self._complete_task_error(
+                        spec,
+                        TaskCancelledError(f"task {spec.name} cancelled"))
+                    return
                 if e.busy:
                     # Saturated cluster: keep queueing, don't burn retries
                     # (the reference queues tasks in the raylet indefinitely).
@@ -612,64 +705,54 @@ class CoreWorker:
                 return
             err = reply.get("error")
             if err is not None and spec.retry_exceptions \
-                    and pending.retries_left > 0:
+                    and pending.retries_left > 0 \
+                    and not pending.cancelled \
+                    and not isinstance(err, TaskCancelledError):
                 pending.retries_left -= 1
                 continue
             self._complete_task_reply(spec, reply)
             return
 
+    def _sched_key(self, spec: TaskSpec, exclude) -> tuple:
+        """Reference SchedulingKey (direct_task_transport.h:53-55):
+        tasks with identical scheduling requirements share leases."""
+        return (tuple(sorted(spec.resources.to_dict().items())),
+                spec.scheduling_strategy,
+                spec.placement_group.hex() if spec.placement_group else None,
+                spec.bundle_index, spec.node_affinity, tuple(exclude))
+
+    async def _push_on_lease(self, spec: TaskSpec, lease: dict):
+        reply = await self.pool.get(lease["worker_address"]).call(
+            "CoreWorker", "PushTask",
+            {"spec": spec, "caller": self.worker_id.binary()},
+            timeout=None)
+        return reply
+
+    async def _return_lease(self, lease: dict, kill: bool = False):
+        try:
+            await self.pool.get(lease["node_address"]).call(
+                "NodeManager", "ReturnWorker",
+                {"lease_id": lease["lease_id"], "kill": kill}, timeout=5)
+        except Exception:
+            pass
+
+    async def _drain_leases(self):
+        scheds = list(self._lease_cache.values())
+        self._lease_cache.clear()
+        for sched in scheds:
+            await sched.drain()
+
     async def _submit_once(self, spec: TaskSpec, exclude):
-        # 1. pick node.  Placement-group tasks go straight to the bundle's
-        # node (the PG already reserved the resources there); everything
-        # else asks the GCS resource view (spillback = exclude + repick).
-        bundle = None
-        if spec.placement_group is not None:
-            node, bundle = await self._resolve_bundle(spec)
-        else:
-            pick = await self.gcs.call("Gcs", "pick_node", {
-                "resources": spec.resources.to_dict(),
-                "strategy": spec.scheduling_strategy,
-                "exclude": exclude,
-                "node_affinity": spec.node_affinity,
-            })
-            node = pick["node"]
-        if node is None:
-            if exclude:
-                raise _RetryableSubmitError("all feasible nodes excluded",
-                                            None, busy=True)
-            raise ValueError(
-                f"no node can satisfy resources "
-                f"{spec.resources.to_dict()} for task {spec.name}")
-        # 2. lease worker from that node's daemon
-        try:
-            lease = await self.pool.get(node.address).call(
-                "NodeManager", "LeaseWorker",
-                {"resources": spec.resources.to_dict(),
-                 "job_id": self._job_int(), "bundle": bundle}, timeout=60)
-        except Exception as e:
-            raise _RetryableSubmitError(f"lease rpc failed: {e}", node.node_id)
-        if not lease.get("granted"):
-            raise _RetryableSubmitError(
-                f"lease rejected: {lease.get('reason')}", node.node_id,
-                busy=lease.get("reason") in ("busy", "resources"))
-        worker_addr = lease["worker_address"]
-        # 3. push task directly to the leased worker
-        try:
-            reply = await self.pool.get(worker_addr).call(
-                "CoreWorker", "PushTask",
-                {"spec": spec, "caller": self.worker_id.binary()},
-                timeout=None)
-            return reply
-        except Exception as e:
-            self.pool.invalidate(worker_addr)
-            raise _RetryableSubmitError(f"worker died: {e}", node.node_id)
-        finally:
-            try:
-                await self.pool.get(node.address).call(
-                    "NodeManager", "ReturnWorker",
-                    {"lease_id": lease["lease_id"]}, timeout=5)
-            except Exception:
-                pass
+        """Queue the task under its scheduling key; the per-key scheduler
+        pipelines queued tasks onto held worker leases (reference:
+        direct_task_transport.h OnWorkerIdle:151, lease request rate
+        limiting :59)."""
+        key = self._sched_key(spec, exclude)
+        sched = self._lease_cache.get(key)
+        if sched is None:
+            sched = self._lease_cache[key] = _KeyScheduler(
+                self, key, spec, list(exclude))
+        return await sched.submit(spec)
 
     async def _resolve_bundle(self, spec: TaskSpec):
         """Map (placement_group, bundle_index) to the bundle's node + lease
@@ -1039,15 +1122,33 @@ class CoreWorker:
         self.tasks.pop(st.producing_task, None)
 
     async def _free_locations(self, oid: ObjectID, locations):
-        nodes = await self._node_table()
+        """Buffer frees and flush batched (one FreeObjects RPC per node per
+        flush window) — per-object RPCs would clog the daemon under churn."""
         for loc in locations:
-            addr = nodes.get(loc)
-            if addr:
-                try:
-                    await self.pool.get(addr).call(
-                        "NodeManager", "FreeObject", {"id": oid.binary()})
-                except Exception:
-                    pass
+            self._free_buffer.setdefault(loc, []).append(oid.binary())
+        if self._free_flusher is None or self._free_flusher.done():
+            self._free_flusher = asyncio.ensure_future(self._flush_frees())
+
+    async def _flush_frees(self):
+        # Loop until the buffer is empty at a non-awaiting point: frees
+        # that arrive DURING the RPC awaits below must not strand until
+        # some later free reschedules the flusher.
+        while True:
+            await asyncio.sleep(0.05)
+            buffered, self._free_buffer = self._free_buffer, {}
+            if not buffered:
+                return
+            nodes = await self._node_table()
+            for loc, ids in buffered.items():
+                addr = nodes.get(loc)
+                if addr:
+                    try:
+                        await self.pool.get(addr).call(
+                            "NodeManager", "FreeObjects", {"ids": ids})
+                    except Exception:
+                        pass
+            if not self._free_buffer:
+                return
 
     # ------------------------------------------------------------------
     # Execution loop (worker mode)
@@ -1084,7 +1185,12 @@ class CoreWorker:
             self._async_loop.call_soon_threadsafe(self._async_loop.stop)
 
     def _run_one(self, spec: TaskSpec, done, loop):
-        reply = self._execute_task(spec)
+        try:
+            reply = self._execute_task(spec)
+        except BaseException as e:  # noqa: BLE001 - e.g. a cancel async-exc
+            # landing in the sliver between the task body returning and the
+            # running-task deregistration; don't kill the exec thread.
+            reply = self._error_reply(spec, e)
         loop.call_soon_threadsafe(
             lambda d=done, r=reply: d.done() or d.set_result(r))
 
@@ -1096,7 +1202,8 @@ class CoreWorker:
         import inspect as _inspect
         is_async = any(
             _inspect.iscoroutinefunction(getattr(cls, name, None))
-            for name in dir(cls) if not name.startswith("__"))
+            for name in dir(cls)
+            if not name.startswith("__") or name == "__call__")
         mc = spec.max_concurrency
         if is_async:
             limit = mc if mc > 0 else 1000
@@ -1114,9 +1221,11 @@ class CoreWorker:
         return {"returns": self._pack_returns(spec, result), "error": None}
 
     def _error_reply(self, spec: TaskSpec, e: BaseException) -> dict:
+        from ray_tpu.exceptions import TaskCancelledError
         tb = traceback.format_exc()
         logger.info("task %s failed:\n%s", spec.name, tb)
-        err = e if isinstance(e, (TaskError, ActorDiedError)) \
+        err = e if isinstance(e, (TaskError, ActorDiedError,
+                                  TaskCancelledError)) \
             else TaskError(spec.name, tb, None)
         return {"returns": [], "error": err}
 
@@ -1129,13 +1238,20 @@ class CoreWorker:
         async with self._async_sem:
             try:
                 loop = asyncio.get_running_loop()
+
+                async def resolve(a):
+                    # Inline ValueArgs deserialize in-memory — no executor
+                    # hop; only ObjectRef args (which may hit the network)
+                    # go to the thread pool.
+                    if isinstance(a, ValueArg):
+                        return self._resolve_arg(a)
+                    return await loop.run_in_executor(
+                        None, self._resolve_arg, a)
+
                 arg_vals, kw_vals = await asyncio.gather(
-                    asyncio.gather(*[
-                        loop.run_in_executor(None, self._resolve_arg, a)
-                        for a in spec.args]),
-                    asyncio.gather(*[
-                        loop.run_in_executor(None, self._resolve_arg, v)
-                        for v in spec.kwargs.values()]))
+                    asyncio.gather(*[resolve(a) for a in spec.args]),
+                    asyncio.gather(*[resolve(v)
+                                     for v in spec.kwargs.values()]))
                 kwargs = dict(zip(spec.kwargs.keys(), kw_vals))
                 if self.actor_instance is None:
                     raise ActorDiedError(spec.actor_id, "no instance")
@@ -1154,6 +1270,13 @@ class CoreWorker:
                 lambda d=done, r=reply: d.done() or d.set_result(r))
 
     def _execute_task(self, spec: TaskSpec) -> dict:
+        from ray_tpu.exceptions import TaskCancelledError
+        if spec.task_id in self._cancelled_exec:
+            self._cancelled_exec.discard(spec.task_id)
+            return {"returns": [],
+                    "error": TaskCancelledError(f"task {spec.name} cancelled")}
+        with self._cancel_lock:
+            self._running_tasks[spec.task_id] = threading.get_ident()
         try:
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
@@ -1180,6 +1303,9 @@ class CoreWorker:
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(spec, e)
         finally:
+            with self._cancel_lock:
+                self._running_tasks.pop(spec.task_id, None)
+            self._cancelled_exec.discard(spec.task_id)
             # Don't leak this task's context (e.g. its placement group) to
             # whatever runs on this reused worker next.
             self.current_task_spec = None
@@ -1224,6 +1350,10 @@ class CoreWorker:
     def shutdown(self):
         self._shutdown = True
         object_ref_mod._install_hooks(None)
+        try:
+            self.io.run(self._drain_leases(), timeout=5)
+        except Exception:
+            pass
         if self.mode == "driver":
             # Job-scoped cleanup: non-detached placement groups (and their
             # reserved bundles) die with the driver (reference: GCS job
@@ -1259,6 +1389,195 @@ class CoreWorker:
 
     async def await_ref(self, ref: ObjectRef):
         return await self._get_one(ref, None)
+
+
+class _KeyScheduler:
+    """Per-SchedulingKey task queue + lease pool.
+
+    Reference: CoreWorkerDirectTaskSubmitter (direct_task_transport.h:75) —
+    tasks queue client-side by key; worker leases are requested at a capped
+    rate while the queue is non-empty; each granted lease executes queued
+    tasks back-to-back (OnWorkerIdle) with ONE PushTask RPC per task; idle
+    leases are returned after a TTL.
+    """
+
+    MAX_PENDING_LEASES = 16   # reference: max_pending_lease_requests
+    IDLE_TTL = 1.0
+
+    def __init__(self, worker: "CoreWorker", key: tuple, proto_spec,
+                 exclude: list):
+        self.worker = worker
+        self.key = key
+        self.proto_spec = proto_spec     # any spec with this key (for pick)
+        self.exclude = exclude
+        self.queue: deque = deque()
+        self.idle: list = []             # idle held leases
+        self.held = 0                    # granted leases not yet returned
+        self.pending_leases = 0          # in-flight LeaseWorker RPCs
+        self._reaper = None
+
+    # -- public -----------------------------------------------------------
+    async def submit(self, spec) -> dict:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.queue.append((spec, fut))
+        self._pump()
+        return await fut
+
+    async def drain(self):
+        if self._reaper is not None:
+            self._reaper.cancel()
+            await asyncio.gather(self._reaper, return_exceptions=True)
+            self._reaper = None
+        idle, self.idle = self.idle, []
+        for lease in idle:
+            self.held -= 1
+            await self.worker._return_lease(lease)
+
+    # -- internals ---------------------------------------------------------
+    def _pump(self):
+        while self.queue and self.idle:
+            spec, fut = self.queue.popleft()
+            lease = self.idle.pop()
+            asyncio.ensure_future(self._run_on_lease(spec, fut, lease))
+        want = min(len(self.queue) - self.pending_leases,
+                   self.MAX_PENDING_LEASES - self.pending_leases
+                   - self.held)
+        for _ in range(max(0, want)):
+            self.pending_leases += 1
+            asyncio.ensure_future(self._acquire_lease())
+
+    def _fail_one(self, exc: BaseException):
+        """Deliver a lease failure to one queued task (its retry loop in
+        _run_task_to_completion decides what happens next)."""
+        while self.queue:
+            spec, fut = self.queue.popleft()
+            if not fut.done():
+                fut.set_exception(exc)
+                return
+
+    def _maybe_gc(self):
+        """Drop this scheduler from the cache when fully idle — otherwise
+        keys that never got a lease (failed/excluded nodes) accumulate."""
+        if not self.queue and not self.idle and not self.held \
+                and not self.pending_leases:
+            if self._reaper is not None:
+                self._reaper.cancel()
+                self._reaper = None
+            self.worker._lease_cache.pop(self.key, None)
+
+    async def _run_on_lease(self, spec, fut, lease):
+        pending = self.worker.tasks.get(spec.task_id)
+        if pending is not None:
+            pending.worker_address = lease["worker_address"]
+        try:
+            reply = await self.worker._push_on_lease(spec, lease)
+        except Exception as e:
+            self.worker.pool.invalidate(lease["worker_address"])
+            self.held -= 1
+            await self.worker._return_lease(lease, kill=True)
+            if not fut.done():
+                fut.set_exception(_RetryableSubmitError(
+                    f"worker died: {e}", lease.get("node_id")))
+            self._pump()
+            return
+        if not fut.done():
+            fut.set_result(reply)
+        lease["idle_since"] = time.monotonic()
+        self.idle.append(lease)
+        if self._reaper is None:
+            self._reaper = asyncio.ensure_future(self._reap_idle())
+        self._pump()
+
+    async def _acquire_lease(self):
+        worker = self.worker
+        spec = self.proto_spec
+        try:
+            bundle = None
+            if spec.placement_group is not None:
+                node, bundle = await worker._resolve_bundle(spec)
+            else:
+                pick = await worker.gcs.call("Gcs", "pick_node", {
+                    "resources": spec.resources.to_dict(),
+                    "strategy": spec.scheduling_strategy,
+                    "exclude": self.exclude,
+                    "node_affinity": spec.node_affinity,
+                })
+                node = pick["node"]
+            if node is None:
+                if self.exclude:
+                    raise _RetryableSubmitError(
+                        "all feasible nodes excluded", None, busy=True)
+                raise ValueError(
+                    f"no node can satisfy resources "
+                    f"{spec.resources.to_dict()} for task {spec.name}")
+            try:
+                lease = await worker.pool.get(node.address).call(
+                    "NodeManager", "LeaseWorker",
+                    {"resources": spec.resources.to_dict(),
+                     "job_id": worker._job_int(), "bundle": bundle},
+                    timeout=60)
+            except Exception as e:
+                raise _RetryableSubmitError(f"lease rpc failed: {e}",
+                                            node.node_id)
+            if not lease.get("granted"):
+                raise _RetryableSubmitError(
+                    f"lease rejected: {lease.get('reason')}", node.node_id,
+                    busy=lease.get("reason") in ("busy", "resources"))
+        except BaseException as e:  # noqa: BLE001 - routed to a queued task
+            self.pending_leases -= 1
+            # A busy rejection while we HOLD leases is not a task failure:
+            # queued tasks are draining through the held workers; failing
+            # one would send it to the back of the queue after a pointless
+            # 0.1s sleep.  Only surface busy when no progress is possible.
+            busy = isinstance(e, _RetryableSubmitError) and e.busy
+            if busy and (self.held > 0 or self.pending_leases > 0):
+                return
+            if not isinstance(e, _RetryableSubmitError):
+                # Permanent infeasibility applies to EVERY queued task with
+                # this key — failing just one would strand the rest.
+                while self.queue:
+                    self._fail_one(e)
+                self._maybe_gc()
+                return
+            self._fail_one(e)
+            # Re-pump: remaining queued tasks still need leases, and the
+            # task we just failed may never resubmit (cancelled, retries
+            # exhausted) — without this they'd strand with no lease
+            # requests in flight.
+            self._pump()
+            self._maybe_gc()
+            return
+        self.pending_leases -= 1
+        self.held += 1
+        lease["node_address"] = node.address
+        lease["node_id"] = node.node_id
+        lease["idle_since"] = time.monotonic()
+        self.idle.append(lease)
+        if self._reaper is None:
+            self._reaper = asyncio.ensure_future(self._reap_idle())
+        self._pump()
+
+    async def _reap_idle(self):
+        try:
+            while True:
+                await asyncio.sleep(self.IDLE_TTL / 2)
+                now = time.monotonic()
+                keep, expire = [], []
+                for lease in self.idle:
+                    (expire if now - lease["idle_since"] > self.IDLE_TTL
+                     else keep).append(lease)
+                self.idle = keep
+                for lease in expire:
+                    self.held -= 1
+                    await self.worker._return_lease(lease)
+                if not self.idle and not self.queue and not self.held \
+                        and not self.pending_leases:
+                    self.worker._lease_cache.pop(self.key, None)
+                    self._reaper = None
+                    return
+        except asyncio.CancelledError:
+            pass
 
 
 class _RefHooks:

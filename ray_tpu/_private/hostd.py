@@ -382,6 +382,14 @@ class NodeDaemon:
         self.store.delete(ObjectID(req["id"]))
         return {"ok": True}
 
+    async def free_objects(self, req):
+        """Batched form: owners buffer freed ids and flush one RPC
+        (reference: raylet FreeObjects batches plasma deletions)."""
+        from ray_tpu._private.ids import ObjectID
+        for id_binary in req["ids"]:
+            self.store.delete(ObjectID(id_binary))
+        return {"ok": True}
+
     async def store_stats(self, req):
         return self.store.stats()
 
@@ -463,6 +471,7 @@ class NodeDaemon:
         self.server.register("NodeManager", "PullObject", self.pull_object)
         self.server.register("NodeManager", "PushObject", self.push_object)
         self.server.register("NodeManager", "FreeObject", self.free_object)
+        self.server.register("NodeManager", "FreeObjects", self.free_objects)
         self.server.register("NodeManager", "StoreStats", self.store_stats)
         self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
         port = await self.server.start(port)

@@ -122,6 +122,7 @@ class RpcClient:
     def __init__(self, address: str):
         self.address = address
         self._channel = None  # created lazily inside the running event loop
+        self._callables: dict[str, Any] = {}
 
     def _chan(self):
         if self._channel is None:
@@ -129,13 +130,22 @@ class RpcClient:
                 self.address, options=_OPTIONS)
         return self._channel
 
+    def _callable(self, path: str):
+        # MultiCallable construction is surprisingly expensive in grpc.aio
+        # (~ms); cache one per method path (reference: generated stubs hold
+        # them for the process lifetime).
+        rpc = self._callables.get(path)
+        if rpc is None:
+            rpc = self._chan().unary_unary(
+                path, request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            self._callables[path] = rpc
+        return rpc
+
     async def call(self, service: str, method: str, request: Any = None,
                    timeout: float | None = None) -> Any:
         path = f"/raytpu.{service}/{method}"
-        rpc = self._chan().unary_unary(
-            path, request_serializer=lambda b: b,
-            response_deserializer=lambda b: b)
-        data = await rpc(_dumps(request), timeout=timeout)
+        data = await self._callable(path)(_dumps(request), timeout=timeout)
         if data[:1] == b"\x02":
             raise RpcError(path, pickle.loads(data[1:]))
         return _loads(data)

@@ -172,7 +172,28 @@ def kill(actor, *, no_restart: bool = True):
 
 
 def cancel(ref, *, force: bool = False, recursive: bool = True):
-    raise NotImplementedError("task cancellation lands with the C++ transport")
+    """Cancel a pending or running task (reference: worker.py
+    ray.cancel:2793).  force=False interrupts the running task with
+    TaskCancelledError; force=True kills the executing worker process.
+
+    recursive=True is accepted for reference compatibility, but
+    cancellation is NOT yet propagated to child tasks spawned by the
+    cancelled task — a warning is logged when this could matter.
+    """
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("cancel() expects an ObjectRef")
+    if recursive:
+        global _warned_recursive_cancel
+        if not _warned_recursive_cancel:
+            _warned_recursive_cancel = True
+            import logging
+            logging.getLogger("ray_tpu").warning(
+                "cancel(recursive=True): child-task cancellation is not "
+                "yet propagated; only the target task is cancelled")
+    _get_worker().cancel_task(ref, force, recursive)
+
+
+_warned_recursive_cancel = False
 
 
 def get_actor(name: str, namespace: str = "default") -> "ActorHandle":

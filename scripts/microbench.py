@@ -1,0 +1,137 @@
+"""Core runtime microbenchmarks.
+
+Reference parity: python/ray/_private/ray_perf.py:93-305 (`ray
+microbenchmark`) — put/get ops/s, task submit+get sync and pipelined,
+1:1 actor calls sync and pipelined, async-actor calls.
+
+Writes MICROBENCH.json at the repo root:
+    {"<bench>": {"ops_s": N, "n": N}, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu  # noqa: E402
+
+
+def timeit(name, fn, n, results):
+    # Warmup round.
+    fn(max(1, n // 10))
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    ops = n / dt
+    results[name] = {"ops_s": round(ops, 1), "n": n}
+    print(f"{name:32s} {ops:10,.1f} ops/s   ({n} ops in {dt:.2f}s)")
+
+
+def main():
+    ray_tpu.init(num_cpus=8, object_store_memory=256 << 20)
+    results: dict = {}
+
+    # --- object store ------------------------------------------------------
+    payload = b"x" * 100
+
+    def put_small(n):
+        for _ in range(n):
+            ray_tpu.put(payload)
+
+    timeit("put_small_100B", put_small, 2000, results)
+
+    ref = ray_tpu.put(payload)
+
+    def get_small(n):
+        for _ in range(n):
+            ray_tpu.get(ref)
+
+    timeit("get_small_100B", get_small, 2000, results)
+
+    import numpy as np
+    big = np.zeros(1 << 20, np.uint8)  # 1 MiB
+
+    def put_1mb(n):
+        for _ in range(n):
+            ray_tpu.put(big)
+
+    timeit("put_1MiB", put_1mb, 500, results)
+
+    # --- tasks -------------------------------------------------------------
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    def task_sync(n):
+        for _ in range(n):
+            ray_tpu.get(nop.remote())
+
+    timeit("task_sync_roundtrip", task_sync, 200, results)
+
+    def task_pipelined(n):
+        ray_tpu.get([nop.remote() for _ in range(n)])
+
+    timeit("task_pipelined", task_pipelined, 1000, results)
+
+    # --- actors ------------------------------------------------------------
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def inc(self):
+            self.x += 1
+            return self.x
+
+    actor = Counter.remote()
+    ray_tpu.get(actor.inc.remote())
+
+    def actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(actor.inc.remote())
+
+    timeit("actor_sync_roundtrip", actor_sync, 500, results)
+
+    def actor_pipelined(n):
+        ray_tpu.get([actor.inc.remote() for _ in range(n)])
+
+    timeit("actor_pipelined", actor_pipelined, 2000, results)
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def ping(self):
+            return 1
+
+    aactor = AsyncActor.remote()
+    ray_tpu.get(aactor.ping.remote())
+
+    def async_actor_pipelined(n):
+        ray_tpu.get([aactor.ping.remote() for _ in range(n)])
+
+    timeit("async_actor_pipelined", async_actor_pipelined, 2000, results)
+
+    # --- scaling: many concurrent tasks -----------------------------------
+    @ray_tpu.remote
+    def sleep10ms():
+        time.sleep(0.01)
+        return None
+
+    def many_sleepers(n):
+        ray_tpu.get([sleep10ms.remote() for _ in range(n)])
+
+    timeit("tasks_10ms_x500_concurrent", many_sleepers, 500, results)
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MICROBENCH.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {out}")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,8 @@
 """End-to-end core runtime tests (reference: python/ray/tests/test_basic_1.py
 and test_actor.py coverage patterns) against a real multi-process cluster."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -306,3 +308,86 @@ def test_async_actor(cluster):
     # 8 x 0.3s sleeps overlapped on one loop: far below the serial 2.4s.
     assert elapsed < 2.0
     assert ray_tpu.get(w.peak_concurrency.remote()) > 1
+
+
+def test_cancel_queued_task(cluster):
+    """A task still queued client-side is dropped without running
+    (reference: ray.cancel worker.py:2793)."""
+    from ray_tpu.exceptions import TaskCancelledError
+
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(5)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    blocker = hog.remote()          # consumes every CPU slot
+    time.sleep(0.3)
+    victim = queued.remote()        # cannot schedule while hog runs
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=30)
+    assert ray_tpu.get(blocker, timeout=30) == "hog"
+
+
+def test_cancel_running_task(cluster):
+    """force=False interrupts the running task thread."""
+    from ray_tpu.exceptions import TaskCancelledError
+
+    @ray_tpu.remote
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            time.sleep(0.01)
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start executing
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_force_kills_worker(cluster):
+    from ray_tpu.exceptions import TaskCancelledError, WorkerCrashedError
+
+    @ray_tpu.remote(max_retries=0)
+    def spin():
+        time.sleep(30)
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises((TaskCancelledError, WorkerCrashedError)):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_checkpoint_directory_roundtrip(tmp_path):
+    """Directory checkpoints with arbitrary files survive the dict form
+    (ADVICE r1: to_dict used to drop everything but checkpoint.pkl)."""
+    import pickle
+
+    from ray_tpu.air import Checkpoint
+
+    src = tmp_path / "ckpt"
+    (src / "nested").mkdir(parents=True)
+    (src / "weights.bin").write_bytes(b"\x00\x01\x02" * 100)
+    (src / "nested" / "meta.txt").write_text("hello")
+
+    ckpt = Checkpoint.from_directory(str(src))
+    # Cross a (simulated) process boundary: pickle -> dict form.
+    ckpt2 = pickle.loads(pickle.dumps(ckpt))
+    out = ckpt2.to_directory(str(tmp_path / "restored"))
+    assert (tmp_path / "restored" / "weights.bin").read_bytes() == \
+        b"\x00\x01\x02" * 100
+    assert (tmp_path / "restored" / "nested" / "meta.txt").read_text() == \
+        "hello"
+
+    # Dict-form checkpoints still round-trip through directories.
+    c3 = Checkpoint.from_dict({"step": 7})
+    d = c3.to_directory(str(tmp_path / "dictform"))
+    assert Checkpoint.from_directory(d).to_dict()["step"] == 7
