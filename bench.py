@@ -25,7 +25,7 @@ def main():
     from ray_tpu.models import gpt
 
     cfg = gpt.CONFIGS["gpt2-small"]
-    batch, seq = 8, 1024
+    batch, seq = 16, 1024    # b16 measured fastest per-token (PERF.md)
 
     init_state, train_step = gpt.make_train_step(cfg, optax.adamw(1e-4))
     state = init_state(jax.random.key(0))

@@ -47,6 +47,10 @@ class GPTConfig:
     capacity_factor: float = 1.25
     remat: bool = False
     tie_embeddings: bool = True
+    # lax.scan unroll factor over layers.  Unrolling lets XLA fuse and
+    # schedule across layer boundaries (measured +33% on one chip, PERF.md)
+    # at the cost of compile time; keep 1 for very deep/remat configs.
+    scan_unroll: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -60,10 +64,11 @@ CONFIGS = {
     "nano-moe": GPTConfig(vocab_size=512, n_layers=2, d_model=64, n_heads=4,
                           d_ff=128, max_seq_len=128, n_experts=4,
                           dtype=jnp.float32),
-    "gpt2-small": GPTConfig(),       # 124M
+    "gpt2-small": GPTConfig(scan_unroll=12),       # 124M
     "gpt2-medium": GPTConfig(n_layers=24, d_model=1024, n_heads=16,
-                             d_ff=4096),
-    "gpt2-xl": GPTConfig(n_layers=48, d_model=1600, n_heads=25, d_ff=6400),
+                             d_ff=4096, scan_unroll=8),
+    "gpt2-xl": GPTConfig(n_layers=48, d_model=1600, n_heads=25, d_ff=6400,
+                         scan_unroll=4),
     "7b": GPTConfig(vocab_size=32000, n_layers=32, d_model=4096, n_heads=32,
                     d_ff=11008, max_seq_len=4096, remat=True),
 }
@@ -93,8 +98,11 @@ def param_specs(config: GPTConfig) -> dict:
             "w_down": ("layers", "mlp", "embed"),
         })
     specs = {
-        "tok_embed": ("vocab", "embed"),
-        "pos_embed": (None, "embed"),
+        # Table embed dims stay unsharded (vocab carries tensor+fsdp, see
+        # parallel/sharding.py DEFAULT_RULES["vocab"]); pos_embed is tiny
+        # and replicated.
+        "tok_embed": ("vocab", None),
+        "pos_embed": (None, None),
         "blocks": blocks,
         "final_ln_scale": ("embed",),
         "final_ln_bias": ("embed",),
@@ -235,6 +243,20 @@ def forward(params: dict, tokens: jax.Array, config: GPTConfig,
             mesh=None) -> tuple[jax.Array, jax.Array]:
     """tokens [B, L] int32 -> (logits [B, L, V], moe_aux_loss scalar)."""
     c = config
+    x, aux = forward_trunk(params, tokens, c, mesh)
+    head = (params["tok_embed"].T if c.tie_embeddings
+            else params["lm_head"]).astype(c.dtype)
+    logits = jnp.einsum("bld,dv->blv", x, head)
+    logits = with_logical_constraint(logits, ("batch", "length", "vocab"),
+                                     mesh=mesh)
+    return logits, aux
+
+
+def forward_trunk(params: dict, tokens: jax.Array, config: GPTConfig,
+                  mesh=None) -> tuple[jax.Array, jax.Array]:
+    """Transformer stack up to (excluding) the lm head.
+    tokens [B, L] -> (x [B, L, D], moe_aux_loss)."""
+    c = config
     b, l = tokens.shape
     x = params["tok_embed"][tokens].astype(c.dtype)
     x = x + params["pos_embed"][:l][None].astype(c.dtype)
@@ -242,20 +264,17 @@ def forward(params: dict, tokens: jax.Array, config: GPTConfig,
 
     block = partial(_block, config=c, mesh=mesh)
     if c.remat:
-        block = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
 
     def body(x, layer_params):
         x, aux = block(x, layer_params)
         return x, aux
 
-    x, auxes = jax.lax.scan(body, x, params["blocks"])
+    x, auxes = jax.lax.scan(body, x, params["blocks"],
+                            unroll=min(c.scan_unroll, c.n_layers))
     x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
-    head = (params["tok_embed"].T if c.tie_embeddings
-            else params["lm_head"]).astype(c.dtype)
-    logits = jnp.einsum("bld,dv->blv", x, head)
-    logits = with_logical_constraint(logits, ("batch", "length", "vocab"),
-                                     mesh=mesh)
-    return logits, jnp.sum(auxes)
+    return x, jnp.sum(auxes)
 
 
 def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):
@@ -264,17 +283,37 @@ def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):
     Runs the model on the FULL length L and shifts targets instead of
     slicing inputs to L-1: the sequence dim must stay divisible by the
     mesh's seq axis for ring attention, and L-1 never is.
+
+    Single chip uses the fused chunked cross-entropy (never materializes
+    [B, L, V] — see ops/cross_entropy.py and PERF.md; the naive fp32
+    log_softmax was ~75% of the train step).  Under a mesh the standard
+    path keeps GSPMD free to shard the logits.
     """
+    from ray_tpu.ops.cross_entropy import fused_cross_entropy
+
     tokens = batch["tokens"]
-    logits, aux = forward(params, tokens, config, mesh)
+    c = config
     targets = jnp.roll(tokens, -1, axis=1)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     # Last position predicts the rolled-around token 0 — always masked.
     valid = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)
     mask = batch.get("loss_mask")
     if mask is not None:
         valid = valid * mask
+
+    multichip = mesh is not None and any(
+        s > 1 for s in mesh.shape.values())
+    if not multichip:
+        x, aux = forward_trunk(params, tokens, c, mesh)
+        b, l, d = x.shape
+        head = (params["tok_embed"].T if c.tie_embeddings
+                else params["lm_head"]).astype(c.dtype)
+        loss = fused_cross_entropy(x.reshape(b * l, d), head,
+                                   targets.reshape(-1), valid.reshape(-1))
+        return loss + 0.01 * aux
+
+    logits, aux = forward(params, tokens, c, mesh)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
     return loss + 0.01 * aux
 

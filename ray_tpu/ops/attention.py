@@ -50,16 +50,16 @@ def _build_mask(q_len, k_len, causal, segment_ids):
 # Pallas flash-attention kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  block_k: int, causal: bool, scale: float,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, block_k: int, causal: bool, scale: float,
                   n_kv_blocks: int):
     """One (batch*head, q_block, kv_block) grid step: online softmax.
 
     K/V arrive one VMEM block per grid step (the grid's last dim streams
     them from HBM — memory is O(block), not O(kv_len)); softmax state
     persists in VMEM scratch across the kv sweep for a given q block.
-    Refs: q [bq, d], k/v [block_k, d], o [bq, d]; scratch m/l [bq, 1] f32,
-    acc [bq, d] f32.
+    Refs: q [bq, d], k/v [block_k, d], o [bq, d], lse [bq, 1] (saved for
+    the backward); scratch m/l [bq, 1] f32, acc [bq, d] f32.
     """
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
@@ -100,8 +100,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(kv_idx == n_kv_blocks - 1)
     def _finalize():
-        o_ref[...] = (acc_ref[...]
-                      / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[...] = m_ref[...] + jnp.log(l_safe)
 
 
 try:  # Pallas import kept lazy-safe for platforms without it.
@@ -115,39 +116,52 @@ except Exception:  # pragma: no cover
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
 def flash_attention(q, k, v, *, causal: bool = True,
-                    scale: Optional[float] = None, block_q: int = 256,
-                    block_k: int = 256, interpret: Optional[bool] = None):
+                    scale: Optional[float] = None, block_q: int = 512,
+                    block_k: int = 512, interpret: Optional[bool] = None):
     """Blockwise attention via Pallas.  Falls back to XLA attention when the
     shape does not tile (length % block != 0) or Pallas is unavailable.
 
-    Differentiable: Pallas forward + custom VJP whose backward recomputes
-    attention with the XLA path (flash-style Pallas backward kernel is a
-    planned optimisation; the recompute keeps forward memory O(block) and
-    correctness exact)."""
+    Differentiable end-to-end in Pallas: the forward saves (O, logsumexp)
+    and the backward runs flash-style dq and dk/dv kernels (causal block
+    skipping, f32 VMEM accumulators) — never materializing [L, L]."""
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward_impl(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
+    out, _ = _flash_forward_impl(q, k, v, causal, scale, block_q, block_k,
+                                 interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward_impl(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward_impl(q, k, v, causal, scale, block_q, block_k,
+                                   interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: reference_attention(q, k, v, causal=causal,
-                                            scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if lse is None:  # forward took the XLA fallback: recompute via XLA
+        _, vjp = jax.vjp(
+            lambda q, k, v: reference_attention(q, k, v, causal=causal,
+                                                scale=scale), q, k, v)
+        return vjp(g)
+    return _flash_backward_impl(q, k, v, out, lse, g, causal, scale,
+                                block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _use_pallas(q_len, kv_len, d, block_q, block_k, causal):
+    return (_HAS_PALLAS and q_len % block_q == 0 and kv_len % block_k == 0
+            and d in (64, 128, 256) and not (causal and q_len != kv_len))
+
+
+def _fold_heads(x):
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
 
 
 def _flash_forward_impl(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -155,22 +169,19 @@ def _flash_forward_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     kv_len = k.shape[1]
     block_q = min(block_q, q_len)
     block_k = min(block_k, kv_len)
-    if (not _HAS_PALLAS or q_len % block_q or kv_len % block_k
-            or d not in (64, 128, 256) or (causal and q_len != kv_len)):
-        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if not _use_pallas(q_len, kv_len, d, block_q, block_k, causal):
+        return reference_attention(q, k, v, causal=causal, scale=scale), None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     n_kv_blocks = kv_len // block_k
 
     # Fold batch and heads into the grid; kernel sees [len, d] slices.
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, q_len, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, kv_len, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, kv_len, d)
+    qr, kr, vr = _fold_heads(q), _fold_heads(k), _fold_heads(v)
 
     kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
                                scale=scale, n_kv_blocks=n_kv_blocks)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, q_len // block_q, n_kv_blocks),
         in_specs=[
@@ -178,8 +189,14 @@ def _flash_forward_impl(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, q_len, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j, kk: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, q_len, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, q_len, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -187,4 +204,143 @@ def _flash_forward_impl(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, q_len, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, q_len, d).transpose(0, 2, 1, 3), lse
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                     dq_acc, delta_ref, *, block_k: int, causal: bool,
+                     scale: float, n_kv_blocks: int):
+    """dq: grid (bh, q_block, kv_block) — kv streams, dq accumulates.
+    ds = p * (dO V^T - D), dq = ds K * scale, with D = rowsum(dO * O)."""
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+    bq = q_ref.shape[0]
+    q_offset = q_idx * bq
+    kv_offset = kv_idx * block_k
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+        delta_ref[...] = jnp.sum(
+            do_ref[...].astype(jnp.float32) * o_ref[...].astype(jnp.float32),
+            axis=-1, keepdims=True)
+
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        s = (q * scale) @ k_blk.T                     # [bq, bk]
+        if causal:
+            q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[...])                 # [bq, bk]
+        dp = do @ v_blk.T                             # [bq, bk]
+        ds = p * (dp - delta_ref[...])
+        dq_acc[...] += (ds @ k_blk) * scale
+
+    if causal:
+        pl.when(q_offset + bq - 1 >= kv_offset)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kv_idx == n_kv_blocks - 1)
+    def _finalize():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                      causal: bool, scale: float, n_q_blocks: int):
+    """dk/dv: grid (bh, kv_block, q_block) — q streams, dk/dv accumulate.
+    dv = P^T dO;  dk = ds^T Q * scale."""
+    q_idx = pl.program_id(2)
+    kv_idx = pl.program_id(1)
+    bk = k_ref.shape[0]
+    q_offset = q_idx * block_q
+    kv_offset = kv_idx * bk
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        delta = jnp.sum(do * o_ref[...].astype(jnp.float32),
+                        axis=-1, keepdims=True)      # [bq, 1]
+        s = (q * scale) @ k_blk.T                    # [bq, bk]
+        if causal:
+            q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[...])                # [bq, bk]
+        dv_acc[...] += p.T @ do
+        dp = do @ v_blk.T
+        ds = p * (dp - delta)
+        dk_acc[...] += (ds.T @ q) * scale
+
+    if causal:
+        pl.when(q_offset + block_q - 1 >= kv_offset)(_compute)
+    else:
+        _compute()
+
+    @pl.when(q_idx == n_q_blocks - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward_impl(q, k, v, out, lse, g, causal, scale, block_q,
+                         block_k, interpret):
+    b, q_len, h, d = q.shape
+    kv_len = k.shape[1]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, kv_len)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    n_q_blocks = q_len // block_q
+    n_kv_blocks = kv_len // block_k
+
+    qr, kr, vr = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    dor, outr = _fold_heads(g), _fold_heads(out)
+
+    q_spec = pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0))
+    kv_spec = pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0))
+    lse_spec = pl.BlockSpec((None, block_q, 1), lambda i, j, kk: (i, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale, n_kv_blocks=n_kv_blocks),
+        grid=(b * h, n_q_blocks, n_kv_blocks),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, q_len, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, outr, lse)
+
+    # dkv sweep: middle grid dim = kv block (fixed per sweep), last = q.
+    q_spec2 = pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, kk, 0))
+    kv_spec2 = pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, j, 0))
+    lse_spec2 = pl.BlockSpec((None, block_q, 1), lambda i, j, kk: (i, kk, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q, causal=causal,
+                          scale=scale, n_q_blocks=n_q_blocks),
+        grid=(b * h, n_kv_blocks, n_q_blocks),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b * h, kv_len, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, kv_len, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, outr, lse)
+
+    unfold = lambda x, l: x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+    return unfold(dq, q_len), unfold(dk, kv_len), unfold(dv, kv_len)

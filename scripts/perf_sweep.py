@@ -1,0 +1,277 @@
+"""Perf experiment matrix for the GPT-2 train step on the real chip.
+
+Usage: python scripts/perf_sweep.py [exp ...]
+Each experiment prints steady-state tokens/s.  Run sequentially (one chip).
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, ".")
+from ray_tpu.models import gpt  # noqa: E402
+
+
+def time_step(step, state, tokens, n=10, scan_steps=None):
+    # Warmup/compile.
+    for _ in range(2):
+        state, metrics = step(state, {"tokens": tokens})
+    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, metrics = step(state, {"tokens": tokens})
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    eff_steps = n * (scan_steps or 1)
+    return tokens.size * eff_steps / dt, dt / eff_steps
+
+
+def base(cfg_name="gpt2-small", batch=8, seq=1024, **cfg_over):
+    cfg = gpt.CONFIGS[cfg_name]
+    if cfg_over:
+        cfg = gpt.GPTConfig(**{**cfg.__dict__, **cfg_over})
+    init_state, train_step = gpt.make_train_step(cfg, optax.adamw(1e-4))
+    state = init_state(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    return cfg, state, tokens, train_step
+
+
+def exp_baseline():
+    cfg, state, tokens, train_step = base()
+    step = jax.jit(train_step, donate_argnums=0)
+    tps, ms = time_step(step, state, tokens)
+    print(f"baseline b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+def exp_batch(b):
+    cfg, state, tokens, train_step = base(batch=b)
+    step = jax.jit(train_step, donate_argnums=0)
+    tps, ms = time_step(step, state, tokens)
+    print(f"batch{b}: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+def exp_scan10():
+    """10 steps inside one jit via lax.scan — measures dispatch overhead."""
+    cfg, state, tokens, train_step = base()
+
+    def multi(state, batch):
+        def body(s, _):
+            s, m = train_step(s, batch)
+            return s, m["loss"]
+        state, losses = jax.lax.scan(body, state, None, length=10)
+        return state, {"loss": losses[-1]}
+
+    step = jax.jit(multi, donate_argnums=0)
+    tps, ms = time_step(step, state, tokens, n=3, scan_steps=10)
+    print(f"scan10 b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+def exp_ref_attention():
+    """XLA reference attention instead of the Pallas kernel."""
+    import ray_tpu.ops.attention as att
+    orig = att.flash_attention
+    att.flash_attention = lambda q, k, v, **kw: att.reference_attention(
+        q, k, v, causal=kw.get("causal", True))
+    try:
+        cfg, state, tokens, train_step = base()
+        step = jax.jit(train_step, donate_argnums=0)
+        tps, ms = time_step(step, state, tokens)
+        print(f"ref-attn b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+    finally:
+        att.flash_attention = orig
+
+
+def exp_fwd_only():
+    cfg, state, tokens, _ = base()
+
+    def fwd(state, batch):
+        loss = gpt.loss_fn(state["params"], batch, cfg)
+        return state, {"loss": loss}
+
+    step = jax.jit(fwd, donate_argnums=0)
+    tps, ms = time_step(step, state, tokens)
+    print(f"fwd-only b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+def exp_no_adamw():
+    """SGD instead of adamw — isolates optimizer cost."""
+    cfg = gpt.CONFIGS["gpt2-small"]
+    init_state, train_step = gpt.make_train_step(cfg, optax.sgd(1e-4))
+    state = init_state(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 1024), 0,
+                                cfg.vocab_size)
+    step = jax.jit(train_step, donate_argnums=0)
+    tps, ms = time_step(step, state, tokens)
+    print(f"sgd b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+EXPS = {
+    "baseline": exp_baseline,
+    "batch16": lambda: exp_batch(16),
+    "batch32": lambda: exp_batch(32),
+    "scan10": exp_scan10,
+    "refattn": exp_ref_attention,
+    "fwdonly": exp_fwd_only,
+    "sgd": exp_no_adamw,
+}
+
+
+
+def exp_nohead():
+    """Loss without the vocab projection + softmax — isolates head cost."""
+    import jax
+    cfg, state, tokens, _ = base()
+
+    def loss_nohead(params, batch):
+        logits_in, _ = _forward_trunk(params, batch["tokens"], cfg)
+        return jnp.mean(jnp.square(logits_in.astype(jnp.float32)))
+
+    def _forward_trunk(params, toks, c):
+        from ray_tpu.models.gpt import _block, _layernorm
+        from functools import partial
+        x = params["tok_embed"][toks].astype(c.dtype)
+        x = x + params["pos_embed"][: toks.shape[1]][None].astype(c.dtype)
+        block = partial(_block, config=c, mesh=None)
+        def body(xx, lp):
+            xx, aux = block(xx, lp)
+            return xx, aux
+        x, auxes = jax.lax.scan(body, x, params["blocks"])
+        x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+        return x, auxes
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_nohead)(state["params"], batch)
+        return state, {"loss": loss}
+
+    stepj = jax.jit(step, donate_argnums=0)
+    tps, ms = time_step(stepj, state, tokens)
+    print(f"nohead b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+def exp_bf16params():
+    """Whole param tree in bf16 (halves weight HBM traffic, no per-layer casts)."""
+    cfg, state, tokens, train_step = base()
+    state["params"] = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 else x, state["params"])
+    import optax
+    opt = optax.adamw(1e-4)
+    state["opt_state"] = opt.init(state["params"])
+    init_state, train_step = gpt.make_train_step(cfg, opt)
+    step = jax.jit(train_step, donate_argnums=0)
+    tps, ms = time_step(step, state, tokens)
+    print(f"bf16params b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+def exp_unroll():
+    """lax.scan over layers with unroll= full depth."""
+    import optax
+    from functools import partial
+    cfg, state, tokens, _ = base()
+    from ray_tpu.models.gpt import _block, _layernorm
+    import ray_tpu.models.gpt as G
+    orig_scan = jax.lax.scan
+    def scan_unrolled(f, init, xs, **kw):
+        kw.pop("unroll", None)
+        return orig_scan(f, init, xs, unroll=True, **kw)
+    jax.lax.scan = scan_unrolled
+    try:
+        init_state, train_step = gpt.make_train_step(cfg, optax.adamw(1e-4))
+        step = jax.jit(train_step, donate_argnums=0)
+        tps, ms = time_step(step, state, tokens)
+        print(f"unroll b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+    finally:
+        jax.lax.scan = orig_scan
+
+
+EXPS["nohead"] = exp_nohead
+EXPS["bf16params"] = exp_bf16params
+EXPS["unroll"] = exp_unroll
+
+def exp_untied():
+    """tie_embeddings=False: isolates the tied-head transpose + grad-add."""
+    cfg, state, tokens, train_step = base(tie_embeddings=False)
+    step = jax.jit(train_step, donate_argnums=0)
+    tps, ms = time_step(step, state, tokens)
+    print(f"untied b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+EXPS["untied"] = exp_untied
+
+def exp_gradonly():
+    """value_and_grad of the full loss, no optimizer apply."""
+    import jax
+    cfg, state, tokens, _ = base()
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(gpt.loss_fn)(
+            state["params"], batch, cfg)
+        return state, {"loss": loss, "g": grads["final_ln_scale"][0]}
+
+    stepj = jax.jit(step, donate_argnums=0)
+    tps, ms = time_step(stepj, state, tokens)
+    print(f"gradonly b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+def exp_fwdloss():
+    """forward + fused loss only (no grad)."""
+    import jax
+    cfg, state, tokens, _ = base()
+
+    def step(state, batch):
+        loss = gpt.loss_fn(state["params"], batch, cfg)
+        return state, {"loss": loss}
+
+    stepj = jax.jit(step, donate_argnums=0)
+    tps, ms = time_step(stepj, state, tokens)
+    print(f"fwdloss b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+EXPS["gradonly"] = exp_gradonly
+EXPS["fwdloss"] = exp_fwdloss
+
+def exp_noattn():
+    """Attention replaced by identity: isolates attention fwd+bwd cost."""
+    import ray_tpu.ops.attention as att
+    import ray_tpu.models.gpt as G
+    orig = G.flash_attention
+    G.flash_attention = lambda q, k, v, **kw: q
+    try:
+        cfg, state, tokens, train_step = base()
+        step = jax.jit(train_step, donate_argnums=0)
+        tps, ms = time_step(step, state, tokens)
+        print(f"noattn b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+    finally:
+        G.flash_attention = orig
+
+
+EXPS["noattn"] = exp_noattn
+
+def exp_fwdtrunk():
+    """Trunk forward only (no head): embed + 12 blocks + final LN."""
+    import jax
+    cfg, state, tokens, _ = base()
+
+    def step(state, batch):
+        x, aux = gpt.forward_trunk(state["params"], batch["tokens"], cfg)
+        return state, {"loss": jnp.mean(jnp.square(x.astype(jnp.float32)))}
+
+    stepj = jax.jit(step, donate_argnums=0)
+    tps, ms = time_step(stepj, state, tokens)
+    print(f"fwdtrunk b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+
+
+EXPS["fwdtrunk"] = exp_fwdtrunk
+
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(EXPS)
+    for name in names:
+        EXPS[name]()
