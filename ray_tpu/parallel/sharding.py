@@ -31,7 +31,14 @@ DEFAULT_RULES: Mapping[str, Union[str, Tuple[str, ...], None]] = {
     "mlp": "tensor",             # ffn hidden: megatron column/row split
     "heads": "tensor",           # attention heads: megatron split
     "kv": None,                  # per-head dim: never sharded
-    "vocab": "tensor",           # embedding/logits vocab dim
+    # Vocab dim carries BOTH the tensor and fsdp shards of the embedding
+    # table.  Sharding the table's embed dim over fsdp instead forces the
+    # partitioner to move the fsdp shard from the gather output's embed dim
+    # onto the activations' batch dim — a transposed-device-order reshard
+    # XLA can only do by full rematerialization (observed in the r1
+    # multichip dryrun).  Vocab-side sharding keeps the gather output
+    # unsharded on embed and still splits table memory 4 ways.
+    "vocab": ("tensor", "fsdp"),  # embedding/logits vocab dim
     "experts": "expert",         # MoE expert dim
     "expert_mlp": "tensor",      # ffn hidden inside an expert
     "layers": None,              # scanned layer dim (stacked params)
@@ -47,6 +54,7 @@ def logical_to_spec(logical: LogicalSpec,
     so the same rules work on any mesh shape."""
     rules = DEFAULT_RULES if rules is None else rules
     out = []
+    used: set = set()
     for name in logical:
         mapped = rules.get(name) if name is not None else None
         if mapped is None:
@@ -55,6 +63,11 @@ def logical_to_spec(logical: LogicalSpec,
         axes = (mapped,) if isinstance(mapped, str) else tuple(mapped)
         if mesh is not None:
             axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        # A mesh axis may shard at most one dim: first logical axis wins
+        # (e.g. logits ("batch","length","vocab") where batch takes fsdp
+        # and vocab falls back to tensor only).
+        axes = tuple(a for a in axes if a not in used)
+        used.update(axes)
         if not axes:
             out.append(None)
         elif len(axes) == 1:

@@ -27,7 +27,11 @@ def test_create_mesh_and_specs():
     mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
     assert mesh.shape["data"] == 2
     spec = logical_to_spec(("batch", "length", "embed"), mesh=mesh)
-    assert spec == jax.sharding.PartitionSpec(("data", "fsdp"), None, "fsdp")
+    # batch claims (data, fsdp); embed's fsdp is then dropped — a mesh axis
+    # may shard at most one dim of a single array.
+    assert spec == jax.sharding.PartitionSpec(("data", "fsdp"))
+    spec = logical_to_spec(("embed", "mlp"), mesh=mesh)
+    assert spec == jax.sharding.PartitionSpec("fsdp", "tensor")
     # Axes of size 1 are dropped.
     spec = logical_to_spec(("batch", "length"), mesh=mesh)
     assert spec == jax.sharding.PartitionSpec(("data", "fsdp"))
@@ -188,3 +192,30 @@ def test_opt_state_sharded_like_params():
     p_shard = state["params"]["blocks"]["w_up"].sharding
     mu = state["opt_state"][0].mu["blocks"]["w_up"]
     assert mu.sharding.is_equivalent_to(p_shard, mu.ndim)
+
+
+def test_no_involuntary_rematerialization(capfd):
+    """Compiled sharded train steps must not trigger XLA SPMD's
+    'Involuntary full rematerialization' fallback (VERDICT r1: the r1
+    rules resharded the embedding gather across transposed device orders).
+    The warning is emitted on C++ stderr, so capture at the fd level."""
+    import jax
+    import optax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel import MeshConfig, create_mesh, shard_batch
+
+    cfg = gpt.CONFIGS["nano"]
+    for mesh_cfg in (MeshConfig(data=2, fsdp=2, tensor=2),
+                     MeshConfig(data=2, seq=4)):
+        mesh = create_mesh(mesh_cfg, devices=jax.devices()[:8])
+        init_state, train_step = gpt.make_train_step(
+            cfg, optax.adamw(1e-3), mesh)
+        state = init_state(jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                                    cfg.vocab_size)
+        batch = shard_batch(mesh, {"tokens": tokens})
+        state, metrics = jax.jit(train_step, donate_argnums=0)(state, batch)
+        assert float(metrics["loss"]) > 0
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err
