@@ -16,6 +16,7 @@ import uuid
 from typing import Any, Optional
 
 _DICT_FILE = "checkpoint.pkl"
+_FILES_KEY = "_checkpoint_files"   # dict key holding packed directory files
 
 
 class Checkpoint:
@@ -41,10 +42,30 @@ class Checkpoint:
     # -------- accessors --------
 
     def to_dict(self) -> dict:
+        """Dict form.  A directory checkpoint made from arbitrary files
+        (e.g. orbax output) round-trips: every file is packed under the
+        reserved _FILES_KEY (reference: air/checkpoint.py dict<->dir packs
+        the full directory, _checkpoint.py _pack)."""
         if self._data is not None:
             return dict(self._data)
-        with open(os.path.join(self._dir, _DICT_FILE), "rb") as f:
-            return pickle.load(f)
+        pkl = os.path.join(self._dir, _DICT_FILE)
+        data: dict = {}
+        if os.path.isfile(pkl):
+            with open(pkl, "rb") as f:
+                data = pickle.load(f)
+        files: dict = {}
+        for root, _, names in os.walk(self._dir):
+            for name in names:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, self._dir)
+                if rel == _DICT_FILE:
+                    continue
+                with open(full, "rb") as f:
+                    files[rel] = f.read()
+        if files:
+            data = dict(data)
+            data[_FILES_KEY] = files
+        return data
 
     def to_directory(self, path: Optional[str] = None) -> str:
         if path is None:
@@ -55,13 +76,22 @@ class Checkpoint:
             if os.path.abspath(self._dir) != os.path.abspath(path):
                 shutil.copytree(self._dir, path, dirs_exist_ok=True)
         else:
-            tmp = path + ".tmp"
-            os.makedirs(tmp, exist_ok=True)
-            with open(os.path.join(tmp, _DICT_FILE), "wb") as f:
-                pickle.dump(self._data, f)
-            for name in os.listdir(tmp):
-                os.replace(os.path.join(tmp, name), os.path.join(path, name))
-            os.rmdir(tmp)
+            data = dict(self._data)
+            files = dict(data.pop(_FILES_KEY, {}))
+            if data or not files:
+                buf = pickle.dumps(data)
+                files[_DICT_FILE] = buf
+            # Per-FILE atomic replace (an os.replace of a directory onto an
+            # existing non-empty directory raises ENOTEMPTY).
+            for rel, blob in files.items():
+                dest = os.path.join(path, rel)
+                parent = os.path.dirname(dest)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                tmpf = dest + ".tmp"
+                with open(tmpf, "wb") as f:
+                    f.write(blob)
+                os.replace(tmpf, dest)
         return path
 
     def __repr__(self):

@@ -180,7 +180,10 @@ class DeploymentHandle:
         self._replicas: List = []
         self._max_q = 100
         self._rr = 0
-        self._in_flight: Dict[int, int] = {}
+        # In-flight counts keyed by stable replica identity (actor id) —
+        # index keys would mis-attribute counts after _refresh/heal
+        # replaces the replica list.
+        self._in_flight: Dict[bytes, int] = {}
         self._fetched_at = 0.0
 
     def options(self, method_name: str) -> "DeploymentHandle":
@@ -204,6 +207,10 @@ class DeploymentHandle:
             self._replicas = routing["replicas"]
             self._max_q = routing["max_concurrent_queries"]
             self._fetched_at = time.monotonic()
+            alive = {r._actor_id.binary() for r in self._replicas}
+            for key in list(self._in_flight):
+                if key not in alive:
+                    del self._in_flight[key]
 
     def remote(self, *args, **kwargs):
         return self._call(self._method, args, kwargs)
@@ -218,24 +225,27 @@ class DeploymentHandle:
                 self._rr += 1
                 pick = None
                 for idx in order:
-                    if self._in_flight.get(idx, 0) < self._max_q:
+                    key = self._replicas[idx]._actor_id.binary()
+                    if self._in_flight.get(key, 0) < self._max_q:
                         pick = idx
                         break
             if pick is not None:
                 replica = self._replicas[pick]
+                key = replica._actor_id.binary()
                 with self._lock:
-                    self._in_flight[pick] = self._in_flight.get(pick, 0) + 1
+                    self._in_flight[key] = self._in_flight.get(key, 0) + 1
                 ref = replica.handle_request.remote(method, args, kwargs)
-                return _TrackedRef(ref, self, pick, method, args, kwargs)
+                return _TrackedRef(ref, self, key, method, args, kwargs)
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"no replica of {self._name!r} under its "
                     f"max_concurrent_queries cap within 60s")
             time.sleep(0.01)  # every replica saturated: backpressure
 
-    def _done(self, idx: int):
+    def _done(self, key: bytes):
         with self._lock:
-            self._in_flight[idx] = max(0, self._in_flight.get(idx, 0) - 1)
+            if key in self._in_flight:
+                self._in_flight[key] = max(0, self._in_flight[key] - 1)
 
     def _on_replica_error(self):
         try:
@@ -262,11 +272,11 @@ class _TrackedRef:
     """Wraps the reply ref to release the in-flight slot on result() and
     retry once through a healed replica set on replica death."""
 
-    def __init__(self, ref, handle: DeploymentHandle, idx: int,
+    def __init__(self, ref, handle: DeploymentHandle, key: bytes,
                  method: str, args, kwargs, retried: bool = False):
         self._ref = ref
         self._handle = handle
-        self._idx = idx
+        self._idx = key
         self._request = (method, args, kwargs)
         self._retried = retried
 

@@ -72,22 +72,28 @@ class WorkerGroup:
             raise RuntimeError(
                 f"could not reserve {num_workers} x {resources_per_worker} "
                 f"(strategy {placement_strategy}) within 120s")
-        res = dict(resources_per_worker)
-        cpu = res.pop("CPU", 0)
-        tpu = res.pop("TPU", None)
-        actor_cls = RayTrainWorker.options(
-            num_cpus=cpu, num_tpus=tpu, resources=res or None)
         self.workers: List[Worker] = []
-        for rank in range(num_workers):
-            actor = actor_cls.options(
-                placement_group=self._pg,
-                placement_group_bundle_index=rank).remote()
-            self.workers.append(Worker(actor=actor, rank=rank))
-        # Resolve worker placement (node ids) for local-rank assignment.
-        node_ids = ray_tpu.get(
-            [w.actor.node_id.remote() for w in self.workers], timeout=120)
-        for w, nid in zip(self.workers, node_ids):
-            w.node_id = nid
+        try:
+            res = dict(resources_per_worker)
+            cpu = res.pop("CPU", 0)
+            tpu = res.pop("TPU", None)
+            actor_cls = RayTrainWorker.options(
+                num_cpus=cpu, num_tpus=tpu, resources=res or None)
+            for rank in range(num_workers):
+                actor = actor_cls.options(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=rank).remote()
+                self.workers.append(Worker(actor=actor, rank=rank))
+            # Resolve worker placement (node ids) for local-rank assignment.
+            node_ids = ray_tpu.get(
+                [w.actor.node_id.remote() for w in self.workers], timeout=120)
+            for w, nid in zip(self.workers, node_ids):
+                w.node_id = nid
+        except Exception:
+            # Don't leak the gang's reserved bundles if construction fails
+            # partway (the wait-timeout path above already cleans up).
+            self.shutdown()
+            raise
 
     def __len__(self):
         return len(self.workers)
