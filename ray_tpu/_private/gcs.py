@@ -73,6 +73,29 @@ class GcsServer:
         self._job_lock = asyncio.Lock()
         self._shutdown = asyncio.Event()
         self._cluster_version = 0  # bumped on node/actor table changes
+        # Event-driven waiters: every state change swaps + fires this event
+        # so long-polls and scheduler retries wake immediately instead of
+        # sleep-polling (reference: pubsub/publisher.h long-poll channels).
+        self._change_event = asyncio.Event()
+
+    def _bump(self):
+        """Record a state change and wake every waiter."""
+        self._cluster_version += 1
+        ev = self._change_event
+        self._change_event = asyncio.Event()
+        ev.set()
+
+    async def _wait_change(self, timeout: float) -> bool:
+        """Wait until the next state change (or timeout); returns whether a
+        change fired.  Callers re-check their condition in a loop."""
+        if timeout <= 0:
+            return False
+        ev = self._change_event
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
 
     # ---------------- node manager ----------------
 
@@ -80,7 +103,7 @@ class GcsServer:
         info: NodeInfo = req["info"]
         self.nodes[info.node_id] = info
         self.node_heartbeat[info.node_id] = time.monotonic()
-        self._cluster_version += 1
+        self._bump()
         logger.info("node %s registered at %s (%s)", info.node_id.hex()[:8],
                     info.address, info.resources_total)
         return {"ok": True}
@@ -91,7 +114,9 @@ class GcsServer:
         if info is None or not info.alive:
             return {"ok": False, "reregister": True}
         self.node_heartbeat[nid] = time.monotonic()
-        info.resources_available = req["available"]
+        if info.resources_available != req["available"]:
+            info.resources_available = req["available"]
+            self._bump()
         return {"ok": True, "shutdown": self._shutdown.is_set()}
 
     async def get_nodes(self, req):
@@ -107,7 +132,7 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
-        self._cluster_version += 1
+        self._bump()
         logger.warning("node %s dead: %s", nid.hex()[:8], reason)
         # Fail over actors that lived there.
         for actor in list(self.actors.values()):
@@ -184,7 +209,7 @@ class GcsServer:
                     info.version += 1
                     return
                 if pg.state != "CREATED":
-                    await asyncio.sleep(0.1)
+                    await self._wait_change(0.1)
                     continue
                 idx = spec.bundle_index
                 if idx >= len(pg.bundles):
@@ -215,14 +240,14 @@ class GcsServer:
                                      % len(candidates)]
                 node = self.nodes.get(pg.bundle_nodes[idx])
                 if node is None or not node.alive:
-                    await asyncio.sleep(0.2)
+                    await self._wait_change(0.2)
                     continue
                 bundle = (pg_id.hex(), idx)
             else:
                 node = sched.pick_node(self._alive_nodes(), pick_demand,
                                        strategy="DEFAULT", exclude=tried)
             if node is None:
-                await asyncio.sleep(0.2)  # wait for capacity / new nodes
+                await self._wait_change(0.2)  # wait for capacity/new nodes
                 tried.clear()
                 continue
             job_int = int.from_bytes(
@@ -238,12 +263,12 @@ class GcsServer:
                 logger.info("lease on %s failed: %s", node.address, e)
                 tried.add(node.node_id)
                 if pg_id is not None:  # fixed target: back off, don't spin
-                    await asyncio.sleep(0.2)
+                    await self._wait_change(0.2)
                 continue
             if not lease.get("granted"):
                 tried.add(node.node_id)
                 if pg_id is not None:
-                    await asyncio.sleep(0.2)
+                    await self._wait_change(0.2)
                 continue
             worker_addr = lease["worker_address"]
             try:
@@ -271,13 +296,13 @@ class GcsServer:
                 info.state = "DEAD"
                 info.death_cause = f"creation failed: {reply['error']}"
                 info.version += 1
-                self._cluster_version += 1
+                self._bump()
                 return
             info.state = "ALIVE"
             info.address = worker_addr
             info.node_id = node.node_id
             info.version += 1
-            self._cluster_version += 1
+            self._bump()
             logger.info("actor %s alive at %s", info.actor_id.hex()[:8],
                         worker_addr)
             return
@@ -291,7 +316,7 @@ class GcsServer:
             actor.state = "RESTARTING"
             actor.address = ""
             actor.version += 1
-            self._cluster_version += 1
+            self._bump()
             logger.info("restarting actor %s (%d/%s): %s",
                         actor.actor_id.hex()[:8], actor.num_restarts,
                         actor.max_restarts, reason)
@@ -301,7 +326,7 @@ class GcsServer:
             actor.death_cause = reason
             actor.address = ""
             actor.version += 1
-            self._cluster_version += 1
+            self._bump()
 
     async def report_actor_death(self, req):
         actor = self.actors.get(req["actor_id"])
@@ -311,7 +336,7 @@ class GcsServer:
                 actor.death_cause = req.get("reason", "killed")
                 actor.address = ""
                 actor.version += 1
-                self._cluster_version += 1
+                self._bump()
             else:
                 await self._on_actor_interrupted(actor, req.get("reason", "?"))
         return {"ok": True}
@@ -323,7 +348,7 @@ class GcsServer:
         deadline = time.monotonic() + req.get("wait_s", 0)
         while actor is not None and actor.state in ("PENDING", "RESTARTING") \
                 and time.monotonic() < deadline:
-            await asyncio.sleep(0.05)
+            await self._wait_change(min(0.5, deadline - time.monotonic()))
         return {"info": actor}
 
     async def get_named_actor(self, req):
@@ -344,7 +369,7 @@ class GcsServer:
             actor.death_cause = "ray_tpu.kill"
             actor.address = ""
             actor.version += 1
-            self._cluster_version += 1
+            self._bump()
         else:
             # Kill the process but honor max_restarts (reference:
             # ray.kill(no_restart=False) semantics).
@@ -452,7 +477,7 @@ class GcsServer:
         while info.state != "REMOVED":
             plan = self._plan_bundles(info)
             if not plan:
-                await asyncio.sleep(0.2)
+                await self._wait_change(0.2)
                 continue
             # Phase 1: prepare every bundle; roll back all on any failure.
             prepared = []
@@ -475,7 +500,7 @@ class GcsServer:
                 # prepares: a Prepare whose reply was lost still reserved
                 # server-side (CancelBundle on an unprepared key is a no-op).
                 await self._cancel_bundles_on(plan.items(), info)
-                await asyncio.sleep(0.2)
+                await self._wait_change(0.2)
                 continue
             # Phase 2: commit.  A failed commit on a live node leaves the
             # bundle unusable (leases check committed=True) — cancel it and
@@ -499,7 +524,7 @@ class GcsServer:
                 return
             if failed:
                 await self._cancel_bundles_on(failed, info)
-                await asyncio.sleep(0.2)
+                await self._wait_change(0.2)
                 continue
             # A planned node may have died while prepare/commit RPCs were in
             # flight — its death event fired before bundle_nodes was written,
@@ -512,11 +537,11 @@ class GcsServer:
                 for i in lost:
                     info.bundle_nodes[i] = None
                     info.bundle_addresses[i] = ""
-                await asyncio.sleep(0.2)
+                await self._wait_change(0.2)
                 continue
             info.state = "CREATED"
             info.version += 1
-            self._cluster_version += 1
+            self._bump()
             logger.info("placement group %s created (%d bundles)",
                         info.pg_id.hex()[:8], len(info.bundles))
             return
@@ -538,7 +563,7 @@ class GcsServer:
             return {"ok": False}
         info.state = "REMOVED"
         info.version += 1
-        self._cluster_version += 1
+        self._bump()
         nodes = {nid for nid in info.bundle_nodes if nid is not None}
         for nid in nodes:
             node = self.nodes.get(nid)
@@ -585,7 +610,7 @@ class GcsServer:
         deadline = time.monotonic() + req.get("wait_s", 0)
         while info is not None and info.state in ("PENDING", "RESCHEDULING") \
                 and time.monotonic() < deadline:
-            await asyncio.sleep(0.05)
+            await self._wait_change(min(0.5, deadline - time.monotonic()))
         return {"info": info}
 
     async def list_placement_groups(self, req):
