@@ -108,7 +108,7 @@ struct Header {
   uint64_t total_size;
   uint64_t table_off;
   uint32_t max_objects;
-  uint32_t pad0_;
+  uint32_t eviction_off;  // 1 = LRU eviction disabled (spilling owns space)
   uint64_t clients_off;
   uint64_t heap_off;
   uint64_t heap_size;
@@ -346,6 +346,7 @@ void destroy_object(Handle* h, Slot* s) {
 
 // Evict the least-recently-used sealed unreferenced object.  Lock held.
 bool evict_one(Handle* h) {
+  if (h->hdr->eviction_off) return false;
   Slot* t = table(h);
   Slot* victim = nullptr;
   for (uint32_t i = 0; i < h->hdr->max_objects; i++) {
@@ -720,6 +721,38 @@ int tpus_reclaim(void* hv) {
   bool any = reclaim_dead_clients(h);
   unlock_store(h);
   return any ? 1 : 0;
+}
+
+// Toggle LRU eviction (spilling daemons disable it and reclaim space by
+// spilling to disk instead; reference: plasma pinned primary copies).
+int tpus_set_eviction(void* hv, int enabled) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  h->hdr->eviction_off = enabled ? 0 : 1;
+  unlock_store(h);
+  return TPUS_OK;
+}
+
+// Enumerate live objects into caller arrays (each sized max_n).  Returns
+// the number of entries written, or a negative TPUS_* error.
+int tpus_list(void* hv, uint8_t* ids, uint64_t* sizes, int32_t* refcounts,
+              uint32_t* states, uint64_t* lru_ticks, uint32_t max_n) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  if (lock_store(h)) return TPUS_SYS;
+  Slot* t = table(h);
+  uint32_t out = 0;
+  for (uint32_t i = 0; i < h->hdr->max_objects && out < max_n; i++) {
+    Slot* s = &t[i];
+    if (s->state != OBJ_CREATED && s->state != OBJ_SEALED) continue;
+    memcpy(ids + uint64_t(out) * kIdSize, s->id, kIdSize);
+    sizes[out] = s->data_size + s->meta_size;
+    refcounts[out] = s->refcount;
+    states[out] = s->state;
+    lru_ticks[out] = s->lru_tick;
+    out++;
+  }
+  unlock_store(h);
+  return int(out);
 }
 
 int tpus_stats(void* hv, uint64_t* capacity, uint64_t* used, uint64_t* count,

@@ -31,6 +31,7 @@ from ray_tpu import object_ref as object_ref_mod
 from ray_tpu.exceptions import (
     ActorDiedError,
     ObjectLostError,
+    ObjectStoreFullError,
     RayTpuTimeoutError,
     TaskError,
     WorkerCrashedError,
@@ -346,8 +347,33 @@ class CoreWorker:
     def put(self, value) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id, self._next_put_index())
         sv = ser.serialize(value, ref_sink=self._pin_serialized_ref)
-        self._store_owned_value(oid, sv)
+        try:
+            self._store_owned_value(oid, sv)
+        except ObjectStoreFullError:
+            # Ask the node daemon to spill to disk, then retry (reference:
+            # raylet SpillObjects on OOM, local_object_manager.h:41).
+            for attempt in range(3):
+                freed = self.io.run(self._request_spill(sv.total_size))
+                try:
+                    self._store_owned_value(oid, sv)
+                    break
+                except ObjectStoreFullError:
+                    if not freed:
+                        time.sleep(0.2)
+            else:
+                self._store_owned_value(oid, sv)
         return ObjectRef(oid, self.address)
+
+    async def _request_spill(self, nbytes: int) -> int:
+        if not self.hostd_address:
+            return 0
+        try:
+            reply = await self.pool.get(self.hostd_address).call(
+                "NodeManager", "SpillObjects",
+                {"bytes_needed": int(nbytes * 1.5)}, timeout=30)
+            return reply.get("freed", 0)
+        except Exception:
+            return 0
 
     def _store_owned_value(self, oid: ObjectID, sv: ser.SerializedValue):
         with self._obj_lock:
@@ -471,9 +497,10 @@ class CoreWorker:
                 finally:
                     buf.release()
         nodes = await self._node_table()
+        # Own node stays in the candidate list: a local store miss with a
+        # local location means the object was SPILLED — the hostd restores
+        # it from disk through the same PullObject RPC.
         for loc in locations:
-            if loc == my_node:
-                continue
             addr = nodes.get(loc)
             if addr is None:
                 continue
@@ -615,7 +642,19 @@ class CoreWorker:
             # Promote big args to the object store (reference: args >100KB go
             # through plasma, _raylet.pyx submit_task).
             oid = ObjectID.for_put(self.current_task_id, self._next_put_index())
-            self._store_owned_value(oid, sv)
+            try:
+                self._store_owned_value(oid, sv)
+            except ObjectStoreFullError:
+                for attempt in range(3):
+                    freed = await self._request_spill(sv.total_size)
+                    try:
+                        self._store_owned_value(oid, sv)
+                        break
+                    except ObjectStoreFullError:
+                        if not freed:
+                            await asyncio.sleep(0.2)
+                else:
+                    self._store_owned_value(oid, sv)
             st = self.objects[oid]
             st.pins += 1
             return RefArg(oid.binary(), self.address)

@@ -91,6 +91,18 @@ class NodeDaemon:
             "RAY_TPU_MAX_WORKERS",
             max(8, int(self.resources_total.get("CPU", 1)) * 4)))
         self._capacity_freed: asyncio.Event | None = None  # made on start()
+        # Object spilling (reference: raylet LocalObjectManager
+        # local_object_manager.h:41 + _private/external_storage.py:246
+        # FileSystemStorage).  With spilling on, LRU eviction is disabled:
+        # primary copies are written to disk under memory pressure and
+        # restored on demand instead of destroyed.
+        self.spill_enabled = os.environ.get("RAY_TPU_SPILL", "1") != "0"
+        self.spill_dir = os.environ.get("RAY_TPU_SPILL_DIR") or os.path.join(
+            session_dir, "spill", self.node_id.hex()[:12])
+        self.spill_high = float(os.environ.get("RAY_TPU_SPILL_HIGH", "0.8"))
+        self.spill_low = float(os.environ.get("RAY_TPU_SPILL_LOW", "0.5"))
+        self.spilled: dict[bytes, tuple[str, int]] = {}  # id -> (path, size)
+        self.spilled_bytes = 0
 
     # ---------------- worker pool ----------------
 
@@ -360,7 +372,12 @@ class NodeDaemon:
         buf = self.store.get(ObjectID(req["id"]), timeout_ms=int(
             req.get("timeout_ms", 0)))
         if buf is None:
-            return {"found": False}
+            restored = self._read_spilled(req["id"])
+            if restored is None:
+                return {"found": False}
+            data, metadata = restored
+            return {"found": True, "data": data, "metadata": metadata,
+                    "spilled": True}
         try:
             return {"found": True, "data": bytes(buf.data),
                     "metadata": buf.metadata}
@@ -380,6 +397,7 @@ class NodeDaemon:
     async def free_object(self, req):
         from ray_tpu._private.ids import ObjectID
         self.store.delete(ObjectID(req["id"]))
+        self._drop_spilled(req["id"])
         return {"ok": True}
 
     async def free_objects(self, req):
@@ -388,10 +406,130 @@ class NodeDaemon:
         from ray_tpu._private.ids import ObjectID
         for id_binary in req["ids"]:
             self.store.delete(ObjectID(id_binary))
+            self._drop_spilled(id_binary)
         return {"ok": True}
 
     async def store_stats(self, req):
-        return self.store.stats()
+        stats = self.store.stats()
+        stats["spilled_objects"] = len(self.spilled)
+        stats["spilled_bytes"] = self.spilled_bytes
+        return stats
+
+    # ---------------- spilling ----------------
+
+    def _spill_some(self, bytes_needed: int = 0) -> int:
+        """Spill sealed, unreferenced objects (oldest LRU first) until
+        usage is under the low watermark (plus any immediate need)."""
+        stats = self.store.stats()
+        used, cap = stats["used"], stats["capacity"]
+        goal = self.spill_low * cap
+        if bytes_needed:
+            goal = min(goal, cap - min(bytes_needed, cap))
+        if used <= (self.spill_high * cap if not bytes_needed else goal):
+            return 0
+        os.makedirs(self.spill_dir, exist_ok=True)
+        freed = 0
+        for oid, size, refcount, sealed, _tick in self.store.list_objects():
+            if used - freed <= goal:
+                break
+            if not sealed or refcount != 0:
+                continue
+            if oid.binary() in self.spilled:
+                continue
+            buf = self.store.get(oid, timeout_ms=0)
+            if buf is None:
+                continue
+            path = os.path.join(self.spill_dir, oid.hex())
+            try:
+                meta = bytes(buf.metadata) if buf.metadata else b""
+                data = bytes(buf.data)
+            finally:
+                buf.release()
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(len(meta).to_bytes(8, "little"))
+                f.write(meta)
+                f.write(data)
+            os.replace(tmp, path)
+            self.spilled[oid.binary()] = (path, size)
+            self.spilled_bytes += size
+            self.store.delete(oid)
+            freed += size
+        if freed:
+            logger.info("spilled %d bytes (%d objects on disk)", freed,
+                        len(self.spilled))
+        return freed
+
+    def _read_spilled(self, id_binary: bytes):
+        ent = self.spilled.get(id_binary)
+        if ent is None:
+            return None
+        path, _size = ent
+        try:
+            with open(path, "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                meta = f.read(meta_len)
+                data = f.read()
+            return data, meta
+        except FileNotFoundError:
+            return None
+
+    def _drop_spilled(self, id_binary: bytes):
+        ent = self.spilled.pop(id_binary, None)
+        if ent is not None:
+            self.spilled_bytes -= ent[1]
+            try:
+                os.unlink(ent[0])
+            except OSError:
+                pass
+
+    async def spill_objects(self, req):
+        """Spill request from a worker whose put hit OOM (reference:
+        raylet SpillObjects RPC, core_worker.proto:443).  Disk writes run
+        in an executor thread — blocking the daemon loop would starve
+        heartbeats and lease RPCs exactly when the node is under memory
+        pressure."""
+        if not self.spill_enabled:
+            return {"freed": 0}
+        loop = asyncio.get_running_loop()
+        freed = await loop.run_in_executor(
+            None, self._spill_some, req.get("bytes_needed", 0))
+        return {"freed": freed}
+
+    async def _spill_loop(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(0.5)
+            try:
+                if self.spill_enabled:
+                    await loop.run_in_executor(None, self._spill_some, 0)
+            except Exception:
+                logger.exception("spill sweep failed")
+
+    async def list_workers(self, req):
+        """Per-node worker table for the state API (reference:
+        experimental/state/api.py list_workers via raylet)."""
+        out = []
+        for handle in self.workers.values():
+            out.append({
+                "pid": handle.proc.pid,
+                "worker_id": (handle.worker_id.hex()
+                              if handle.worker_id else None),
+                "state": handle.state,
+                "job_id": handle.job_id,
+                "address": handle.address,
+                "lease_id": handle.lease_id,
+                "lease_resources": dict(handle.lease_resources),
+                "actor_id": (handle.actor_id.hex()
+                             if handle.actor_id else None),
+                "idle_s": round(time.monotonic() - handle.idle_since, 1)
+                          if handle.state == "idle" else None,
+                "alive": handle.proc.poll() is None,
+            })
+        return {"workers": out, "node_id": self.node_id.hex(),
+                "store": self.store.stats(),
+                "resources_total": dict(self.resources_total),
+                "resources_available": dict(self.resources_available)}
 
     # ---------------- lifecycle ----------------
 
@@ -473,12 +611,18 @@ class NodeDaemon:
         self.server.register("NodeManager", "FreeObject", self.free_object)
         self.server.register("NodeManager", "FreeObjects", self.free_objects)
         self.server.register("NodeManager", "StoreStats", self.store_stats)
+        self.server.register("NodeManager", "SpillObjects",
+                             self.spill_objects)
+        self.server.register("NodeManager", "ListWorkers", self.list_workers)
         self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
         port = await self.server.start(port)
         await self.gcs.call("Gcs", "register_node", {"info": self.node_info()},
                             timeout=10)
         self._tasks = [asyncio.ensure_future(self._heartbeat_loop()),
                        asyncio.ensure_future(self._reaper_loop())]
+        if self.spill_enabled:
+            self.store.set_eviction(False)
+            self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         return port
 
     def install_signal_handlers(self):

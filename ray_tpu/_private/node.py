@@ -35,6 +35,11 @@ class ProcessGroup:
     def __init__(self):
         self.procs: list[subprocess.Popen] = []
 
+    def wait(self):
+        """Block until every tracked daemon exits (CLI --block mode)."""
+        for p in self.procs:
+            p.wait()
+
     def reap(self, timeout: float = 5.0):
         # Reverse order: hostds before the GCS, so each hostd can still kill
         # its workers and deregister while the control plane is up.

@@ -19,6 +19,8 @@ from ray_tpu import _native
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError, RayTpuTimeoutError
 
+_ID_SIZE = 28  # kIdSize in _native/objstore.cc
+
 _OK = 0
 _EXISTS = -1
 _NOT_FOUND = -2
@@ -54,6 +56,11 @@ def _load():
         lib.tpus_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.tpus_reclaim.argtypes = [ctypes.c_void_p]
         lib.tpus_stats.argtypes = [ctypes.c_void_p, u64p, u64p, u64p, u64p]
+        lib.tpus_set_eviction.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tpus_list.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), u64p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint32),
+            u64p, ctypes.c_uint32]
         _lib = lib
     return _lib
 
@@ -191,6 +198,37 @@ class ObjectStore:
         """Drop refs and unsealed creations of clients whose process died.
         Also runs automatically when an allocation fails."""
         return _load().tpus_reclaim(self._h) == 1
+
+    def set_eviction(self, enabled: bool) -> None:
+        """Toggle LRU eviction.  Spilling daemons disable it and reclaim
+        space by spilling to disk instead (reference: plasma pins primary
+        copies; raylet LocalObjectManager spills them)."""
+        _check(_load().tpus_set_eviction(self._h, 1 if enabled else 0),
+               "set_eviction")
+
+    def list_objects(self, max_n: int = 65536) -> list:
+        """Enumerate live objects: [(ObjectID, total_size, refcount,
+        sealed, lru_tick)], oldest-first by lru_tick."""
+        lib = _load()
+        ids = (ctypes.c_uint8 * (_ID_SIZE * max_n))()
+        sizes = (ctypes.c_uint64 * max_n)()
+        refs = (ctypes.c_int32 * max_n)()
+        states = (ctypes.c_uint32 * max_n)()
+        ticks = (ctypes.c_uint64 * max_n)()
+        n = lib.tpus_list(self._h, ids,
+                          ctypes.cast(sizes, ctypes.POINTER(ctypes.c_uint64)),
+                          ctypes.cast(refs, ctypes.POINTER(ctypes.c_int32)),
+                          ctypes.cast(states, ctypes.POINTER(ctypes.c_uint32)),
+                          ctypes.cast(ticks, ctypes.POINTER(ctypes.c_uint64)),
+                          max_n)
+        _check(min(n, 0), "list")
+        out = []
+        raw = bytes(ids)
+        for i in range(n):
+            out.append((ObjectID(raw[_ID_SIZE * i:_ID_SIZE * (i + 1)]), sizes[i],
+                        refs[i], states[i] == 2, ticks[i]))
+        out.sort(key=lambda e: e[4])
+        return out
 
     def stats(self) -> dict:
         cap = ctypes.c_uint64()

@@ -1,0 +1,187 @@
+"""`ray_tpu` command-line interface.
+
+Reference parity: python/ray/scripts/scripts.py (start:529, stop:1013,
+status:1955, memory:1905) and the state CLI (`ray list`, `ray summary`,
+experimental/state/state_cli.py).
+
+Usage:
+    python -m ray_tpu.scripts.cli start --head [--num-cpus N]
+    python -m ray_tpu.scripts.cli start --address GCS_ADDR
+    python -m ray_tpu.scripts.cli status  --address GCS_ADDR
+    python -m ray_tpu.scripts.cli list {nodes,actors,workers,placement-groups,objects} --address GCS_ADDR
+    python -m ray_tpu.scripts.cli memory --address GCS_ADDR
+    python -m ray_tpu.scripts.cli stop   --address GCS_ADDR
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _fmt_table(rows, columns) -> str:
+    if not rows:
+        return "(none)"
+    widths = [max(len(str(c)), max(len(str(r.get(c, ""))) for r in rows))
+              for c in columns]
+    lines = ["  ".join(str(c).ljust(w) for c, w in zip(columns, widths))]
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(
+            str(r.get(c, "")).ljust(w) for c, w in zip(columns, widths)))
+    return "\n".join(lines)
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private import node as node_mod
+    if args.head:
+        session_dir = node_mod.new_session_dir()
+        group = node_mod.ProcessGroup()
+        gcs_address = node_mod.start_gcs(session_dir, group)
+        node_mod.start_hostd(
+            gcs_address, session_dir, group, num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus, head=True,
+            store_capacity=args.object_store_memory)
+        print(f"GCS address: {gcs_address}")
+        print(f"Session dir: {session_dir}")
+        print(f"Connect with ray_tpu.init(address={gcs_address!r}) or "
+              f"join nodes with: python -m ray_tpu.scripts.cli start "
+              f"--address {gcs_address}")
+        if args.block:
+            try:
+                group.wait()
+            except KeyboardInterrupt:
+                group.reap()
+        return 0
+    if not args.address:
+        print("either --head or --address is required", file=sys.stderr)
+        return 2
+    session_dir = node_mod.new_session_dir()
+    group = node_mod.ProcessGroup()
+    info = node_mod.start_hostd(
+        args.address, session_dir, group, num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus, head=False,
+        store_capacity=args.object_store_memory)
+    print(f"Node started, daemon at {info['address']} "
+          f"(node {info['node_id'][:12]})")
+    if args.block:
+        try:
+            group.wait()
+        except KeyboardInterrupt:
+            group.reap()
+    return 0
+
+
+def cmd_stop(args) -> int:
+    from ray_tpu._private.rpc import RpcClient
+
+    async def stop():
+        client = RpcClient(args.address)
+        try:
+            await client.call("Gcs", "shutdown_cluster", {}, timeout=10)
+        finally:
+            await client.close()
+
+    asyncio.run(stop())
+    print("cluster shutdown requested")
+    return 0
+
+
+def cmd_status(args) -> int:
+    from ray_tpu import state
+    s = state.summarize_cluster(args.address)
+    if args.json:
+        print(json.dumps(s, indent=2))
+        return 0
+    print(f"Nodes: {s['nodes_alive']} alive, {s['nodes_dead']} dead")
+    print("Resources:")
+    for k, total in sorted(s["resources_total"].items()):
+        avail = s["resources_available"].get(k, 0.0)
+        print(f"  {k}: {total - avail:g}/{total:g} used")
+    print(f"Actors: " + (", ".join(
+        f"{n} {st}" for st, n in sorted(s["actors"].items())) or "none"))
+    print(f"Placement groups: {s['placement_groups']}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu import state
+    kind = args.kind.replace("-", "_")
+    fn = {
+        "nodes": (state.list_nodes,
+                  ["node_id", "address", "alive", "is_head",
+                   "resources_total"]),
+        "actors": (state.list_actors,
+                   ["actor_id", "class_name", "state", "name", "node_id",
+                    "num_restarts"]),
+        "workers": (state.list_workers,
+                    ["node_id", "pid", "state", "job_id", "actor_id",
+                     "idle_s"]),
+        "placement_groups": (state.list_placement_groups,
+                             ["placement_group_id", "state", "strategy",
+                              "bundles"]),
+        "objects": (state.list_objects, None),
+    }.get(kind)
+    if fn is None:
+        print(f"unknown kind {args.kind!r}", file=sys.stderr)
+        return 2
+    rows = fn[0](args.address)
+    if args.json or fn[1] is None:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    for r in rows:  # truncate ids for table form
+        for key in ("node_id", "actor_id", "placement_group_id"):
+            if isinstance(r.get(key), str) and len(r[key]) > 12:
+                r[key] = r[key][:12]
+    print(_fmt_table(rows, fn[1]))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    from ray_tpu import state
+    rows = [r for r in state.list_objects(args.address) if "capacity" in r]
+    for r in rows:
+        r["node_id"] = r["node_id"][:12]
+        r["used_mb"] = round(r.pop("used", 0) / 1e6, 1)
+        r["capacity_mb"] = round(r.pop("capacity", 0) / 1e6, 1)
+    print(_fmt_table(rows, ["node_id", "used_mb", "capacity_mb",
+                            "num_objects", "num_evictions"]))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head node or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--object-store-memory", type=int, default=256 << 20)
+    sp.add_argument("--block", action="store_true",
+                    help="stay attached; ctrl-c tears the node down")
+    sp.set_defaults(fn=cmd_start)
+
+    for name, fn in (("stop", cmd_stop), ("status", cmd_status),
+                     ("memory", cmd_memory)):
+        q = sub.add_parser(name)
+        q.add_argument("--address", required=True)
+        q.add_argument("--json", action="store_true")
+        q.set_defaults(fn=fn)
+
+    q = sub.add_parser("list", help="list live cluster entities")
+    q.add_argument("kind", choices=["nodes", "actors", "workers",
+                                    "placement-groups", "objects"])
+    q.add_argument("--address", required=True)
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_list)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

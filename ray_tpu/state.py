@@ -1,0 +1,164 @@
+"""State observability API: list live cluster entities.
+
+Reference parity: python/ray/experimental/state/api.py (list_actors,
+list_nodes, list_placement_groups, list_workers, list_objects,
+summarize_*) backed by dashboard/state_aggregator.py over GCS tables.
+Here the GCS tables and per-node daemons are queried directly; works both
+inside a connected driver (address=None) and standalone against a GCS
+address (the CLI's mode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+
+def _run(coro):
+    from ray_tpu import api
+    if api._worker is not None:
+        return api._worker.io.run(coro)
+    return asyncio.run(coro)
+
+
+def _gcs_address(address: Optional[str]) -> str:
+    if address:
+        return address
+    from ray_tpu import api
+    if api._worker is not None:
+        return api._worker.gcs_address
+    raise RuntimeError(
+        "not connected: pass address= or call ray_tpu.init() first")
+
+
+async def _gcs_call(address: str, method: str, req: dict | None = None):
+    from ray_tpu._private.rpc import RpcClient
+    from ray_tpu import api
+    if api._worker is not None and address == api._worker.gcs_address:
+        return await api._worker.gcs.call("Gcs", method, req or {})
+    client = RpcClient(address)
+    try:
+        return await client.call("Gcs", method, req or {}, timeout=30)
+    finally:
+        await client.close()
+
+
+def list_nodes(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    addr = _gcs_address(address)
+    reply = _run(_gcs_call(addr, "get_nodes"))
+    return [{
+        "node_id": n.node_id.hex(),
+        "address": n.address,
+        "alive": n.alive,
+        "is_head": n.is_head,
+        "resources_total": dict(n.resources_total),
+        "resources_available": dict(n.resources_available),
+    } for n in reply["nodes"]]
+
+
+def list_actors(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    addr = _gcs_address(address)
+    reply = _run(_gcs_call(addr, "list_actors"))
+    out = []
+    for a in reply["actors"]:
+        out.append({
+            "actor_id": a.actor_id.hex(),
+            "class_name": a.class_name,
+            "state": a.state,
+            "name": a.name or None,
+            "namespace": a.namespace or None,
+            "node_id": a.node_id.hex() if a.node_id else None,
+            "num_restarts": a.num_restarts,
+            "death_cause": a.death_cause or None,
+        })
+    return out
+
+
+def list_placement_groups(address: Optional[str] = None
+                          ) -> List[Dict[str, Any]]:
+    addr = _gcs_address(address)
+    reply = _run(_gcs_call(addr, "list_placement_groups"))
+    return [{
+        "placement_group_id": p.pg_id.hex(),
+        "state": p.state,
+        "strategy": p.strategy,
+        "bundles": list(p.bundles),
+        "bundle_nodes": [n.hex() if n else None for n in p.bundle_nodes],
+    } for p in reply["placement_groups"]]
+
+
+async def _each_node(address: str, service: str, method: str,
+                     req: dict | None = None) -> Dict[str, Any]:
+    from ray_tpu._private.rpc import RpcClient
+    nodes = (await _gcs_call(address, "get_nodes"))["nodes"]
+    out = {}
+    for n in nodes:
+        if not n.alive:
+            continue
+        client = RpcClient(n.address)
+        try:
+            out[n.node_id.hex()] = await client.call(
+                service, method, req or {}, timeout=10)
+        except Exception as e:
+            out[n.node_id.hex()] = {"error": repr(e)}
+        finally:
+            await client.close()
+    return out
+
+
+def list_workers(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Worker processes across every alive node."""
+    addr = _gcs_address(address)
+    per_node = _run(_each_node(addr, "NodeManager", "ListWorkers"))
+    out = []
+    for node_id, reply in per_node.items():
+        for w in reply.get("workers", []):
+            out.append({"node_id": node_id, **w})
+    return out
+
+
+def list_objects(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Object-store summary per node (per-object enumeration requires the
+    owner's table; the connected driver's own objects are included)."""
+    addr = _gcs_address(address)
+    per_node = _run(_each_node(addr, "NodeManager", "StoreStats"))
+    out = [{"node_id": nid, **stats} for nid, stats in per_node.items()]
+    from ray_tpu import api
+    if api._worker is not None:
+        w = api._worker
+        for oid, st in list(w.objects.items()):
+            out.append({
+                "object_id": oid.hex(), "owner": "self",
+                "pending": st.pending, "pins": st.pins,
+                "local_refs": st.local_refs,
+                "locations": [l.hex() if hasattr(l, "hex") else str(l)
+                              for l in st.locations],
+            })
+    return out
+
+
+def summarize_cluster(address: Optional[str] = None) -> Dict[str, Any]:
+    addr = _gcs_address(address)
+    nodes = list_nodes(addr)
+    actors = list_actors(addr)
+    pgs = list_placement_groups(addr)
+    total: Dict[str, float] = {}
+    avail: Dict[str, float] = {}
+    for n in nodes:
+        if not n["alive"]:
+            continue
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0.0) + v
+    by_state: Dict[str, int] = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    return {
+        "nodes_alive": sum(n["alive"] for n in nodes),
+        "nodes_dead": sum(not n["alive"] for n in nodes),
+        "resources_total": total,
+        "resources_available": avail,
+        "actors": by_state,
+        "placement_groups": len(pgs),
+    }
