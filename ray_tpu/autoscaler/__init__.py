@@ -1,0 +1,26 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling with TPU-pod
+awareness.
+
+Reference parity: python/ray/autoscaler/ (StandardAutoscaler
+_private/autoscaler.py:172, LoadMetrics _private/load_metrics.py:65,
+bin-packing ResourceDemandScheduler _private/resource_demand_scheduler.py:103,
+pluggable NodeProvider node_provider.py:13 incl. fake_multi_node for
+tests).  TPU twist (SURVEY P1): a node type can declare an atomic
+slice — a v5p pod slice scales as a unit of N hosts, never host-by-host.
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.load_metrics import LoadMetrics  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+)
+
+__all__ = [
+    "FakeNodeProvider", "LoadMetrics", "NodeProvider", "NodeTypeConfig",
+    "ResourceDemandScheduler", "StandardAutoscaler",
+]

@@ -1,0 +1,122 @@
+"""StandardAutoscaler: the update loop gluing load -> bin-packing ->
+provider.
+
+Reference parity: python/ray/autoscaler/_private/autoscaler.py:172
+(update:370 — read load, launch for unfulfilled demand, terminate idle
+nodes past the timeout) driven by the monitor daemon
+(_private/monitor.py:126); here `update()` is called by a loop or a test
+directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+)
+
+logger = logging.getLogger("ray_tpu.autoscaler")
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider,
+                 node_types: Dict[str, NodeTypeConfig],
+                 gcs_address: str, *,
+                 idle_timeout_s: float = 60.0,
+                 max_launch_batch: int = 16):
+        self.provider = provider
+        self.node_types = node_types
+        self.scheduler = ResourceDemandScheduler(node_types)
+        self.load = LoadMetrics(gcs_address)
+        self.idle_timeout_s = idle_timeout_s
+        self.max_launch_batch = max_launch_batch
+        self._idle_since: Dict[str, float] = {}   # runtime node id -> t0
+        self.launched_total: Dict[str, int] = {}
+        self.terminated_total = 0
+
+    def update(self) -> Dict[str, int]:
+        """One reconciliation pass; returns node_type -> launched count."""
+        snap = self.load.snapshot()
+        existing_avail = [dict(n.resources_available) for n in snap.nodes]
+        counts: Dict[str, int] = {}
+        for ptype in self.provider.non_terminated_nodes().values():
+            counts[ptype] = counts.get(ptype, 0) + 1
+
+        demands = list(snap.pending_actor_demands)
+        pg_demands = [(p.strategy, p.bundles) for p in snap.pending_pgs]
+        plan = self.scheduler.get_nodes_to_launch(
+            existing_avail, counts, demands, pg_demands)
+
+        launched: Dict[str, int] = {}
+        for node_type, count in plan.items():
+            count = min(count, self.max_launch_batch)
+            logger.info("scaling up: %d x %s", count, node_type)
+            self.provider.create_nodes(node_type, count)
+            launched[node_type] = count
+            self.launched_total[node_type] = (
+                self.launched_total.get(node_type, 0) + count)
+
+        self._terminate_idle(snap)
+        return launched
+
+    def _terminate_idle(self, snap) -> None:
+        now = time.monotonic()
+        idle = set(snap.idle_node_ids)
+        for nid in list(self._idle_since):
+            if nid not in idle:
+                del self._idle_since[nid]
+        by_runtime = {}
+        for pid in self.provider.non_terminated_nodes():
+            rid = self.provider.runtime_node_id(pid)
+            if rid:
+                by_runtime[rid] = pid
+        runtime_of = {pid: rid for rid, pid in by_runtime.items()}
+        terminated: set = set()
+        for nid in idle:
+            if nid not in by_runtime or by_runtime[nid] in terminated:
+                continue  # not ours (e.g. the head) or already gone
+            t0 = self._idle_since.setdefault(nid, now)
+            if now - t0 >= self.idle_timeout_s:
+                pid = by_runtime[nid]
+                # A TPU slice is atomic in BOTH directions: only terminate
+                # when EVERY host of the slice has been idle past the
+                # timeout, then take the whole slice down together.
+                members = self.provider.slice_members(pid)
+                def _expired(member_pid):
+                    rid = runtime_of.get(member_pid)
+                    return (rid in idle and now - self._idle_since.get(
+                        rid, now) >= self.idle_timeout_s)
+                if not all(_expired(m) for m in members):
+                    continue
+                logger.info("scaling down idle %s (%d hosts)",
+                            nid[:12], len(members))
+                for m in members:
+                    self.provider.terminate_node(m)
+                    terminated.add(m)
+                    rid = runtime_of.get(m)
+                    if rid:
+                        self._idle_since.pop(rid, None)
+                    self.terminated_total += 1
+
+
+TPU_POD_TYPES = {
+    # Atomic TPU slices: one entry = one host's resources, slice_hosts =
+    # hosts per slice (4 chips/host).  Scaling unit = the whole slice.
+    "tpu-v5p-8": NodeTypeConfig(
+        "tpu-v5p-8", {"CPU": 100.0, "TPU": 4.0, "TPU-v5p-head": 1.0},
+        max_workers=64, slice_hosts=1),
+    "tpu-v5p-32": NodeTypeConfig(
+        "tpu-v5p-32", {"CPU": 100.0, "TPU": 4.0},
+        max_workers=64, slice_hosts=4),
+    "tpu-v5p-128": NodeTypeConfig(
+        "tpu-v5p-128", {"CPU": 100.0, "TPU": 4.0},
+        max_workers=128, slice_hosts=16),
+    "cpu-worker": NodeTypeConfig(
+        "cpu-worker", {"CPU": 16.0}, max_workers=100, slice_hosts=1),
+}

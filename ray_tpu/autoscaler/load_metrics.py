@@ -1,0 +1,69 @@
+"""LoadMetrics: the autoscaler's view of cluster load and pending demand.
+
+Reference parity: python/ray/autoscaler/_private/load_metrics.py:65 — but
+where the reference receives raylet load pushes through the monitor, this
+pulls the GCS tables directly: node resource usage, PENDING placement
+groups (bundle lists + strategy), and PENDING actors (their resource
+demand).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+@dataclass
+class PendingPG:
+    strategy: str
+    bundles: List[Dict[str, float]]
+
+
+@dataclass
+class LoadSnapshot:
+    nodes: List[Any] = field(default_factory=list)        # NodeInfo, alive
+    pending_pgs: List[PendingPG] = field(default_factory=list)
+    pending_actor_demands: List[Dict[str, float]] = field(
+        default_factory=list)
+    idle_node_ids: List[str] = field(default_factory=list)
+    at: float = 0.0
+
+
+class LoadMetrics:
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+
+    async def _fetch(self) -> LoadSnapshot:
+        from ray_tpu._private.rpc import RpcClient
+        gcs = RpcClient(self.gcs_address)
+        try:
+            nodes = (await gcs.call("Gcs", "get_nodes", {}))["nodes"]
+            pgs = (await gcs.call("Gcs", "list_placement_groups",
+                                  {}))["placement_groups"]
+            actors = (await gcs.call("Gcs", "list_actors", {}))["actors"]
+        finally:
+            await gcs.close()
+        snap = LoadSnapshot(at=time.monotonic())
+        snap.nodes = [n for n in nodes if n.alive]
+        for p in pgs:
+            if p.state in ("PENDING", "RESCHEDULING"):
+                snap.pending_pgs.append(
+                    PendingPG(p.strategy, [dict(b) for b in p.bundles]))
+        for a in actors:
+            if a.state == "PENDING":
+                demand = a.resources.to_dict() if a.resources else {}
+                snap.pending_actor_demands.append(
+                    {k: v for k, v in demand.items() if v > 0})
+        # Idle = all resources free (no leases, no actors placed there).
+        for n in snap.nodes:
+            if n.is_head:
+                continue
+            if all(abs(n.resources_available.get(k, 0.0) - v) < 1e-9
+                   for k, v in n.resources_total.items()):
+                snap.idle_node_ids.append(n.node_id.hex())
+        return snap
+
+    def snapshot(self) -> LoadSnapshot:
+        return asyncio.run(self._fetch())

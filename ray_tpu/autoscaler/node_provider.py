@@ -1,0 +1,90 @@
+"""NodeProvider: the pluggable boundary to actual machines.
+
+Reference parity: python/ray/autoscaler/node_provider.py:13 (create_node /
+terminate_node / non_terminated_nodes) and the in-process
+fake_multi_node provider used for tests — here the fake provider drives
+cluster_utils.Cluster, adding/removing real hostd daemons.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Interface. node_type names index the autoscaler's NodeTypeConfig."""
+
+    def create_nodes(self, node_type: str, count: int) -> List[str]:
+        """Launch `count` nodes of `node_type`; returns provider node ids."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """provider node id -> node_type."""
+        raise NotImplementedError
+
+    def runtime_node_id(self, provider_node_id: str) -> Optional[str]:
+        """The GCS NodeID hex once the node joined, else None."""
+        raise NotImplementedError
+
+    def slice_members(self, provider_node_id: str) -> List[str]:
+        """Provider node ids forming this node's atomic slice (just the
+        node itself for non-slice types).  A slice terminates as a unit."""
+        return [provider_node_id]
+
+
+class FakeNodeProvider(NodeProvider):
+    """Drives an in-process cluster_utils.Cluster — every 'launched' node
+    is a real hostd daemon (reference: fake_multi_node provider)."""
+
+    def __init__(self, cluster, node_types: Dict[str, Any]):
+        self.cluster = cluster
+        self.node_types = node_types
+        self._nodes: Dict[str, dict] = {}   # provider id -> cluster node
+        self._types: Dict[str, str] = {}
+        self._slices: Dict[str, str] = {}   # provider id -> slice group id
+
+    def create_nodes(self, node_type: str, count: int) -> List[str]:
+        cfg = self.node_types[node_type]
+        slice_hosts = getattr(cfg, "slice_hosts", 1)
+        out = []
+        slice_id = None
+        for i in range(count):
+            if slice_hosts > 1 and i % slice_hosts == 0:
+                slice_id = f"slice-{uuid.uuid4().hex[:8]}"
+            resources = dict(cfg.resources)
+            cpus = resources.pop("CPU", 1)
+            tpus = resources.pop("TPU", None)
+            node = self.cluster.add_node(
+                num_cpus=cpus, num_tpus=tpus, resources=resources or None)
+            pid = f"fake-{node_type}-{uuid.uuid4().hex[:8]}"
+            self._nodes[pid] = node
+            self._types[pid] = node_type
+            if slice_hosts > 1:
+                self._slices[pid] = slice_id
+            out.append(pid)
+        self.cluster.wait_for_nodes()
+        return out
+
+    def slice_members(self, provider_node_id: str) -> List[str]:
+        sid = self._slices.get(provider_node_id)
+        if sid is None:
+            return [provider_node_id]
+        return [p for p, g in self._slices.items() if g == sid]
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        node = self._nodes.pop(provider_node_id, None)
+        self._types.pop(provider_node_id, None)
+        self._slices.pop(provider_node_id, None)
+        if node is not None:
+            self.cluster.remove_node(node, allow_graceful=True)
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        return dict(self._types)
+
+    def runtime_node_id(self, provider_node_id: str) -> Optional[str]:
+        node = self._nodes.get(provider_node_id)
+        return node["node_id"] if node else None

@@ -1,0 +1,142 @@
+"""Bin-packing: unfulfilled demand -> nodes to launch.
+
+Reference parity: python/ray/autoscaler/_private/
+resource_demand_scheduler.py:103 (get_nodes_to_launch:171).  TPU-specific
+semantics: a NodeTypeConfig with slice_hosts > 1 is an ATOMIC slice —
+launches happen in whole-slice multiples and a STRICT_PACK placement group
+asking for the slice's combined shape maps onto one slice (SURVEY P1: a
+v5p-128 is an atomic scaling unit, unlike GPU nodes).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    max_workers: int = 10
+    # Atomic slice: scaling unit = this many hosts of `resources` each.
+    slice_hosts: int = 1
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v
+               for k, v in demand.items() if v > 0)
+
+
+def _sub(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        if v > 0:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+class ResourceDemandScheduler:
+    def __init__(self, node_types: Dict[str, NodeTypeConfig]):
+        self.node_types = node_types
+
+    def get_nodes_to_launch(
+            self, existing: List[Dict[str, float]],
+            existing_counts: Dict[str, int],
+            demands: List[Dict[str, float]],
+            pg_demands: List[Tuple[str, List[Dict[str, float]]]],
+    ) -> Dict[str, int]:
+        """existing: available-resource dicts of alive nodes;
+        existing_counts: node_type -> current count (launch caps);
+        demands: flat resource demands (pending actors/tasks);
+        pg_demands: (strategy, bundles) for pending placement groups.
+        Returns node_type -> count to launch (slice types in whole-slice
+        multiples)."""
+        virtual = [dict(a) for a in existing]
+        to_launch: Dict[str, int] = {}
+        counts = dict(existing_counts)
+
+        def capacity_left(cfg: NodeTypeConfig) -> int:
+            return max(0, cfg.max_workers - counts.get(cfg.name, 0))
+
+        def launch(cfg: NodeTypeConfig, hosts: int) -> int:
+            """Launch enough slices/hosts to add >= hosts; returns added."""
+            if cfg.slice_hosts > 1:
+                slices = math.ceil(hosts / cfg.slice_hosts)
+                hosts = slices * cfg.slice_hosts
+            hosts = min(hosts, capacity_left(cfg))
+            if hosts <= 0:
+                return 0
+            if cfg.slice_hosts > 1:
+                hosts = (hosts // cfg.slice_hosts) * cfg.slice_hosts
+                if hosts <= 0:
+                    return 0
+            to_launch[cfg.name] = to_launch.get(cfg.name, 0) + hosts
+            counts[cfg.name] = counts.get(cfg.name, 0) + hosts
+            for _ in range(hosts):
+                virtual.append(dict(cfg.resources))
+            return hosts
+
+        def place(demand: Dict[str, float]) -> bool:
+            for avail in virtual:
+                if _fits(avail, demand):
+                    _sub(avail, demand)
+                    return True
+            return False
+
+        # Placement groups first (gang semantics: all bundles or nothing).
+        for strategy, bundles in pg_demands:
+            snapshot = [dict(a) for a in virtual]
+            placed_all = all(place(b) for b in bundles)
+            if placed_all:
+                continue
+            # Roll back partial placement, then launch for the whole gang.
+            del virtual[:]
+            virtual.extend(snapshot)
+            for cfg in self._types_for(bundles):
+                hosts_needed = self._hosts_for_bundles(cfg, bundles, strategy)
+                if hosts_needed and launch(cfg, hosts_needed):
+                    if all(place(b) for b in bundles):
+                        break
+            # else: demand stays unfulfilled (caps/infeasible) — reported
+            # by the autoscaler, matching the reference's behavior.
+
+        for demand in demands:
+            if place(demand):
+                continue
+            for cfg in self._types_for([demand]):
+                if launch(cfg, 1) and place(demand):
+                    break
+        return to_launch
+
+    def _types_for(self, bundles: List[Dict[str, float]]):
+        """Node types that can host the largest bundle, smallest first."""
+        biggest = {}
+        for b in bundles:
+            for k, v in b.items():
+                biggest[k] = max(biggest.get(k, 0.0), v)
+        fitting = [c for c in self.node_types.values()
+                   if _fits(c.resources, biggest)]
+        return sorted(fitting,
+                      key=lambda c: sum(c.resources.values()) * c.slice_hosts)
+
+    def _hosts_for_bundles(self, cfg: NodeTypeConfig,
+                           bundles: List[Dict[str, float]],
+                           strategy: str) -> int:
+        """How many `cfg` hosts the bundle set needs (first-fit-decreasing
+        per host; STRICT_SPREAD = one bundle per host)."""
+        if strategy == "STRICT_SPREAD":
+            return len(bundles)
+        hosts: List[Dict[str, float]] = []
+        order = sorted(bundles, key=lambda b: -sum(b.values()))
+        for b in order:
+            for h in hosts:
+                if _fits(h, b):
+                    _sub(h, b)
+                    break
+            else:
+                h = dict(cfg.resources)
+                if not _fits(h, b):
+                    return 0  # this type can never host the bundle
+                _sub(h, b)
+                hosts.append(h)
+        return len(hosts)

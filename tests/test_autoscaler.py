@@ -1,0 +1,144 @@
+"""Autoscaler tests: bin-packing decisions (unit, mocked state) and the
+end-to-end fake-provider flow where a pending placement group triggers a
+real scale-up and then schedules.
+
+Reference coverage model: python/ray/tests/test_autoscaler.py (mocked
+NodeProvider unit tests) + test_autoscaler_fake_multinode.py (e2e with the
+fake provider).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    FakeNodeProvider,
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+)
+
+
+# ---------------------------------------------------------------------------
+# Unit: bin-packing
+# ---------------------------------------------------------------------------
+
+
+def _sched(**types):
+    return ResourceDemandScheduler(
+        {name: NodeTypeConfig(name, res, max_workers=mw,
+                              slice_hosts=sh)
+         for name, (res, mw, sh) in types.items()})
+
+
+def test_binpack_launches_for_flat_demand():
+    s = _sched(cpu=({"CPU": 4.0}, 10, 1))
+    plan = s.get_nodes_to_launch(
+        existing=[{"CPU": 1.0}], existing_counts={},
+        demands=[{"CPU": 2.0}, {"CPU": 2.0}, {"CPU": 2.0}],
+        pg_demands=[])
+    assert plan == {"cpu": 2}  # 3x2 CPU, one node packs two demands
+
+
+def test_binpack_respects_max_workers():
+    s = _sched(cpu=({"CPU": 1.0}, 2, 1))
+    plan = s.get_nodes_to_launch(
+        existing=[], existing_counts={"cpu": 1},
+        demands=[{"CPU": 1.0}] * 5, pg_demands=[])
+    assert plan == {"cpu": 1}  # cap 2, one already exists
+
+
+def test_binpack_pg_gang_semantics():
+    s = _sched(cpu=({"CPU": 4.0}, 10, 1))
+    plan = s.get_nodes_to_launch(
+        existing=[{"CPU": 4.0}], existing_counts={"cpu": 1},
+        demands=[],
+        pg_demands=[("STRICT_SPREAD", [{"CPU": 2.0}] * 3)])
+    # One bundle fits the existing node; STRICT_SPREAD needs 3 hosts total.
+    assert plan == {"cpu": 3}
+
+
+def test_binpack_tpu_slice_is_atomic():
+    """A v5p-style slice scales in whole-slice host multiples (SURVEY P1)."""
+    s = _sched(slice=({"CPU": 100.0, "TPU": 4.0}, 64, 4))
+    plan = s.get_nodes_to_launch(
+        existing=[], existing_counts={},
+        demands=[],
+        pg_demands=[("PACK", [{"TPU": 4.0}] * 2)])  # 2 hosts of demand
+    assert plan == {"slice": 4}  # rounded up to one whole 4-host slice
+
+    plan = s.get_nodes_to_launch(
+        existing=[], existing_counts={},
+        demands=[{"TPU": 4.0}] * 5, pg_demands=[])
+    assert plan["slice"] % 4 == 0 and plan["slice"] >= 8
+
+
+def test_binpack_infeasible_type_not_chosen():
+    s = _sched(small=({"CPU": 2.0}, 10, 1), big=({"CPU": 16.0}, 10, 1))
+    plan = s.get_nodes_to_launch(
+        existing=[], existing_counts={},
+        demands=[{"CPU": 8.0}], pg_demands=[])
+    assert plan == {"big": 1}
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: pending PG -> scale-up -> PG schedules
+# ---------------------------------------------------------------------------
+
+
+def test_autoscaler_scales_up_for_pending_pg():
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=cluster.address)
+        provider = FakeNodeProvider(cluster, {
+            "cpu-worker": NodeTypeConfig("cpu-worker", {"CPU": 2.0},
+                                         max_workers=4),
+        })
+        autoscaler = StandardAutoscaler(
+            provider, provider.node_types, cluster.address,
+            idle_timeout_s=3600)
+
+        # A 2-host gang the 1-node cluster cannot satisfy.
+        pg = placement_group([{"CPU": 2.0}, {"CPU": 2.0}],
+                             strategy="STRICT_SPREAD")
+        assert not pg.wait(2), "PG should pend before scale-up"
+
+        launched = autoscaler.update()
+        assert sum(launched.values()) >= 1, "expected a scale-up decision"
+        assert pg.wait(60), "PG must schedule after scale-up"
+        remove_placement_group(pg)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_autoscaler_scales_down_idle_nodes():
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=cluster.address)
+        provider = FakeNodeProvider(cluster, {
+            "cpu-worker": NodeTypeConfig("cpu-worker", {"CPU": 2.0},
+                                         max_workers=4),
+        })
+        autoscaler = StandardAutoscaler(
+            provider, provider.node_types, cluster.address,
+            idle_timeout_s=0.5)
+        provider.create_nodes("cpu-worker", 1)
+        assert len(provider.non_terminated_nodes()) == 1
+
+        autoscaler.update()          # records idle t0
+        time.sleep(0.8)
+        autoscaler.update()          # past idle timeout -> terminate
+        assert len(provider.non_terminated_nodes()) == 0
+        assert autoscaler.terminated_total == 1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
