@@ -23,12 +23,28 @@ SERVE_NAMESPACE = "serve"
 
 
 @dataclass
+class AutoscalingConfig:
+    """Queue-depth replica autoscaling (reference:
+    serve/_private/autoscaling_policy.py + serve/config.py
+    AutoscalingConfig): desired = ceil(total_ongoing_requests /
+    target_ongoing_requests), clamped to [min, max], applied after the
+    respective delay has elapsed continuously."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.2
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
 class DeploymentConfig:
     name: str
     num_replicas: int = 1
     max_concurrent_queries: int = 100
     ray_actor_options: dict = field(default_factory=dict)
     user_config: Any = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
     version: int = 0
 
 
@@ -45,19 +61,33 @@ class ReplicaActor:
         if user_config is not None and hasattr(self._callable,
                                                "reconfigure"):
             self._callable.reconfigure(user_config)
+        self._metrics_lock = threading.Lock()
+        self._ongoing = 0
 
     def handle_request(self, method_name, args, kwargs):
-        target = self._callable
-        if method_name and method_name != "__call__":
-            target = getattr(self._callable, method_name)
-        elif not callable(target):
-            raise TypeError("deployment object is not callable")
-        import asyncio
-        import inspect
-        result = target(*args, **(kwargs or {}))
-        if inspect.iscoroutine(result):
-            result = asyncio.run(result)
-        return result
+        with self._metrics_lock:
+            self._ongoing += 1
+        try:
+            target = self._callable
+            if method_name and method_name != "__call__":
+                target = getattr(self._callable, method_name)
+            elif not callable(target):
+                raise TypeError("deployment object is not callable")
+            import asyncio
+            import inspect
+            result = target(*args, **(kwargs or {}))
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._metrics_lock:
+                self._ongoing -= 1
+
+    def ongoing_requests(self) -> int:
+        """Autoscaling load signal (reference: replicas report queue
+        metrics to the controller)."""
+        with self._metrics_lock:
+            return self._ongoing
 
     def reconfigure(self, user_config):
         if hasattr(self._callable, "reconfigure"):
@@ -68,83 +98,226 @@ class ReplicaActor:
         return "pong"
 
 
-@ray_tpu.remote
+@ray_tpu.remote(max_concurrency=64)
 class ServeController:
     """Deployment table + reconciliation (reference: controller.py:71,
-    DeploymentStateManager deployment_state.py:1864)."""
+    DeploymentStateManager deployment_state.py:1864).  Threaded actor:
+    the control loop (autoscaling) and long-poll waiters run alongside
+    deploy/routing calls; the deployment table is lock-protected."""
 
     def __init__(self):
         # name -> {"config": DeploymentConfig, "replicas": [handles],
         #          "deployed_def": (cls, args, kwargs)}
         self._deployments: Dict[str, dict] = {}
+        self._lock = threading.RLock()
         self._version = 0
+        self._version_cv = threading.Condition(self._lock)
+        self._loop_started = False
+        self._stopped = False
+        # name -> (desired_replicas, since_monotonic) scale intent
+        self._scale_intent: Dict[str, tuple] = {}
+
+    def _bump_version(self):
+        with self._version_cv:
+            self._version += 1
+            self._version_cv.notify_all()
+
+    # ---------------- long-poll config plane ----------------
+
+    def poll_routing(self, name: str, known_version: int,
+                     timeout_s: float = 10.0):
+        """Block until the config version moves past known_version (or
+        timeout), then return the routing table (reference:
+        _private/long_poll.py:68 LongPollHost)."""
+        deadline = time.monotonic() + timeout_s
+        with self._version_cv:
+            while self._version == known_version and not self._stopped:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._version_cv.wait(remaining)
+        return self.get_routing(name)
+
+    # ---------------- autoscaling control loop ----------------
+
+    def run_control_loop(self, interval_s: float = 0.2):
+        """Reference: the controller's run loop (controller.py) driving
+        autoscaling_policy decisions.  Runs on one of this threaded
+        actor's pool threads forever."""
+        with self._lock:
+            if self._loop_started:
+                return False
+            self._loop_started = True
+        while not self._stopped:
+            try:
+                self._autoscale_pass()
+            except Exception:
+                pass
+            time.sleep(interval_s)
+        return True
+
+    def _autoscale_pass(self):
+        with self._lock:
+            entries = {n: e for n, e in self._deployments.items()
+                       if e["config"].autoscaling_config is not None}
+        for name, entry in entries.items():
+            cfg: DeploymentConfig = entry["config"]
+            auto: AutoscalingConfig = cfg.autoscaling_config
+            replicas = list(entry["replicas"])
+            if not replicas:
+                continue
+            total = 0
+            for r in replicas:
+                try:
+                    total += ray_tpu.get(r.ongoing_requests.remote(),
+                                         timeout=5)
+                except Exception:
+                    pass
+            import math
+            desired = max(auto.min_replicas,
+                          min(auto.max_replicas,
+                              math.ceil(total /
+                                        max(auto.target_ongoing_requests,
+                                            1e-9))))
+            now = time.monotonic()
+            current = len(replicas)
+            if desired == current:
+                self._scale_intent.pop(name, None)
+                continue
+            intent = self._scale_intent.get(name)
+            if intent is None or intent[0] != desired:
+                self._scale_intent[name] = (desired, now)
+                continue
+            delay = (auto.upscale_delay_s if desired > current
+                     else auto.downscale_delay_s)
+            if now - intent[1] < delay:
+                continue
+            with self._lock:
+                entry = self._deployments.get(name)
+                if entry is None:
+                    continue
+                entry["config"].num_replicas = desired
+            self._reconcile(name)
+            self._scale_intent.pop(name, None)
+            self._bump_version()
 
     def deploy(self, config: DeploymentConfig, cls_or_fn, init_args,
                init_kwargs):
-        entry = self._deployments.get(config.name)
-        if entry is None:
-            entry = {"config": config, "replicas": [],
-                     "deployed_def": (cls_or_fn, init_args, init_kwargs)}
-            self._deployments[config.name] = entry
-        else:
-            entry["config"] = config
-            entry["deployed_def"] = (cls_or_fn, init_args, init_kwargs)
+        with self._lock:
+            entry = self._deployments.get(config.name)
+            if entry is None:
+                entry = {"config": config, "replicas": [],
+                         "deployed_def": (cls_or_fn, init_args, init_kwargs)}
+                self._deployments[config.name] = entry
+            else:
+                entry["config"] = config
+                entry["deployed_def"] = (cls_or_fn, init_args, init_kwargs)
+                # New code/config version: existing replicas are stale and
+                # get replaced below (reference: deployment_state.py rolling
+                # version replacement).
+                entry["def_version"] = entry.get("def_version", 0) + 1
+            if config.autoscaling_config is not None:
+                config.num_replicas = max(
+                    config.autoscaling_config.min_replicas,
+                    min(config.num_replicas,
+                        config.autoscaling_config.max_replicas))
         self._reconcile(config.name)
-        self._version += 1
+        self._bump_version()
         return {"name": config.name, "replicas": len(entry["replicas"])}
 
     def _reconcile(self, name: str):
-        entry = self._deployments[name]
-        config: DeploymentConfig = entry["config"]
-        cls_or_fn, args, kwargs = entry["deployed_def"]
-        replicas: List = entry["replicas"]
-        # Health-check existing replicas; drop the dead.
-        alive = []
-        for r in replicas:
-            try:
-                ray_tpu.get(r.ping.remote(), timeout=10)
-                alive.append(r)
-            except Exception:
-                pass
-        replicas[:] = alive
-        opts = dict(config.ray_actor_options)
-        while len(replicas) < config.num_replicas:
-            actor = ReplicaActor.options(
-                num_cpus=opts.get("num_cpus", 0.1),
-                num_tpus=opts.get("num_tpus"),
-                resources=opts.get("resources"),
-                max_restarts=2,
-                # Replicas must execute up to max_concurrent_queries requests
-                # at once, or @serve.batch could never accumulate a batch.
-                max_concurrency=config.max_concurrent_queries,
-            ).remote(cls_or_fn, args, kwargs, config.user_config)
-            replicas.append(actor)
-        while len(replicas) > config.num_replicas:
-            victim = replicas.pop()
-            try:
-                ray_tpu.kill(victim)
-            except Exception:
-                pass
-        # Verify new replicas constructed (surface user __init__ errors).
-        for r in replicas:
-            ray_tpu.get(r.ping.remote(), timeout=120)
+        """Converge the replica set.  Blocking actor RPCs (pings, replica
+        construction) run WITHOUT the table lock — holding it would stall
+        every get_routing/poll_routing for the duration of a replica cold
+        start.  A per-deployment lock serializes concurrent reconciles."""
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:
+                return
+            rlock = entry.setdefault("_rlock", threading.Lock())
+        with rlock:
+            with self._lock:
+                entry = self._deployments.get(name)
+                if entry is None:
+                    return
+                config: DeploymentConfig = entry["config"]
+                cls_or_fn, args, kwargs = entry["deployed_def"]
+                replicas = list(entry["replicas"])
+                def_version = entry.setdefault("def_version", 0)
+                vers = dict(entry.setdefault("replica_vers", {}))
+            # ---- unlocked: health checks / kills / constructions ----
+            alive = []
+            for r in replicas:
+                key = r._actor_id.binary()
+                if vers.get(key, def_version) != def_version:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                    vers.pop(key, None)
+                    continue
+                try:
+                    ray_tpu.get(r.ping.remote(), timeout=10)
+                    alive.append(r)
+                except Exception:
+                    vers.pop(key, None)
+            replicas = alive
+            opts = dict(config.ray_actor_options)
+            while len(replicas) < config.num_replicas:
+                actor = ReplicaActor.options(
+                    num_cpus=opts.get("num_cpus", 0.1),
+                    num_tpus=opts.get("num_tpus"),
+                    resources=opts.get("resources"),
+                    max_restarts=2,
+                    # Replicas must execute up to max_concurrent_queries
+                    # requests at once, or @serve.batch could never
+                    # accumulate a batch.
+                    max_concurrency=config.max_concurrent_queries,
+                ).remote(cls_or_fn, args, kwargs, config.user_config)
+                replicas.append(actor)
+                vers[actor._actor_id.binary()] = def_version
+            while len(replicas) > config.num_replicas:
+                victim = replicas.pop()
+                vers.pop(victim._actor_id.binary(), None)
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+            # Verify new replicas constructed (surface user __init__
+            # errors) before committing them to the routing table.
+            for r in replicas:
+                ray_tpu.get(r.ping.remote(), timeout=120)
+            with self._lock:
+                entry = self._deployments.get(name)
+                if entry is None:
+                    for r in replicas:
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                    return
+                entry["replicas"][:] = replicas
+                entry["replica_vers"] = vers
 
     def get_routing(self, name: str):
-        entry = self._deployments.get(name)
-        if entry is None:
-            return None
-        return {"replicas": list(entry["replicas"]),
-                "max_concurrent_queries":
-                    entry["config"].max_concurrent_queries,
-                "version": self._version}
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:
+                return None
+            return {"replicas": list(entry["replicas"]),
+                    "max_concurrent_queries":
+                        entry["config"].max_concurrent_queries,
+                    "version": self._version}
 
     def list_deployments(self):
-        return {name: {"num_replicas": len(e["replicas"]),
-                       "target": e["config"].num_replicas}
-                for name, e in self._deployments.items()}
+        with self._lock:
+            return {name: {"num_replicas": len(e["replicas"]),
+                           "target": e["config"].num_replicas}
+                    for name, e in self._deployments.items()}
 
     def delete_deployment(self, name: str):
-        entry = self._deployments.pop(name, None)
+        with self._lock:
+            entry = self._deployments.pop(name, None)
         if entry is None:
             return False
         for r in entry["replicas"]:
@@ -152,39 +325,65 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:
                 pass
-        self._version += 1
+        self._bump_version()
         return True
 
     def heal(self, name: str):
         """Router-reported replica failure: reconcile this deployment."""
-        if name in self._deployments:
-            self._reconcile(name)
-            self._version += 1
+        self._reconcile(name)
+        self._bump_version()
         return True
 
     def shutdown(self):
+        self._stopped = True
+        with self._version_cv:
+            self._version_cv.notify_all()
         for name in list(self._deployments):
             self.delete_deployment(name)
         return True
 
 
+class _RouterState:
+    """Per-deployment routing state SHARED by every handle in the process:
+    one replica table, one in-flight map, one long-poll thread — however
+    many DeploymentHandle facades exist (reference: handles share the
+    Router; r2 review: per-handle pollers leaked a thread per
+    handle.options() call)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.Lock()
+        self.replicas: List = []
+        self.max_q = 100
+        self.rr = 0
+        # In-flight counts keyed by stable replica identity (actor id).
+        self.in_flight: Dict[bytes, int] = {}
+        self.fetched_at = 0.0
+        self.known_version = -1
+        self.poller: Optional[threading.Thread] = None
+
+
+_router_states: Dict[str, _RouterState] = {}
+_router_states_lock = threading.Lock()
+
+
+def _get_router_state(name: str) -> _RouterState:
+    with _router_states_lock:
+        st = _router_states.get(name)
+        if st is None:
+            st = _router_states[name] = _RouterState(name)
+        return st
+
+
 class DeploymentHandle:
     """Client-side handle with round-robin + in-flight cap (reference:
     handle.py over router.py:224-263).  Picklable: travels to replicas so
-    deployments can compose."""
+    deployments can compose.  Routing state is shared per deployment."""
 
     def __init__(self, deployment_name: str, method_name: str = "__call__"):
         self._name = deployment_name
         self._method = method_name
-        self._lock = threading.Lock()
-        self._replicas: List = []
-        self._max_q = 100
-        self._rr = 0
-        # In-flight counts keyed by stable replica identity (actor id) —
-        # index keys would mis-attribute counts after _refresh/heal
-        # replaces the replica list.
-        self._in_flight: Dict[bytes, int] = {}
-        self._fetched_at = 0.0
+        self._state = _get_router_state(deployment_name)
 
     def options(self, method_name: str) -> "DeploymentHandle":
         return DeploymentHandle(self._name, method_name)
@@ -194,46 +393,90 @@ class DeploymentHandle:
             raise AttributeError(item)
         return _MethodCaller(self, item)
 
-    def _refresh(self, force=False):
-        with self._lock:
-            if not force and self._replicas \
-                    and time.monotonic() - self._fetched_at < 2.0:
-                return
-            controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
-            routing = ray_tpu.get(
-                controller.get_routing.remote(self._name), timeout=30)
-            if routing is None:
-                raise ValueError(f"deployment {self._name!r} not found")
-            self._replicas = routing["replicas"]
-            self._max_q = routing["max_concurrent_queries"]
-            self._fetched_at = time.monotonic()
-            alive = {r._actor_id.binary() for r in self._replicas}
-            for key in list(self._in_flight):
+    def _apply_routing(self, routing) -> None:
+        st = self._state
+        with st.lock:
+            st.replicas = routing["replicas"]
+            st.max_q = routing["max_concurrent_queries"]
+            st.known_version = routing.get("version", -1)
+            st.fetched_at = time.monotonic()
+            alive = {r._actor_id.binary() for r in st.replicas}
+            for key in list(st.in_flight):
                 if key not in alive:
-                    del self._in_flight[key]
+                    del st.in_flight[key]
+
+    def _refresh(self, force=False):
+        st = self._state
+        with st.lock:
+            fresh = (not force and st.replicas
+                     and time.monotonic() - st.fetched_at < 2.0)
+        if fresh:
+            self._ensure_poller()
+            return
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+        routing = ray_tpu.get(
+            controller.get_routing.remote(self._name), timeout=30)
+        if routing is None:
+            raise ValueError(f"deployment {self._name!r} not found")
+        self._apply_routing(routing)
+        self._ensure_poller()
+
+    def _ensure_poller(self):
+        """Config changes PUSH to the shared router state via ONE
+        controller long-poll thread per deployment (reference:
+        _private/long_poll.py:185 config propagation)."""
+        st = self._state
+        with st.lock:
+            if st.poller is not None and st.poller.is_alive():
+                return
+            st.poller = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name=f"serve-longpoll-{self._name}")
+            st.poller.start()
+
+    def _poll_loop(self):
+        import ray_tpu.api as _api
+        st = self._state
+        while _api._worker is not None:
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                               SERVE_NAMESPACE)
+                routing = ray_tpu.get(
+                    controller.poll_routing.remote(
+                        self._name, st.known_version, 10.0),
+                    timeout=30)
+                if routing is None:
+                    return  # deployment deleted
+                if routing.get("version", -1) != st.known_version:
+                    self._apply_routing(routing)
+            except Exception:
+                time.sleep(1.0)
 
     def remote(self, *args, **kwargs):
         return self._call(self._method, args, kwargs)
+
+    def _pick_replica(self):
+        """One routing decision under the in-flight cap; returns
+        (replica, key) or None when every replica is saturated."""
+        st = self._state
+        with st.lock:
+            n = len(st.replicas)
+            order = [(st.rr + i) % n for i in range(n)] if n else []
+            st.rr += 1
+            for idx in order:
+                key = st.replicas[idx]._actor_id.binary()
+                if st.in_flight.get(key, 0) < st.max_q:
+                    st.in_flight[key] = st.in_flight.get(key, 0) + 1
+                    return st.replicas[idx], key
+        return None
 
     def _call(self, method, args, kwargs):
         self._refresh()
         deadline = time.monotonic() + 60
         while True:
-            with self._lock:
-                n = len(self._replicas)
-                order = [(self._rr + i) % n for i in range(n)] if n else []
-                self._rr += 1
-                pick = None
-                for idx in order:
-                    key = self._replicas[idx]._actor_id.binary()
-                    if self._in_flight.get(key, 0) < self._max_q:
-                        pick = idx
-                        break
+            pick = self._pick_replica()
             if pick is not None:
-                replica = self._replicas[pick]
-                key = replica._actor_id.binary()
-                with self._lock:
-                    self._in_flight[key] = self._in_flight.get(key, 0) + 1
+                replica, key = pick
                 ref = replica.handle_request.remote(method, args, kwargs)
                 return _TrackedRef(ref, self, key, method, args, kwargs)
             if time.monotonic() > deadline:
@@ -242,10 +485,69 @@ class DeploymentHandle:
                     f"max_concurrent_queries cap within 60s")
             time.sleep(0.01)  # every replica saturated: backpressure
 
+    async def call_async(self, method, args, kwargs, *,
+                         timeout: float = 60.0, _retried=False):
+        """Async-native request path (reference: the ASGI proxy awaits the
+        router/replica without burning a thread per request)."""
+        import asyncio
+
+        from ray_tpu.exceptions import ActorDiedError
+
+        self._refresh()
+        deadline = time.monotonic() + timeout
+        while True:
+            pick = self._pick_replica()
+            if pick is not None:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {self._name!r} under its "
+                    f"max_concurrent_queries cap within {timeout}s")
+            await asyncio.sleep(0.005)
+        replica, key = pick
+        ref = replica.handle_request.remote(method, args, kwargs)
+        released = False
+
+        def release(_=None):
+            nonlocal released
+            if not released:
+                released = True
+                self._done(key)
+
+        try:
+            fut = asyncio.wrap_future(ref.future())
+            try:
+                result = await asyncio.wait_for(
+                    fut, max(0.1, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                # The request is STILL running on the replica — keep its
+                # in-flight slot charged until the underlying call
+                # completes, or the admission cap would over-admit.
+                fut.add_done_callback(release)
+                raise TimeoutError(
+                    f"request to {self._name!r} timed out")
+            release()
+            return result
+        except ActorDiedError:
+            release()
+            if _retried:
+                raise
+            self._on_replica_error()
+            return await self.call_async(
+                method, args, kwargs,
+                timeout=max(0.1, deadline - time.monotonic()),
+                _retried=True)
+        except TimeoutError:
+            raise
+        except BaseException:
+            release()
+            raise
+
     def _done(self, key: bytes):
-        with self._lock:
-            if key in self._in_flight:
-                self._in_flight[key] = max(0, self._in_flight[key] - 1)
+        st = self._state
+        with st.lock:
+            if key in st.in_flight:
+                st.in_flight[key] = max(0, st.in_flight[key] - 1)
 
     def _on_replica_error(self):
         try:
@@ -281,7 +583,7 @@ class _TrackedRef:
         self._retried = retried
 
     def result(self, timeout: Optional[float] = None):
-        from ray_tpu.exceptions import ActorDiedError
+        from ray_tpu.exceptions import ActorDiedError, RayTpuTimeoutError
         try:
             value = ray_tpu.get(self._ref, timeout=timeout)
         except ActorDiedError:
@@ -293,6 +595,13 @@ class _TrackedRef:
             retry = self._handle._call(method, args, kwargs)
             retry._retried = True
             return retry.result(timeout)
+        except RayTpuTimeoutError:
+            # Still executing on the replica: keep the slot charged until
+            # it actually finishes (admission-cap correctness).
+            handle, key = self._handle, self._idx
+            self._ref.future().add_done_callback(
+                lambda _: handle._done(key))
+            raise
         except BaseException:
             self._handle._done(self._idx)
             raise

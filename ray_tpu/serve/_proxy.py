@@ -42,20 +42,17 @@ class HTTPProxyActor:
                     payload = (await request.read()).decode()
             else:
                 payload = dict(request.query) or None
-            loop = asyncio.get_running_loop()
-
-            def call():
-                try:
-                    tracked = handle.remote(payload)
-                    return tracked.result(timeout=60), None
-                except ValueError as e:
-                    return None, (404, str(e))
-                except Exception as e:  # noqa: BLE001
-                    return None, (500, f"{type(e).__name__}: {e}")
-
-            result, err = await loop.run_in_executor(None, call)
-            if err is not None:
-                return web.json_response({"error": err[1]}, status=err[0])
+            # Async-native path: the routing decision and the reply await
+            # run on this event loop — no thread per in-flight request
+            # (reference: fully-async ASGI proxy, http_proxy.py:250).
+            try:
+                result = await handle.call_async(
+                    handle._method, (payload,), {}, timeout=60)
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500)
             return web.json_response({"result": result})
 
         async def healthz(_):

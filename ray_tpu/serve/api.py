@@ -9,20 +9,24 @@ from typing import Any, Callable, Optional
 
 import ray_tpu
 from ray_tpu.serve._private import (
-    CONTROLLER_NAME, SERVE_NAMESPACE, DeploymentConfig, DeploymentHandle,
-    ServeController)
+    CONTROLLER_NAME, SERVE_NAMESPACE, AutoscalingConfig, DeploymentConfig,
+    DeploymentHandle, ServeController)
 
 _http_proxy = None
 
 
 def _get_or_start_controller():
     try:
-        return ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
     except ValueError:
-        return ServeController.options(
+        controller = ServeController.options(
             name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
             lifetime="detached", num_cpus=0.1,
             get_if_exists=True).remote()
+        # Fire-and-forget: the autoscaling/reconciliation loop runs on one
+        # of the threaded controller's pool threads (idempotent).
+        controller.run_control_loop.remote()
+    return controller
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 0,
@@ -31,10 +35,11 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
     Returns the proxy port when a proxy was started."""
     global _http_proxy
     _get_or_start_controller()
-    if with_proxy and _http_proxy is None:
-        from ray_tpu.serve._proxy import HTTPProxyActor
-        _http_proxy = HTTPProxyActor.options(num_cpus=0.1).remote(
-            http_host, http_port)
+    if with_proxy:
+        if _http_proxy is None:
+            from ray_tpu.serve._proxy import HTTPProxyActor
+            _http_proxy = HTTPProxyActor.options(num_cpus=0.1).remote(
+                http_host, http_port)
         return ray_tpu.get(_http_proxy.address.remote(), timeout=60)
     return None
 
@@ -61,7 +66,8 @@ class Deployment:
                 num_replicas: Optional[int] = None,
                 max_concurrent_queries: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
-                user_config: Any = None) -> "Deployment":
+                user_config: Any = None,
+                autoscaling_config: Optional[dict] = None) -> "Deployment":
         import copy
         cfg = copy.deepcopy(self._config)
         if num_replicas is not None:
@@ -72,6 +78,11 @@ class Deployment:
             cfg.ray_actor_options = dict(ray_actor_options)
         if user_config is not None:
             cfg.user_config = user_config
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = (
+                autoscaling_config
+                if isinstance(autoscaling_config, AutoscalingConfig)
+                else AutoscalingConfig(**autoscaling_config))
         new_name = name or self.name
         cfg.name = new_name
         return Deployment(self._cls_or_fn, new_name, cfg)
@@ -83,16 +94,23 @@ class Deployment:
 def deployment(_cls_or_fn=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 100,
                ray_actor_options: Optional[dict] = None,
-               user_config: Any = None):
+               user_config: Any = None,
+               autoscaling_config: Optional[dict] = None):
     """@serve.deployment decorator."""
 
     def wrap(cls_or_fn):
         dep_name = name or getattr(cls_or_fn, "__name__", "deployment")
+        auto = None
+        if autoscaling_config is not None:
+            auto = (autoscaling_config
+                    if isinstance(autoscaling_config, AutoscalingConfig)
+                    else AutoscalingConfig(**autoscaling_config))
         cfg = DeploymentConfig(
             name=dep_name, num_replicas=num_replicas,
             max_concurrent_queries=max_concurrent_queries,
             ray_actor_options=dict(ray_actor_options or {}),
-            user_config=user_config)
+            user_config=user_config,
+            autoscaling_config=auto)
         return Deployment(cls_or_fn, dep_name, cfg)
 
     return wrap(_cls_or_fn) if _cls_or_fn is not None else wrap
