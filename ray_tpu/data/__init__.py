@@ -6,7 +6,12 @@ sort/groupby, parquet/csv/json/numpy/text IO, split() for per-worker
 ingest.
 """
 
-from ray_tpu.data.dataset import Dataset, GroupedData  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    DataIterator,
+    Dataset,
+    GroupedData,
+)
+from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401
 from ray_tpu.data.read_api import (  # noqa: F401
     from_arrow,
     from_items,

@@ -22,6 +22,7 @@ import pyarrow as pa
 import ray_tpu
 from ray_tpu.data import block as blk
 from ray_tpu.data.executor import (
+    ActorPoolStrategy,
     AllToAll, ExecPlan, OneToOne, execute, iter_output_refs)
 
 
@@ -63,6 +64,92 @@ def _hash_partition(block, key, n):
 @ray_tpu.remote
 def _concat_remote(*blocks):
     return blk.concat_blocks(list(blocks))
+
+
+@ray_tpu.remote
+def _partition_random(block, n, seed):
+    """Assign each row to one of n shuffle partitions (seeded)."""
+    if n == 1:
+        return block
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n, size=block.num_rows)
+    return tuple(block.take(pa.array(np.nonzero(assign == j)[0]))
+                 for j in range(n))
+
+
+@ray_tpu.remote
+def _partition_chunks(block, n):
+    """Split a block into n even row-range chunks."""
+    if n == 1:
+        return block
+    rows = block.num_rows
+    per = -(-rows // n) if rows else 1
+    return tuple(blk.slice_block(block, min(j * per, rows),
+                                 min((j + 1) * per, rows))
+                 for j in range(n))
+
+
+@ray_tpu.remote
+def _partition_range(block, key, boundaries):
+    """Range-partition by sorted boundaries (len(boundaries)+1 parts)."""
+    n = len(boundaries) + 1
+    if n == 1:
+        return block
+    if block.num_rows == 0 or key not in block.schema.names:
+        return tuple(blk.slice_block(block, 0, 0) for _ in range(n))
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    assign = np.searchsorted(np.asarray(boundaries), col, side="right")
+    return tuple(block.take(pa.array(np.nonzero(assign == j)[0]))
+                 for j in range(n))
+
+
+@ray_tpu.remote
+def _merge_shuffled(seed, *parts):
+    whole = blk.concat_blocks(list(parts))
+    if whole.num_rows == 0:
+        return whole
+    rng = np.random.default_rng(seed)
+    return whole.take(pa.array(rng.permutation(whole.num_rows)))
+
+
+@ray_tpu.remote
+def _merge_sorted(key, order, *parts):
+    whole = blk.concat_blocks(list(parts))
+    if whole.num_rows == 0:
+        return whole
+    return whole.take(pa.compute.sort_indices(whole,
+                                              sort_keys=[(key, order)]))
+
+
+@ray_tpu.remote
+def _sample_keys(block, key, k):
+    if block.num_rows == 0 or key not in block.schema.names:
+        return []
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    if len(col) <= k:
+        return list(col)
+    idx = np.random.default_rng(0).choice(len(col), size=k, replace=False)
+    return list(col[idx])
+
+
+@ray_tpu.remote
+def _slice_remote(block, start, end):
+    return blk.slice_block(block, start, end)
+
+
+def _scatter_merge(refs, partitioner, merger, n):
+    """Map-side partition + reduce-side merge, all in remote tasks — the
+    driver moves only refs (reference: _internal/push_based_shuffle.py
+    two-phase map/merge; ADVICE r1: all-to-all must not materialize on
+    the driver)."""
+    if not refs:
+        return refs
+    parts = [partitioner(r) for r in refs]
+    if n == 1:
+        cols = [parts]
+    else:
+        cols = [[parts[i][j] for i in range(len(refs))] for j in range(n)]
+    return [merger(j, cols[j]) for j in range(n)]
 
 
 @ray_tpu.remote
@@ -122,10 +209,20 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     batch_size: Optional[int] = None,
-                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+                    fn_kwargs: Optional[dict] = None,
+                    compute: Optional["ActorPoolStrategy"] = None
+                    ) -> "Dataset":
+        """compute=ActorPoolStrategy(size=N) runs the stage on a pool of
+        long-lived actors — fn may be a CLASS whose instances cache
+        expensive state (model weights) across blocks (reference:
+        actor_pool_map_operator.py)."""
         kwargs = fn_kwargs or {}
+        callable_holder = [fn]
 
         def do(block):
+            f = callable_holder[0]
+            if isinstance(f, type):
+                f = callable_holder[0] = f()  # construct once per worker
             if block.num_rows == 0:
                 return block
             size = batch_size or block.num_rows
@@ -134,8 +231,12 @@ class Dataset:
                 piece = blk.slice_block(block, start,
                                         min(start + size, block.num_rows))
                 batch = blk.block_to_batch(piece, batch_format)
-                outs.append(blk.batch_to_block(fn(batch, **kwargs)))
+                outs.append(blk.batch_to_block(f(batch, **kwargs)))
             return blk.concat_blocks(outs)
+
+        if compute is not None:
+            return Dataset(self._plan.with_stage(
+                OneToOne(do, "map_batches", compute=compute)))
         return self._with_one_to_one(do, "map_batches")
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
@@ -159,39 +260,53 @@ class Dataset:
 
     def repartition(self, num_blocks: int) -> "Dataset":
         def do(refs):
-            blocks = ray_tpu.get(list(refs))
-            whole = blk.concat_blocks(blocks)
             n = max(1, num_blocks)
-            per = max(1, -(-whole.num_rows // n)) if whole.num_rows else 1
-            out = []
-            for i in range(n):
-                piece = blk.slice_block(whole, min(i * per, whole.num_rows),
-                                        min((i + 1) * per, whole.num_rows))
-                out.append(ray_tpu.put(piece))
-            return out
+            return _scatter_merge(
+                refs,
+                lambda r: _partition_chunks.options(num_returns=n)
+                .remote(r, n),
+                lambda j, col: _concat_remote.remote(*col), n)
         return Dataset(self._plan.with_stage(AllToAll(do, "repartition")))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         def do(refs):
-            blocks = ray_tpu.get(list(refs))
-            whole = blk.concat_blocks(blocks)
-            if whole.num_rows == 0:
-                return [ray_tpu.put(whole)]
-            rng = np.random.default_rng(seed)
-            shuffled = whole.take(pa.array(rng.permutation(whole.num_rows)))
-            return [ray_tpu.put(p) for p in _rechunk(shuffled, len(refs))]
+            n = max(1, len(refs))
+            # seed=None must be nondeterministic per execution (reference
+            # semantics) — draw fresh entropy at execution time.
+            base = seed if seed is not None else int(
+                np.random.SeedSequence().entropy % (2 ** 31))
+            return _scatter_merge(
+                refs,
+                lambda r, _c=iter(range(len(refs))):
+                    _partition_random.options(num_returns=n)
+                    .remote(r, n, base + next(_c)),
+                lambda j, col: _merge_shuffled.remote(base + 7919 * (j + 1),
+                                                      *col), n)
         return Dataset(self._plan.with_stage(AllToAll(do, "random_shuffle")))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         def do(refs):
-            blocks = ray_tpu.get(list(refs))
-            whole = blk.concat_blocks(blocks)
-            if whole.num_rows == 0:
-                return [ray_tpu.put(whole)]
+            n = max(1, len(refs))
             order = "descending" if descending else "ascending"
-            idx = pa.compute.sort_indices(whole, sort_keys=[(key, order)])
-            return [ray_tpu.put(p)
-                    for p in _rechunk(whole.take(idx), len(refs))]
+            if n == 1:
+                return [_merge_sorted.remote(key, order, *refs)]
+            # Sample-based range partitioning (reference: _internal/sort.py
+            # sample -> boundaries -> partition -> per-range merge-sort).
+            samples: list = []
+            for chunk in ray_tpu.get(
+                    [_sample_keys.remote(r, key, 64) for r in refs]):
+                samples.extend(chunk)
+            if not samples:
+                return [_merge_sorted.remote(key, order, *refs)]
+            samples.sort()
+            bounds = [samples[(i + 1) * len(samples) // n]
+                      for i in range(n - 1)]
+            out = _scatter_merge(
+                refs,
+                lambda r: _partition_range.options(num_returns=n)
+                .remote(r, key, bounds),
+                lambda j, col: _merge_sorted.remote(key, order, *col), n)
+            return out[::-1] if descending else out
         return Dataset(self._plan.with_stage(AllToAll(do, "sort")))
 
     def limit(self, n: int) -> "Dataset":
@@ -218,15 +333,41 @@ class Dataset:
         ingest)."""
         refs = self._execute()
         if equal:
-            whole = blk.concat_blocks(ray_tpu.get(list(refs)))
-            per = whole.num_rows // n
-            return [Dataset(ExecPlan([ray_tpu.put(
-                blk.slice_block(whole, i * per, (i + 1) * per))]))
-                for i in range(n)]
+            # Remote slicing against global row offsets — the driver reads
+            # only per-block row counts (ADVICE r1: split(equal) must not
+            # concatenate the dataset in driver memory).
+            counts = [c for c, _ in ray_tpu.get(
+                [_block_meta.remote(r) for r in refs])]
+            total = sum(counts)
+            per = total // n
+            shards: List[List[Any]] = [[] for _ in range(n)]
+            offset = 0
+            for r, c in zip(refs, counts):
+                for i in range(n):
+                    lo, hi = i * per, (i + 1) * per
+                    s0, s1 = max(lo, offset), min(hi, offset + c)
+                    if s1 > s0:
+                        if s1 - s0 == c:
+                            shards[i].append(r)
+                        else:
+                            shards[i].append(_slice_remote.remote(
+                                r, s0 - offset, s1 - offset))
+                offset += c
+            return [Dataset(ExecPlan(s)) for s in shards]
         shards: List[List[Any]] = [[] for _ in range(n)]
         for i, r in enumerate(refs):
             shards[i % n].append(r)
         return [Dataset(ExecPlan(s)) for s in shards]
+
+    def streaming_split(self, n: int, *, equal: bool = False
+                        ) -> List["DataIterator"]:
+        """n independent streaming iterators, one per consumer (Train
+        workers): each holds only ITS shard's block refs and pulls blocks
+        with bounded prefetch — no driver round-trips during iteration
+        (reference: dataset.streaming_split / DataIterator).  Picklable:
+        pass them to actors."""
+        shards = self.split(n, equal=equal)
+        return [DataIterator(d._execute()) for d in shards]
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -466,3 +607,61 @@ class GroupedData:
         out = [_group_apply.remote(p, self._key, fn)
                for p in self._partitions()]
         return Dataset(ExecPlan(out))
+
+
+def _batches_from_refs(refs, batch_size, batch_format, drop_last,
+                       prefetch: int = 4):
+    """Yield batches from block refs with bounded prefetch."""
+    buffer: List[pa.Table] = []
+    buffered = 0
+    pending = list(refs)
+    i = 0
+    while i < len(pending):
+        # Touch ahead: ray_tpu.wait warms up to `prefetch` blocks.
+        ahead = pending[i:i + prefetch]
+        if len(ahead) > 1:
+            ray_tpu.wait(ahead, num_returns=len(ahead), timeout=0,
+                         fetch_local=True)
+        b = ray_tpu.get(pending[i])
+        i += 1
+        if b.num_rows == 0:
+            continue
+        buffer.append(b)
+        buffered += b.num_rows
+        while buffered >= batch_size:
+            whole = blk.concat_blocks(buffer)
+            piece = blk.slice_block(whole, 0, batch_size)
+            rest = blk.slice_block(whole, batch_size, whole.num_rows)
+            buffer = [rest] if rest.num_rows else []
+            buffered = rest.num_rows
+            yield blk.block_to_batch(piece, batch_format)
+    if buffered and not drop_last:
+        yield blk.block_to_batch(blk.concat_blocks(buffer), batch_format)
+
+
+class DataIterator:
+    """A shard's streaming view (reference: data/dataset_iterator.py).
+    Holds block refs only; safe to ship to a worker actor."""
+
+    def __init__(self, refs: List[Any]):
+        self._refs = list(refs)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     prefetch_blocks: int = 4) -> Iterator[Any]:
+        return _batches_from_refs(self._refs, batch_size, batch_format,
+                                  drop_last, prefetch_blocks)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for r in self._refs:
+            yield from blk.block_rows(ray_tpu.get(r))
+
+    def count(self) -> int:
+        return sum(c for c, _ in ray_tpu.get(
+            [_block_meta.remote(r) for r in self._refs]))
+
+    def materialize(self) -> "Dataset":
+        return Dataset(ExecPlan(list(self._refs)))
+
+    def __reduce__(self):
+        return (DataIterator, (self._refs,))

@@ -23,11 +23,35 @@ from ray_tpu.data import block as blk
 
 
 @dataclass
+class ActorPoolStrategy:
+    """compute= for stateful/model-loading transforms: the stage runs on a
+    pool of long-lived actors instead of stateless tasks (reference:
+    execution/operators/actor_pool_map_operator.py + ActorPoolStrategy)."""
+
+    size: int = 2
+    num_cpus: float = 1.0
+    num_tpus: Optional[float] = None
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    """One actor of a map stage's pool; caches the (possibly expensive to
+    construct) transform across blocks."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def run(self, block):
+        return self._fn(block)
+
+
+@dataclass
 class OneToOne:
     """A fusable per-block transform."""
 
     fn: Callable  # block -> block
     name: str
+    compute: Optional[ActorPoolStrategy] = None
 
 
 @dataclass
@@ -66,20 +90,47 @@ def _run_block(block, fused_fn):
 
 
 def _segments(stages: List[Any]) -> List[Tuple[str, Any]]:
-    """Group consecutive OneToOne stages into fused segments."""
+    """Group consecutive stateless OneToOne stages into fused segments;
+    actor-pool stages stand alone (their state lives in the pool)."""
     segs: List[Tuple[str, Any]] = []
     chain: List[OneToOne] = []
+
+    def flush():
+        nonlocal chain
+        if chain:
+            segs.append(("fused", _fuse(chain)))
+            chain = []
+
     for s in stages:
-        if isinstance(s, OneToOne):
+        if isinstance(s, OneToOne) and s.compute is None:
             chain.append(s)
+        elif isinstance(s, OneToOne):
+            flush()
+            segs.append(("actor_pool", s))
         else:
-            if chain:
-                segs.append(("fused", _fuse(chain)))
-                chain = []
+            flush()
             segs.append(("barrier", s))
-    if chain:
-        segs.append(("fused", _fuse(chain)))
+    flush()
     return segs
+
+
+def _run_actor_pool(refs: List[Any], stage: OneToOne) -> List[Any]:
+    strat = stage.compute
+    pool = [_PoolWorker.options(num_cpus=strat.num_cpus,
+                                num_tpus=strat.num_tpus).remote(stage.fn)
+            for _ in range(max(1, strat.size))]
+    out = [pool[i % len(pool)].run.remote(r) for i, r in enumerate(refs)]
+    # Returns live in the node object store / owner memory, not in the
+    # actors — once every result is sealed the pool can be released.
+    if out:
+        ray_tpu.wait(out, num_returns=len(out), timeout=None,
+                     fetch_local=False)
+    for a in pool:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+    return out
 
 
 def execute(plan: ExecPlan, window: int = 16) -> List[Any]:
@@ -100,6 +151,8 @@ def execute(plan: ExecPlan, window: int = 16) -> List[Any]:
                 out.append(task)
             refs = out
             # Let stragglers finish before a subsequent barrier counts rows.
+        elif kind == "actor_pool":
+            refs = _run_actor_pool(refs, seg)
         else:
             refs = seg.fn(refs)
     return refs
@@ -120,6 +173,8 @@ def iter_output_refs(plan: ExecPlan, window: int = 8) -> Iterator[Any]:
             break
         if kind == "fused":
             refs = [_run_block.remote(r, seg) for r in refs]
+        elif kind == "actor_pool":
+            refs = _run_actor_pool(refs, seg)
         else:
             refs = seg.fn(refs)
     if trailing is None:

@@ -23,6 +23,7 @@ from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
     Result,
 )
 from ray_tpu.train.session import (  # noqa: F401
+    get_dataset_shard,
     get_checkpoint,
     get_context,
     get_local_rank,

@@ -43,7 +43,8 @@ class BackendExecutor:
         self._backend.on_start(self.worker_group, self._backend_config)
 
     def start_training(self, train_fn: Callable[[], None],
-                       checkpoint: Optional[Checkpoint] = None):
+                       checkpoint: Optional[Checkpoint] = None,
+                       dataset_shards: Optional[dict] = None):
         wg = self.worker_group
         self._backend.on_training_start(wg, self._backend_config)
         local = wg.local_ranks()
@@ -56,8 +57,10 @@ class BackendExecutor:
                 local_rank=local[rank][0],
                 local_world_size=local[rank][1],
                 node_rank=node_ranks[rank])
+            per_worker = {name: shards[rank] for name, shards
+                          in (dataset_shards or {}).items()}
             refs.append(worker.actor.init_session.remote(
-                train_fn, ctx, checkpoint))
+                train_fn, ctx, checkpoint, per_worker))
         ray_tpu.get(refs, timeout=120)
 
     # How long some workers may keep reporting after others finished before

@@ -89,13 +89,15 @@ class DataParallelTrainer(BaseTrainer):
                  backend_config: Optional[BackendConfig] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[dict] = None):
         super().__init__(scaling_config=scaling_config,
                          run_config=run_config)
         self._train_loop = train_loop_per_worker
         self._train_loop_config = dict(train_loop_config or {})
         self._backend_config = backend_config or self._backend_config_cls()
         self._resume_from = resume_from_checkpoint
+        self._datasets = dict(datasets or {})
 
     def with_config_overrides(self, config: dict):
         import copy
@@ -114,10 +116,19 @@ class DataParallelTrainer(BaseTrainer):
         last_checkpoint = self._resume_from
         error: Optional[BaseException] = None
 
+        # Per-worker streaming ingest: each worker iterates only ITS
+        # shard (reference: DataParallelTrainer datasets= +
+        # session.get_dataset_shard over streaming_split).
+        dataset_shards = {
+            name: ds.streaming_split(self.scaling_config.num_workers,
+                                     equal=True)
+            for name, ds in self._datasets.items()}
+
         executor.start()
         try:
             while True:
-                executor.start_training(train_fn, last_checkpoint)
+                executor.start_training(train_fn, last_checkpoint,
+                                        dataset_shards)
                 try:
                     while True:
                         results = executor.get_next_results()

@@ -34,9 +34,11 @@ class TrainContext:
 
 class _TrainSession:
     def __init__(self, train_fn: Callable[[], Any], context: TrainContext,
-                 checkpoint: Optional[Checkpoint] = None):
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[dict] = None):
         self.context = context
         self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
         self.result_queue: queue.Queue = queue.Queue(maxsize=1)
         self.continue_event = threading.Event()
         self.error: Optional[BaseException] = None
@@ -117,6 +119,17 @@ def get_session() -> "_TrainSession":
 
 def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
     get_session().report(dict(metrics), checkpoint)
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's streaming shard of a trainer dataset (reference:
+    air/session.py get_dataset_shard backed by streaming_split)."""
+    shard = get_session().dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset shard {name!r}; pass datasets={{{name!r}: ds}} to "
+            f"the trainer")
+    return shard
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

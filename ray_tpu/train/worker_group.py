@@ -28,9 +28,11 @@ class RayTrainWorker:
         os.environ.update({k: str(v) for k, v in env.items()})
         return True
 
-    def init_session(self, train_fn, context, checkpoint=None):
+    def init_session(self, train_fn, context, checkpoint=None,
+                     dataset_shards=None):
         from ray_tpu.train import session as session_mod
-        sess = session_mod._TrainSession(train_fn, context, checkpoint)
+        sess = session_mod._TrainSession(train_fn, context, checkpoint,
+                                         dataset_shards)
         session_mod._session = sess
         self._session = sess
         sess.start()

@@ -7,3 +7,5 @@ from ray_tpu.util.placement_group import (  # noqa: F401
     placement_group_table,
     remove_placement_group,
 )
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.queue import Queue  # noqa: F401

@@ -1,0 +1,98 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference parity: python/ray/util/actor_pool.py (submit, get_next,
+get_next_unordered, map, map_unordered).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; blocks-free, requires an idle
+        actor (pop order round-robins through completions)."""
+        if not self._idle:
+            raise ValueError("no idle actors; call get_next() first")
+        actor = self._idle.pop(0)
+        future = fn(actor, value)
+        self._future_to_actor[future] = (self._next_task_index, actor)
+        self._index_to_future[self._next_task_index] = future
+        self._next_task_index += 1
+
+    def has_next(self) -> bool:
+        return self._next_return_index < self._next_task_index
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        # Re-idle BEFORE get: a raising task or a get timeout must not
+        # leak the actor out of the pool (reference actor_pool.py does the
+        # same).
+        _, actor = self._future_to_actor.pop(future)
+        self._idle.append(actor)
+        return ray_tpu.get(future, timeout=timeout)
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Next result in COMPLETION order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        idx, actor = self._future_to_actor.pop(future)
+        self._index_to_future.pop(idx, None)
+        if idx == self._next_return_index:
+            while self._next_return_index not in self._index_to_future \
+                    and self._next_return_index < self._next_task_index:
+                self._next_return_index += 1
+        self._idle.append(actor)  # before get: errors must not leak actors
+        return ray_tpu.get(future)
+
+    def map(self, fn: Callable, values) -> Iterator[Any]:
+        values = list(values)
+        sent = 0
+        while sent < len(values) and self.has_free():
+            self.submit(fn, values[sent])
+            sent += 1
+        while self.has_next():
+            yield self.get_next()
+            if sent < len(values):
+                self.submit(fn, values[sent])
+                sent += 1
+
+    def map_unordered(self, fn: Callable, values) -> Iterator[Any]:
+        values = list(values)
+        sent = 0
+        while sent < len(values) and self.has_free():
+            self.submit(fn, values[sent])
+            sent += 1
+        while self._future_to_actor:
+            yield self.get_next_unordered()
+            if sent < len(values):
+                self.submit(fn, values[sent])
+                sent += 1
+
+    def push(self, actor) -> None:
+        self._idle.append(actor)
+
+    def pop_idle(self):
+        return self._idle.pop(0) if self._idle else None

@@ -1,0 +1,119 @@
+"""Distributed FIFO queue backed by an async actor.
+
+Reference parity: python/ray/util/queue.py (Queue over a _QueueActor).
+The actor is ASYNC: blocking put/get park coroutines on the actor's event
+loop instead of pinning threads, so thousands of waiters are cheap.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+        self._q: "asyncio.Queue" = asyncio.Queue(
+            maxsize=maxsize if maxsize > 0 else 0)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        import asyncio
+        try:
+            if timeout is None:
+                await self._q.put(item)
+            else:
+                await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+        try:
+            if timeout is None:
+                return True, await self._q.get()
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except Exception:
+            return False
+
+    async def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except Exception:
+            return False, None
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+
+class Queue:
+    """Client handle; picklable (travels by actor handle)."""
+
+    def __init__(self, maxsize: int = 0, *, _actor=None):
+        self.maxsize = maxsize
+        self._actor = _actor or _QueueActor.options(num_cpus=0.05).remote(
+            maxsize)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not ray_tpu.get(self._actor.put_nowait.remote(item)):
+                raise Full("queue is full")
+            return
+        if not ray_tpu.get(self._actor.put.remote(item, timeout)):
+            raise Full("put timed out")
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self._actor.get_nowait.remote())
+            if not ok:
+                raise Empty("queue is empty")
+            return item
+        ok, item = ray_tpu.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty("get timed out")
+        return item
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def shutdown(self) -> None:
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self.maxsize, self._actor))
+
+
+def _rebuild_queue(maxsize, actor):
+    return Queue(maxsize, _actor=actor)
