@@ -47,6 +47,16 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
             if ignore_reinit_error:
                 return _connection_info()
             raise RuntimeError("ray_tpu.init() called twice")
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        GLOBAL_CONFIG.apply_system_config(_system_config)
+        # Spawned daemons inherit overrides through the env (reference:
+        # _system_config forwarded to gcs/raylet at bootstrap); shutdown()
+        # undoes both so config can't leak into a later init().
+        import os as _os
+        global _applied_system_config
+        _applied_system_config = list(_system_config or {})
+        for k, v in (_system_config or {}).items():
+            _os.environ[f"RAY_TPU_{k.upper()}"] = str(v)
         from ray_tpu._private import node as node_mod
         from ray_tpu._private.core_worker import CoreWorker
         from ray_tpu._private.rpc import RpcClient
@@ -128,15 +138,25 @@ def _connection_info():
             "session_dir": (_cluster or {}).get("session_dir")}
 
 
+_applied_system_config: list = []
+
+
 def shutdown():
     """Disconnect; if we bootstrapped the cluster, tear it down."""
-    global _worker, _cluster
+    global _worker, _cluster, _applied_system_config
     with _global_lock:
         if _worker is None:
             return
         cluster, worker = _cluster, _worker
         _worker = None
         _cluster = None
+        import os as _os
+
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        for k in _applied_system_config:
+            GLOBAL_CONFIG._overrides.pop(k, None)
+            _os.environ.pop(f"RAY_TPU_{k.upper()}", None)
+        _applied_system_config = []
     try:
         if cluster and cluster.get("owned"):
             try:
@@ -243,6 +263,11 @@ class RemoteFunction:
         merged.update(opts)  # constructor re-validates the merged set
         return RemoteFunction(self._fn, merged)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG authoring (reference: dag/function_node.py)."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._fn.__name__} cannot be called directly; "
@@ -306,6 +331,11 @@ class ActorClass:
         merged = dict(self._options)
         merged.update(opts)
         return ActorClass(self._cls, merged)
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG authoring (reference: dag/class_node.py)."""
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self, args, kwargs)
 
     def __call__(self, *a, **k):
         raise TypeError(f"actor class {self._cls.__name__} cannot be "

@@ -1,0 +1,16 @@
+"""ray_tpu.dag — lazy task/actor graph authoring via .bind().
+
+Reference parity: python/ray/dag/ (DAGNode dag_node.py:23, function/class
+nodes, InputNode); consumed by Serve graphs and Workflow.
+"""
+
+from ray_tpu.dag.dag_node import (  # noqa: F401
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+)
+
+__all__ = ["ClassMethodNode", "ClassNode", "DAGNode", "FunctionNode",
+           "InputNode"]

@@ -1,0 +1,129 @@
+"""Lazy DAG nodes over tasks and actors.
+
+Reference parity: python/ray/dag/dag_node.py:23 (DAGNode),
+function_node.py, class_node.py, input_node.py.  `fn.bind(x)` builds the
+graph without executing; `node.execute(input)` resolves it: every node
+becomes one task/actor call whose upstream arguments are passed as
+ObjectRefs (no intermediate driver materialization).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Tuple
+
+
+class DAGNode:
+    """Base: holds bound args and resolves upstream nodes on execute."""
+
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+        self._uuid = uuid.uuid4().hex
+
+    # -- traversal ---------------------------------------------------------
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def _resolve_args(self, cache: Dict[str, Any], input_value) -> Tuple:
+        args = tuple(
+            a._execute_cached(cache, input_value) if isinstance(a, DAGNode)
+            else a
+            for a in self._bound_args)
+        kwargs = {
+            k: (v._execute_cached(cache, input_value)
+                if isinstance(v, DAGNode) else v)
+            for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_cached(self, cache: Dict[str, Any], input_value):
+        if self._uuid not in cache:
+            cache[self._uuid] = self._execute_impl(cache, input_value)
+        return cache[self._uuid]
+
+    def _execute_impl(self, cache, input_value):
+        raise NotImplementedError
+
+    def execute(self, input_value: Any = None):
+        """Run the DAG; returns the root's ObjectRef (or actor handle for
+        a ClassNode root).  Shared upstream nodes execute once."""
+        return self._execute_cached({}, input_value)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input (reference: input_node.py).
+    Usable as a context manager for reference-API parity:
+        with InputNode() as inp: dag = f.bind(inp)
+    """
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, cache, input_value):
+        return input_value
+
+
+class FunctionNode(DAGNode):
+    """A bound remote function call (reference: function_node.py)."""
+
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache, input_value)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """A bound actor construction (reference: class_node.py).  Method
+    calls on the node create ClassMethodNodes."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundMethod(self, name)
+
+    def _execute_impl(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache, input_value)
+        return self._actor_cls.remote(*args, **kwargs)
+
+
+class _UnboundMethod:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """A bound method call on a ClassNode's actor."""
+
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method = method
+
+    def _children(self) -> List[DAGNode]:
+        return [self._class_node] + super()._children()
+
+    def _execute_impl(self, cache, input_value):
+        actor = self._class_node._execute_cached(cache, input_value)
+        args, kwargs = self._resolve_args(cache, input_value)
+        return getattr(actor, self._method).remote(*args, **kwargs)

@@ -1,0 +1,21 @@
+"""ray_tpu.workflow — durable DAG execution with persisted step results.
+
+Reference parity: python/ray/workflow/ (workflow_executor.py,
+workflow_storage.py, task_executor.py): a DAG runs step by step, every
+step's result is checkpointed to storage keyed by a deterministic step
+id, and `resume` re-runs only steps without a stored result — crash and
+driver-restart safe.
+"""
+
+from ray_tpu.workflow.api import (  # noqa: F401
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = ["get_output", "get_status", "init", "list_all", "resume",
+           "run", "run_async"]

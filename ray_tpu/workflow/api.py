@@ -1,0 +1,228 @@
+"""Workflow execution + storage.
+
+Reference parity: python/ray/workflow/ — workflow_storage.py (per-step
+persisted results under the workflow's storage prefix),
+workflow_executor.py (resume skips completed steps), api.py (run/resume/
+get_output/get_status/list_all).
+
+Step identity: a deterministic id derived from the DAG structure
+(function name + position), so the same DAG resumes against its stored
+results.  Storage is a filesystem directory (set with workflow.init;
+defaults to ~/.ray_tpu_workflows).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag import ClassMethodNode, ClassNode, DAGNode, FunctionNode, InputNode
+
+_storage_dir: Optional[str] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the workflow storage root (reference: workflow.init)."""
+    global _storage_dir
+    _storage_dir = storage or os.path.join(
+        os.path.expanduser("~"), ".ray_tpu_workflows")
+    os.makedirs(_storage_dir, exist_ok=True)
+
+
+def _storage() -> str:
+    if _storage_dir is None:
+        init()
+    return _storage_dir
+
+
+def _wf_dir(workflow_id: str) -> str:
+    d = os.path.join(_storage(), workflow_id)
+    os.makedirs(os.path.join(d, "steps"), exist_ok=True)
+    return d
+
+
+def _step_id(node: DAGNode, path: str) -> str:
+    """Deterministic id: structural path + callable name."""
+    if isinstance(node, FunctionNode):
+        name = getattr(node._remote_fn, "__name__", "fn")
+    elif isinstance(node, ClassMethodNode):
+        name = node._method
+    elif isinstance(node, ClassNode):
+        name = node._actor_cls._cls.__name__
+    else:
+        name = type(node).__name__
+    return hashlib.sha1(f"{path}:{name}".encode()).hexdigest()[:16]
+
+
+class _StepStore:
+    def __init__(self, workflow_id: str):
+        self.dir = _wf_dir(workflow_id)
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(os.path.join(self.dir, "steps", step_id))
+
+    def load(self, step_id: str):
+        with open(os.path.join(self.dir, "steps", step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, step_id: str, value) -> None:
+        path = os.path.join(self.dir, "steps", step_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)
+
+    def meta(self, **updates) -> dict:
+        path = os.path.join(self.dir, "meta.json")
+        meta = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                meta = json.load(f)
+        if updates:
+            meta.update(updates)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, path)
+        return meta
+
+
+def _execute_durable(node: DAGNode, store: _StepStore, input_value,
+                     path: str = "r", seen: Optional[dict] = None):
+    """Post-order durable execution: each step's RESULT (not ref) persists
+    before its parent runs (reference: task_executor.py checkpointing).
+    `seen` (node uuid -> value) makes a node SHARED by multiple parents
+    execute exactly once per run, matching DAGNode.execute — its result is
+    still checkpointed under every structural path so resume finds it."""
+    if seen is None:
+        seen = {}
+    if isinstance(node, InputNode):
+        return input_value
+    if node._uuid in seen:
+        return seen[node._uuid]
+    if isinstance(node, ClassNode):
+        # Actors are not durable steps; reconstruct (once) each run.
+        args, kwargs = _resolve_bound(node, store, input_value, path, seen)
+        actor = node._actor_cls.remote(*args, **kwargs)
+        seen[node._uuid] = actor
+        return actor
+    sid = _step_id(node, path)
+    if store.has(sid):
+        value = store.load(sid)
+        seen[node._uuid] = value
+        return value
+    if isinstance(node, ClassMethodNode):
+        actor = _execute_durable(node._class_node, store, input_value,
+                                 path + ".actor", seen)
+        args, kwargs = _resolve_bound(node, store, input_value, path, seen)
+        value = ray_tpu.get(getattr(actor, node._method)
+                            .remote(*args, **kwargs))
+    elif isinstance(node, FunctionNode):
+        args, kwargs = _resolve_bound(node, store, input_value, path, seen)
+        value = ray_tpu.get(node._remote_fn.remote(*args, **kwargs))
+    else:
+        raise TypeError(f"cannot execute {type(node).__name__} durably")
+    store.save(sid, value)
+    seen[node._uuid] = value
+    return value
+
+
+def _resolve_bound(node: DAGNode, store, input_value, path, seen):
+    args = []
+    for i, a in enumerate(node._bound_args):
+        args.append(
+            _execute_durable(a, store, input_value, f"{path}.a{i}", seen)
+            if isinstance(a, DAGNode) else a)
+    kwargs = {}
+    for k, v in node._bound_kwargs.items():
+        kwargs[k] = (
+            _execute_durable(v, store, input_value, f"{path}.k{k}", seen)
+            if isinstance(v, DAGNode) else v)
+    return tuple(args), kwargs
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        input_value: Any = None):
+    """Execute a DAG durably; returns the final result.  Re-running (or
+    resuming) the same workflow_id skips steps whose results are stored."""
+    import uuid as _uuid
+    workflow_id = workflow_id or (
+        f"wf-{int(time.time())}-{os.getpid()}-{_uuid.uuid4().hex[:8]}")
+    store = _StepStore(workflow_id)
+    store.meta(status="RUNNING", started_at=time.time())
+    # The DAG structure is persisted so resume() works without the
+    # original python objects in scope.
+    dag_path = os.path.join(store.dir, "dag.pkl")
+    if not os.path.exists(dag_path):
+        import cloudpickle
+        with open(dag_path + ".tmp", "wb") as f:
+            cloudpickle.dump((dag, input_value), f)
+        os.replace(dag_path + ".tmp", dag_path)
+    try:
+        result = _execute_durable(dag, store, input_value)
+    except BaseException as e:
+        store.meta(status="FAILED", error=repr(e))
+        raise
+    store.save("__output__", result)
+    store.meta(status="SUCCESSFUL", finished_at=time.time())
+    return result
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              input_value: Any = None):
+    """Run in a background task; returns an ObjectRef of the result."""
+    import cloudpickle
+    blob = cloudpickle.dumps((dag, input_value))
+    storage = _storage()
+
+    @ray_tpu.remote
+    def _driver(blob, workflow_id, storage):
+        import cloudpickle as cp
+
+        from ray_tpu import workflow as wf
+        wf.init(storage)
+        dag, input_value = cp.loads(blob)
+        return wf.run(dag, workflow_id=workflow_id, input_value=input_value)
+
+    return _driver.remote(blob, workflow_id, storage)
+
+
+def resume(workflow_id: str):
+    """Resume from storage: completed steps load, missing ones re-run
+    (reference: workflow_executor resume path)."""
+    store = _StepStore(workflow_id)
+    dag_path = os.path.join(store.dir, "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise ValueError(f"workflow {workflow_id!r} has no stored DAG")
+    import cloudpickle
+    with open(dag_path, "rb") as f:
+        dag, input_value = cloudpickle.load(f)
+    return run(dag, workflow_id=workflow_id, input_value=input_value)
+
+
+def get_output(workflow_id: str):
+    store = _StepStore(workflow_id)
+    if not store.has("__output__"):
+        raise ValueError(f"workflow {workflow_id!r} has no stored output")
+    return store.load("__output__")
+
+
+def get_status(workflow_id: str) -> str:
+    return _StepStore(workflow_id).meta().get("status", "UNKNOWN")
+
+
+def list_all() -> List[Dict[str, Any]]:
+    root = _storage()
+    out = []
+    for name in sorted(os.listdir(root)):
+        meta_path = os.path.join(root, name, "meta.json")
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            out.append({"workflow_id": name, **meta})
+    return out
