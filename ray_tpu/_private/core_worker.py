@@ -142,6 +142,7 @@ class CoreWorker:
         self._cancelled_exec: set = set()
         self._running_tasks: dict = {}    # TaskID -> executing thread id
         self._cancel_lock = threading.Lock()
+        self._renv_cache: dict = {}       # user runtime_env json -> descriptor
         self.actor_submitters: dict[ActorID, _ActorSubmitter] = {}
         self.borrowed: dict[ObjectID, str] = {}  # borrowed ref -> owner addr
         self._put_index = 0
@@ -607,6 +608,21 @@ class CoreWorker:
         self.io.run(self._prepare_and_launch(fn, args, kwargs, opts, task_id))
         return refs
 
+    async def _build_runtime_env(self, user_env) -> dict:
+        """Package a user runtime_env once per unique value (content-
+        addressed uploads make repeats cheap anyway)."""
+        if not user_env:
+            return {}
+        import json as _json
+
+        from ray_tpu._private import runtime_env as renv
+        cache_key = _json.dumps(user_env, sort_keys=True, default=str)
+        cached = self._renv_cache.get(cache_key)
+        if cached is None:
+            cached = await renv.build_descriptor(user_env, self._kv_call)
+            self._renv_cache[cache_key] = cached
+        return cached
+
     async def _prepare_and_launch(self, fn, args, kwargs, opts, task_id):
         fn_key = await self.fn_manager.export(self._job_int(), fn)
         spec = TaskSpec(
@@ -625,6 +641,8 @@ class CoreWorker:
             node_affinity=opts.get("_node_id"),
             placement_group=_pg_id_of(opts.get("placement_group")),
             bundle_index=opts.get("placement_group_bundle_index", -1),
+            runtime_env=await self._build_runtime_env(
+                opts.get("runtime_env")),
         )
         self.tasks[task_id] = _PendingTask(
             spec=spec, retries_left=spec.max_retries, future=None, lineage=True)
@@ -755,10 +773,12 @@ class CoreWorker:
     def _sched_key(self, spec: TaskSpec, exclude) -> tuple:
         """Reference SchedulingKey (direct_task_transport.h:53-55):
         tasks with identical scheduling requirements share leases."""
+        from ray_tpu._private import runtime_env as renv
         return (tuple(sorted(spec.resources.to_dict().items())),
                 spec.scheduling_strategy,
                 spec.placement_group.hex() if spec.placement_group else None,
-                spec.bundle_index, spec.node_affinity, tuple(exclude))
+                spec.bundle_index, spec.node_affinity, tuple(exclude),
+                renv.env_hash(spec.runtime_env))
 
     async def _push_on_lease(self, spec: TaskSpec, lease: dict):
         reply = await self.pool.get(lease["worker_address"]).call(
@@ -939,6 +959,8 @@ class CoreWorker:
             max_concurrency=opts.get("max_concurrency") or 0,
             placement_group=_pg_id_of(opts.get("placement_group")),
             bundle_index=opts.get("placement_group_bundle_index", -1),
+            runtime_env=await self._build_runtime_env(
+                opts.get("runtime_env")),
         )
         info = ActorInfo(
             actor_id=actor_id,
@@ -1440,8 +1462,17 @@ class _KeyScheduler:
     leases are returned after a TTL.
     """
 
-    MAX_PENDING_LEASES = 16   # reference: max_pending_lease_requests
-    IDLE_TTL = 1.0
+    # reference: max_pending_lease_requests / lease TTL — flags in
+    # _private/config.py (RAY_TPU_MAX_PENDING_LEASE_REQUESTS etc.)
+    @property
+    def MAX_PENDING_LEASES(self):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        return GLOBAL_CONFIG.max_pending_lease_requests
+
+    @property
+    def IDLE_TTL(self):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        return GLOBAL_CONFIG.lease_idle_ttl_s
 
     def __init__(self, worker: "CoreWorker", key: tuple, proto_spec,
                  exclude: list):
@@ -1554,7 +1585,8 @@ class _KeyScheduler:
                 lease = await worker.pool.get(node.address).call(
                     "NodeManager", "LeaseWorker",
                     {"resources": spec.resources.to_dict(),
-                     "job_id": worker._job_int(), "bundle": bundle},
+                     "job_id": worker._job_int(), "bundle": bundle,
+                     "runtime_env": spec.runtime_env},
                     timeout=60)
             except Exception as e:
                 raise _RetryableSubmitError(f"lease rpc failed: {e}",

@@ -27,8 +27,32 @@ from ray_tpu._private import scheduler as sched
 
 logger = logging.getLogger("ray_tpu.gcs")
 
-HEARTBEAT_INTERVAL_S = 0.5
-NODE_DEATH_TIMEOUT_S = 5.0
+def _cfg():
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    return GLOBAL_CONFIG
+
+
+_M = None
+
+
+def _metrics():
+    global _M
+    if _M is None:
+        from ray_tpu.util import metrics as mt
+        _M = {
+            "actors_created": mt.Counter(
+                "actors_created", "actors scheduled successfully"),
+            "actor_restarts": mt.Counter(
+                "actor_restarts", "actor failover restarts"),
+            "placement_groups_created": mt.Counter(
+                "placement_groups_created", "placement groups scheduled"),
+            "nodes_alive": mt.Gauge("nodes_alive", "alive nodes"),
+        }
+    return _M
+
+
+HEARTBEAT_INTERVAL_S = _cfg().heartbeat_interval_s
+NODE_DEATH_TIMEOUT_S = _cfg().node_death_timeout_s
 
 
 class KvManager:
@@ -122,6 +146,12 @@ class GcsServer:
     async def get_nodes(self, req):
         return {"nodes": list(self.nodes.values()),
                 "version": self._cluster_version}
+
+    async def get_metrics(self, req):
+        from ray_tpu.util import metrics as mt
+        _metrics()["nodes_alive"].set(
+            sum(1 for n in self.nodes.values() if n.alive))
+        return {"metrics": mt.collect()}
 
     async def drain_node(self, req):
         await self._mark_node_dead(req["node_id"], "drained")
@@ -257,7 +287,10 @@ class GcsServer:
                 lease = await self.pool.get(node.address).call(
                     "NodeManager", "LeaseWorkerForActor",
                     {"actor_id": info.actor_id, "resources": demand,
-                     "job_id": job_int, "bundle": bundle},
+                     "job_id": job_int, "bundle": bundle,
+                     "runtime_env": getattr(info.creation_spec,
+                                            "runtime_env", None)
+                     if info.creation_spec is not None else None},
                     timeout=30)
             except Exception as e:
                 logger.info("lease on %s failed: %s", node.address, e)
@@ -302,6 +335,7 @@ class GcsServer:
             info.address = worker_addr
             info.node_id = node.node_id
             info.version += 1
+            _metrics()["actors_created"].inc()
             self._bump()
             logger.info("actor %s alive at %s", info.actor_id.hex()[:8],
                         worker_addr)
@@ -313,6 +347,7 @@ class GcsServer:
     async def _on_actor_interrupted(self, actor: ActorInfo, reason: str):
         if actor.num_restarts < actor.max_restarts or actor.max_restarts == -1:
             actor.num_restarts += 1
+            _metrics()["actor_restarts"].inc()
             actor.state = "RESTARTING"
             actor.address = ""
             actor.version += 1
@@ -541,6 +576,7 @@ class GcsServer:
                 continue
             info.state = "CREATED"
             info.version += 1
+            _metrics()["placement_groups_created"].inc()
             self._bump()
             logger.info("placement group %s created (%d bundles)",
                         info.pg_id.hex()[:8], len(info.bundles))

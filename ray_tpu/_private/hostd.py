@@ -29,7 +29,37 @@ from ray_tpu._private.rpc import ClientPool, RpcClient, RpcServer
 
 logger = logging.getLogger("ray_tpu.hostd")
 
-IDLE_WORKER_TTL_S = 60.0
+def _cfg():
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    return GLOBAL_CONFIG
+
+
+def _metrics():
+    """Daemon metric definitions (reference: stats/metric_defs.h:46-110)."""
+    global _M
+    if _M is None:
+        from ray_tpu.util import metrics as mt
+        _M = {
+            "leases_granted": mt.Counter(
+                "leases_granted", "worker leases granted"),
+            "workers_spawned": mt.Counter(
+                "workers_spawned", "worker processes spawned"),
+            "objects_spilled": mt.Counter(
+                "objects_spilled", "objects written to spill storage"),
+            "bytes_spilled": mt.Counter(
+                "bytes_spilled", "bytes written to spill storage"),
+            "objects_restored": mt.Counter(
+                "objects_restored", "spilled objects read back"),
+            "store_used_bytes": mt.Gauge(
+                "store_used_bytes", "shm object store bytes in use"),
+        }
+    return _M
+
+
+_M = None
+
+
+
 
 
 def detect_resources() -> dict:
@@ -45,9 +75,11 @@ def detect_resources() -> dict:
 
 
 class WorkerHandle:
-    def __init__(self, proc: subprocess.Popen, job_id: int):
+    def __init__(self, proc: subprocess.Popen, job_id: int,
+                 env_hash: str = ""):
         self.proc = proc
         self.job_id = job_id
+        self.env_hash = env_hash  # runtime-env cache key (worker_pool.h:156)
         self.worker_id: WorkerID | None = None
         self.address: str = ""
         self.state = "starting"  # starting/idle/claimed/leased/actor
@@ -87,30 +119,37 @@ class NodeDaemon:
         self._lease_seq = 0
         self.server = RpcServer(host)
         self._shutdown = asyncio.Event()
-        self.max_workers = int(os.environ.get(
-            "RAY_TPU_MAX_WORKERS",
-            max(8, int(self.resources_total.get("CPU", 1)) * 4)))
+        self.max_workers = _cfg().max_workers_per_node or max(
+            8, int(self.resources_total.get("CPU", 1)) * 4)
         self._capacity_freed: asyncio.Event | None = None  # made on start()
         # Object spilling (reference: raylet LocalObjectManager
         # local_object_manager.h:41 + _private/external_storage.py:246
         # FileSystemStorage).  With spilling on, LRU eviction is disabled:
         # primary copies are written to disk under memory pressure and
         # restored on demand instead of destroyed.
-        self.spill_enabled = os.environ.get("RAY_TPU_SPILL", "1") != "0"
+        self.spill_enabled = _cfg().spill_enabled
         self.spill_dir = os.environ.get("RAY_TPU_SPILL_DIR") or os.path.join(
             session_dir, "spill", self.node_id.hex()[:12])
-        self.spill_high = float(os.environ.get("RAY_TPU_SPILL_HIGH", "0.8"))
-        self.spill_low = float(os.environ.get("RAY_TPU_SPILL_LOW", "0.5"))
+        self.spill_high = _cfg().spill_high_watermark
+        self.spill_low = _cfg().spill_low_watermark
         self.spilled: dict[bytes, tuple[str, int]] = {}  # id -> (path, size)
         self.spilled_bytes = 0
 
     # ---------------- worker pool ----------------
 
-    def _spawn_worker(self, job_id: int) -> WorkerHandle:
+    def _spawn_worker(self, job_id: int,
+                      runtime_env: dict | None = None) -> WorkerHandle:
+        from ray_tpu._private import runtime_env as renv
         log_base = os.path.join(self.session_dir, "logs",
                                 f"worker-{len(self.workers)}-{os.getpid()}")
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if runtime_env:
+            import json as _json
+            env.update(runtime_env.get("env_vars", {}))
+            env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
+            env["RAY_TPU_RUNTIME_ENV_CACHE"] = os.path.join(
+                self.session_dir, "runtime_env")
         cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
                "--gcs", self.gcs_address,
                "--hostd", f"{self.host}:{self.server.port}",
@@ -120,9 +159,11 @@ class NodeDaemon:
         out = open(log_base + ".out", "ab")
         err = open(log_base + ".err", "ab")
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
-        handle = WorkerHandle(proc, job_id)
+        handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env))
+        _metrics()["workers_spawned"].inc()
         self.workers[proc.pid] = handle
-        logger.info("spawned worker pid=%d job=%d", proc.pid, job_id)
+        logger.info("spawned worker pid=%d job=%d env=%s", proc.pid, job_id,
+                    handle.env_hash or "-")
         return handle
 
     async def worker_ready(self, req):
@@ -137,22 +178,29 @@ class NodeDaemon:
         handle.ready.set()
         return {"ok": True, "node_id": self.node_id}
 
-    async def _get_worker(self, job_id: int, timeout: float = 60.0):
-        """Pop an idle worker for the job, spawning if necessary.  The
-        returned handle is already claimed (state="claimed") so concurrent
-        leases can never share a worker."""
+    async def _get_worker(self, job_id: int, timeout: float = 60.0,
+                          runtime_env: dict | None = None):
+        """Pop an idle worker for (job, runtime-env hash), spawning if
+        necessary.  The returned handle is already claimed
+        (state="claimed") so concurrent leases can never share a worker."""
+        from ray_tpu._private import runtime_env as renv
+        want_hash = renv.env_hash(runtime_env)
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
             for handle in self.workers.values():
                 if handle.state == "idle" and not handle.reserved \
-                        and handle.job_id == job_id:
+                        and handle.job_id == job_id \
+                        and handle.env_hash == want_hash:
                     handle.state = "claimed"
                     return handle
             live = [w for w in self.workers.values() if w.proc.poll() is None]
             if len(live) >= self.max_workers:
+                # Evict an idle worker that can't serve this lease — other
+                # job OR same job with a different runtime-env hash.
                 for handle in live:
                     if handle.state == "idle" and not handle.reserved \
-                            and handle.job_id != job_id:
+                            and (handle.job_id != job_id
+                                 or handle.env_hash != want_hash):
                         self._kill_worker(handle)
                         break
                 else:
@@ -160,7 +208,7 @@ class NodeDaemon:
             # Spawn a worker pinned to this lease (reserved=True) so another
             # lease cannot steal it the moment it boots — stealing cascades
             # into one extra spawn per steal.
-            handle = self._spawn_worker(job_id)
+            handle = self._spawn_worker(job_id, runtime_env)
             handle.reserved = True
             try:
                 await asyncio.wait_for(
@@ -258,7 +306,8 @@ class NodeDaemon:
             reserved = (self._bundle_reserve(bundle, demand) if bundle
                         else self._reserve(demand))
             if reserved:
-                handle = await self._get_worker(job_id)
+                handle = await self._get_worker(
+                    job_id, runtime_env=req.get("runtime_env"))
                 if handle is not None:
                     break
                 if bundle:
@@ -275,6 +324,7 @@ class NodeDaemon:
                 return {"granted": False, "reason": "busy"}
             await self._wait_capacity(min(remaining, 0.5))
         self._lease_seq += 1
+        _metrics()["leases_granted"].inc()
         lease_id = f"{self.node_id.hex()[:8]}-{self._lease_seq}"
         logger.info("lease %s -> worker pid=%d", lease_id, handle.proc.pid)
         handle.state = "leased"
@@ -308,7 +358,8 @@ class NodeDaemon:
                 return {"granted": False, "reason": "resources"}
         elif not self._reserve(demand):
             return {"granted": False, "reason": "resources"}
-        handle = await self._get_worker(req.get("job_id", 0))
+        handle = await self._get_worker(
+            req.get("job_id", 0), runtime_env=req.get("runtime_env"))
         if handle is None:
             if bundle:
                 self._bundle_unreserve(bundle, demand)
@@ -375,6 +426,7 @@ class NodeDaemon:
             restored = self._read_spilled(req["id"])
             if restored is None:
                 return {"found": False}
+            _metrics()["objects_restored"].inc()
             data, metadata = restored
             return {"found": True, "data": data, "metadata": metadata,
                     "spilled": True}
@@ -453,6 +505,8 @@ class NodeDaemon:
             os.replace(tmp, path)
             self.spilled[oid.binary()] = (path, size)
             self.spilled_bytes += size
+            _metrics()["objects_spilled"].inc()
+            _metrics()["bytes_spilled"].inc(size)
             self.store.delete(oid)
             freed += size
         if freed:
@@ -505,6 +559,13 @@ class NodeDaemon:
                     await loop.run_in_executor(None, self._spill_some, 0)
             except Exception:
                 logger.exception("spill sweep failed")
+
+    async def get_metrics(self, req):
+        """Process-local metric snapshot (reference: per-node agent scrape
+        path, _private/metrics_agent.py)."""
+        from ray_tpu.util import metrics as mt
+        _metrics()["store_used_bytes"].set(self.store.stats()["used"])
+        return {"metrics": mt.collect(), "node_id": self.node_id.hex()}
 
     async def list_workers(self, req):
         """Per-node worker table for the state API (reference:
@@ -590,7 +651,7 @@ class NodeDaemon:
                         except Exception:
                             pass
                 elif (handle.state == "idle"
-                      and now - handle.idle_since > IDLE_WORKER_TTL_S):
+                      and now - handle.idle_since > _cfg().worker_idle_ttl_s):
                     self._kill_worker(handle)
             await asyncio.sleep(0.2)
 
@@ -614,6 +675,7 @@ class NodeDaemon:
         self.server.register("NodeManager", "SpillObjects",
                              self.spill_objects)
         self.server.register("NodeManager", "ListWorkers", self.list_workers)
+        self.server.register("NodeManager", "Metrics", self.get_metrics)
         self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
         port = await self.server.start(port)
         await self.gcs.call("Gcs", "register_node", {"info": self.node_info()},

@@ -1,0 +1,123 @@
+"""Runtime environments: per-task/actor env vars + code shipping.
+
+Reference parity: python/ray/_private/runtime_env/ — working_dir.py /
+py_modules.py (zip upload to GCS KV, content-addressed, cached per node),
+plugin descriptor plumbing through the raylet worker pool
+(worker_pool.h:245 runtime-env-hash worker caching).  pip/conda creation
+is gated off (this environment is zero-egress); env_vars, working_dir and
+py_modules are fully supported.
+
+Descriptor shape (what travels in TaskSpec.runtime_env after packaging):
+    {"env_vars": {...},
+     "working_dir_key": "pkg:<sha1>",       # GCS KV key
+     "py_module_keys": ["pkg:<sha1>", ...]}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import zipfile
+from typing import Any, Dict, Optional
+
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+_PKG_NS = "pkg"
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        base = os.path.abspath(path)
+        for root, _dirs, files in os.walk(base):
+            if "__pycache__" in root:
+                continue
+            for name in files:
+                full = os.path.join(root, name)
+                z.write(full, os.path.relpath(full, base))
+    return buf.getvalue()
+
+
+async def build_descriptor(runtime_env: Dict[str, Any], kv_call
+                           ) -> Dict[str, Any]:
+    """Validate + package a user runtime_env; uploads code archives to the
+    GCS KV under content hashes.  kv_call: async (method, request)."""
+    unknown = set(runtime_env) - _SUPPORTED
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}; "
+                         f"supported: {sorted(_SUPPORTED)}")
+    if runtime_env.get("pip") or runtime_env.get("conda"):
+        raise NotImplementedError(
+            "runtime_env pip/conda environments need package downloads; "
+            "this deployment is network-isolated — bake dependencies into "
+            "the image and use working_dir/py_modules for code")
+    desc: Dict[str, Any] = {}
+    env_vars = runtime_env.get("env_vars") or {}
+    if env_vars:
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in env_vars.items()):
+            raise ValueError("runtime_env env_vars must be str -> str")
+        desc["env_vars"] = dict(env_vars)
+
+    async def upload(path: str) -> str:
+        if not os.path.isdir(path):
+            raise ValueError(f"runtime_env path is not a directory: {path}")
+        blob = _zip_dir(path)
+        key = f"{_PKG_NS}:{hashlib.sha1(blob).hexdigest()}"
+        await kv_call("kv_put", {"ns": _PKG_NS, "key": key, "value": blob,
+                                 "overwrite": False})
+        return key
+
+    if runtime_env.get("working_dir"):
+        desc["working_dir_key"] = await upload(runtime_env["working_dir"])
+    if runtime_env.get("py_modules"):
+        desc["py_module_keys"] = [await upload(p)
+                                  for p in runtime_env["py_modules"]]
+    return desc
+
+
+def env_hash(descriptor: Optional[Dict[str, Any]]) -> str:
+    """Stable worker-pool cache key (reference: runtime-env hash,
+    worker_pool.h:156)."""
+    if not descriptor:
+        return ""
+    return hashlib.sha1(
+        json.dumps(descriptor, sort_keys=True).encode()).hexdigest()[:16]
+
+
+async def setup_in_worker(descriptor: Dict[str, Any], kv_call,
+                          cache_root: str) -> None:
+    """Worker-side activation: fetch + extract archives (content-addressed
+    cache shared by workers on the node), chdir into working_dir, prepend
+    py_modules to sys.path.  env_vars were applied by the daemon at spawn."""
+    if not descriptor:
+        return
+
+    async def fetch_extract(key: str) -> str:
+        dest = os.path.join(cache_root, key.replace(":", "_"))
+        if not os.path.isdir(dest):
+            reply = await kv_call("kv_get", {"ns": _PKG_NS, "key": key})
+            blob = reply["value"]
+            if blob is None:
+                raise RuntimeError(f"runtime_env package {key} not in GCS")
+            tmp = dest + f".tmp{os.getpid()}"
+            with zipfile.ZipFile(io.BytesIO(blob)) as z:
+                z.extractall(tmp)
+            try:
+                os.replace(tmp, dest)
+            except OSError:  # another worker won the race
+                import shutil
+                shutil.rmtree(tmp, ignore_errors=True)
+        return dest
+
+    for key in descriptor.get("py_module_keys", []):
+        path = await fetch_extract(key)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    if descriptor.get("working_dir_key"):
+        path = await fetch_extract(descriptor["working_dir_key"])
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)

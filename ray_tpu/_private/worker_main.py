@@ -42,6 +42,17 @@ def main():
     from ray_tpu import api
     api._worker = cw
 
+    renv_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if renv_json:
+        import json
+
+        from ray_tpu._private import runtime_env as renv
+        cache_root = os.environ.get(
+            "RAY_TPU_RUNTIME_ENV_CACHE", "/tmp/ray_tpu/runtime_env")
+        os.makedirs(cache_root, exist_ok=True)
+        cw.io.run(renv.setup_in_worker(json.loads(renv_json), cw._kv_call,
+                                       cache_root), timeout=120)
+
     hostd = RpcClient(args.hostd)
     cw.io.run(hostd.call("NodeManager", "WorkerReady", {
         "pid": os.getpid(),

@@ -456,3 +456,119 @@ def test_object_spilling_roundtrip(cluster):
     assert any(s.get("spilled_objects", 0) > 0 or
                s.get("spilled_bytes", 0) > 0 for s in stats)
     del refs
+
+
+def test_runtime_env_env_vars_and_working_dir(cluster, tmp_path):
+    """runtime_env: env_vars reach the worker process; working_dir ships
+    through the GCS KV and becomes the task's cwd + sys.path (reference:
+    _private/runtime_env/ working_dir.py + worker pool env-hash caching)."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mymod.py").write_text("MAGIC = 'from-working-dir'\n")
+    (proj / "data.txt").write_text("42")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "on"},
+                                 "working_dir": str(proj)})
+    def probe():
+        import os
+        import mymod
+        with open("data.txt") as f:
+            data = f.read()
+        return os.environ.get("MY_FLAG"), mymod.MAGIC, data
+
+    flag, magic, data = ray_tpu.get(probe.remote(), timeout=60)
+    assert flag == "on"
+    assert magic == "from-working-dir"
+    assert data == "42"
+
+    # Workers without the env must not see it (pool keyed by env hash).
+    @ray_tpu.remote
+    def plain():
+        import os
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(plain.remote(), timeout=60) is None
+
+    # pip envs are explicitly gated in this zero-egress deployment.
+    with pytest.raises((NotImplementedError, Exception)):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def nope():
+            return 1
+        ray_tpu.get(nope.remote(), timeout=30)
+
+
+def test_runtime_env_on_actor(cluster, tmp_path):
+    mod = tmp_path / "actormod"
+    mod.mkdir()
+    (mod / "helper.py").write_text("def gift():\n    return 'actor-env'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)],
+                                 "env_vars": {"WHO": "actor"}})
+    class Envy:
+        def peek(self):
+            import os
+            from helper import gift
+            return os.environ["WHO"], gift()
+
+    a = Envy.remote()
+    assert ray_tpu.get(a.peek.remote(), timeout=60) == ("actor", "actor-env")
+    ray_tpu.kill(a)
+
+
+def test_system_config_flags(cluster):
+    """Config registry: env override + _system_config validation
+    (reference: ray_config_def.h RAY_CONFIG flags)."""
+    import os
+
+    from ray_tpu._private.config import GLOBAL_CONFIG, RayTpuConfig
+
+    assert GLOBAL_CONFIG.task_max_retries == 3
+    os.environ["RAY_TPU_TASK_MAX_RETRIES"] = "7"
+    try:
+        assert GLOBAL_CONFIG.task_max_retries == 7
+    finally:
+        del os.environ["RAY_TPU_TASK_MAX_RETRIES"]
+
+    cfg = RayTpuConfig()
+    cfg.apply_system_config({"lease_idle_ttl_s": 2.5})
+    assert cfg.lease_idle_ttl_s == 2.5
+    with pytest.raises(ValueError):
+        cfg.apply_system_config({"not_a_flag": 1})
+    dump = GLOBAL_CONFIG.dump()
+    assert "spill_enabled" in dump and "heartbeat_interval_s" in dump
+
+
+def test_metrics_api_and_export(cluster):
+    """User metric API + cluster scrape + Prometheus text (reference:
+    ray/util/metrics.py + stats/metric_defs.h + metrics agent export)."""
+    from ray_tpu import state
+    from ray_tpu.util import metrics as mt
+
+    c = mt.Counter("test_requests", "requests served", ("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = mt.Gauge("test_temperature", "temp")
+    g.set(3.5)
+    h = mt.Histogram("test_latency", "latency", ("route",))
+    h.observe(0.1, tags={"route": "/a"})
+    h.observe(0.3, tags={"route": "/a"})
+
+    snap = mt.collect()
+    assert snap["test_requests"]["series"][0]["value"] == 3.0
+    text = mt.prometheus_text()
+    assert 'ray_tpu_test_requests{route="/a"} 3.0' in text
+    assert "ray_tpu_test_latency_count" in text
+
+    # Cluster-side: daemon metrics flow through the scrape RPCs.
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    ray_tpu.get(touch.remote())
+    cm = state.cluster_metrics()
+    node_metrics = list(cm["nodes"].values())[0]
+    assert node_metrics["leases_granted"]["series"][0]["value"] >= 1
+    assert node_metrics["workers_spawned"]["series"][0]["value"] >= 1
+    prom = state.prometheus_metrics()
+    assert "ray_tpu_leases_granted" in prom
+    assert 'component="gcs"' in prom
