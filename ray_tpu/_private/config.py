@@ -1,0 +1,102 @@
+"""Central config registry: typed flags, env-overridable.
+
+Reference parity: src/ray/common/ray_config_def.h (~700 RAY_CONFIG(type,
+name, default) entries overridable via RAY_<name> env vars or the
+_system_config dict at init, mirrored through includes/ray_config.pxi).
+Here every knob is declared once, reads `RAY_TPU_<NAME>` from the
+environment, and can be overridden per-process via
+`ray_tpu.init(_system_config={...})`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "default", "cast", "doc")
+
+    def __init__(self, name: str, default, cast: Callable, doc: str):
+        self.name = name
+        self.default = default
+        self.cast = cast
+        self.doc = doc
+
+
+def _bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() not in ("0", "false", "no", "off", "")
+
+
+class RayTpuConfig:
+    """Singleton registry; access flags as attributes."""
+
+    _FLAGS: Dict[str, _Flag] = {}
+
+    @classmethod
+    def _define(cls, name: str, default, cast, doc: str):
+        cls._FLAGS[name] = _Flag(name, default, cast, doc)
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def apply_system_config(self, overrides: Dict[str, Any] | None) -> None:
+        """ray_tpu.init(_system_config={...}) hook."""
+        for k, v in (overrides or {}).items():
+            if k not in self._FLAGS:
+                raise ValueError(f"unknown config flag {k!r}; known: "
+                                 f"{sorted(self._FLAGS)}")
+            self._overrides[k] = self._FLAGS[k].cast(v)
+
+    def __getattr__(self, name: str):
+        flag = self._FLAGS.get(name)
+        if flag is None:
+            raise AttributeError(name)
+        if name in self._overrides:
+            return self._overrides[name]
+        env = os.environ.get(f"RAY_TPU_{name.upper()}")
+        if env is not None:
+            return flag.cast(env)
+        return flag.default
+
+    def dump(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in sorted(self._FLAGS)}
+
+
+_D = RayTpuConfig._define
+# -- core runtime ----------------------------------------------------------
+_D("object_store_memory", 256 << 20, int,
+   "default per-node shared-memory store capacity (bytes)")
+_D("inline_object_limit", 100 * 1024, int,
+   "returns/args below this size travel inline instead of via the store")
+_D("lease_idle_ttl_s", 1.0, float,
+   "held worker leases idle past this return to the daemon")
+_D("max_pending_lease_requests", 16, int,
+   "in-flight LeaseWorker RPCs per scheduling key")
+_D("task_max_retries", 3, int, "default task retry budget")
+_D("worker_idle_ttl_s", 60.0, float,
+   "idle pooled workers are reaped after this")
+_D("max_workers_per_node", 0, int,
+   "worker-pool cap per node; 0 = max(8, 4x CPUs)")
+_D("heartbeat_interval_s", 0.5, float, "hostd -> GCS heartbeat period")
+_D("node_death_timeout_s", 5.0, float,
+   "missed-heartbeat window before a node is declared dead")
+# -- spilling --------------------------------------------------------------
+_D("spill_enabled", True, _bool, "spill to disk instead of LRU eviction")
+_D("spill_high_watermark", 0.8, float, "store fraction that starts a sweep")
+_D("spill_low_watermark", 0.5, float, "sweep target store fraction")
+# -- serve -----------------------------------------------------------------
+_D("serve_controller_threads", 64, int,
+   "controller thread pool (long-polls + control loop)")
+# -- scheduling ------------------------------------------------------------
+_D("scheduler_spread_threshold", 0.5, float,
+   "hybrid policy: pack until this utilization, then best-node")
+
+
+GLOBAL_CONFIG = RayTpuConfig()
+
+
+def get_config() -> RayTpuConfig:
+    return GLOBAL_CONFIG

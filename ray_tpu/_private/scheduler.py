@@ -12,7 +12,12 @@ from __future__ import annotations
 
 from ray_tpu._private.protocol import NodeInfo
 
-HYBRID_THRESHOLD = 0.5  # reference: RAY_scheduler_spread_threshold default
+def _threshold() -> float:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    return GLOBAL_CONFIG.scheduler_spread_threshold
+
+
+HYBRID_THRESHOLD = 0.5  # reference default; live value via _threshold()
 
 
 def _fits(available: dict, demand: dict) -> bool:
@@ -59,7 +64,7 @@ def pick_node(nodes: list[NodeInfo], demand: dict, strategy: str = "DEFAULT",
     # Hybrid/DEFAULT: pack onto already-busy nodes while below the threshold
     # so small tasks don't fragment the fleet, else fall back to best
     # (least-utilized) node.
-    below = [n for n in candidates if _utilization(n) < HYBRID_THRESHOLD]
+    below = [n for n in candidates if _utilization(n) < _threshold()]
     if below:
         return max(below, key=_utilization)
     return min(candidates, key=_utilization)

@@ -139,6 +139,12 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    from ray_tpu import state
+    print(state.prometheus_metrics(args.address), end="")
+    return 0
+
+
 def cmd_memory(args) -> int:
     from ray_tpu import state
     rows = [r for r in state.list_objects(args.address) if "capacity" in r]
@@ -166,7 +172,7 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_start)
 
     for name, fn in (("stop", cmd_stop), ("status", cmd_status),
-                     ("memory", cmd_memory)):
+                     ("memory", cmd_memory), ("metrics", cmd_metrics)):
         q = sub.add_parser(name)
         q.add_argument("--address", required=True)
         q.add_argument("--json", action="store_true")

@@ -137,6 +137,28 @@ def list_objects(address: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+def cluster_metrics(address: Optional[str] = None) -> Dict[str, Any]:
+    """Per-process metric snapshots: GCS + every alive node daemon
+    (reference: state aggregation over per-node metrics agents)."""
+    addr = _gcs_address(address)
+    gcs = _run(_gcs_call(addr, "get_metrics"))
+    per_node = _run(_each_node(addr, "NodeManager", "Metrics"))
+    return {"gcs": gcs.get("metrics", {}),
+            "nodes": {nid: r.get("metrics", {})
+                      for nid, r in per_node.items()}}
+
+
+def prometheus_metrics(address: Optional[str] = None) -> str:
+    """Cluster-wide Prometheus exposition text."""
+    from ray_tpu.util import metrics as mt
+    snap = cluster_metrics(address)
+    out = [mt.prometheus_text(snap["gcs"], {"component": "gcs"})]
+    for nid, m in snap["nodes"].items():
+        out.append(mt.prometheus_text(
+            m, {"component": "hostd", "node_id": nid[:12]}))
+    return "".join(out)
+
+
 def summarize_cluster(address: Optional[str] = None) -> Dict[str, Any]:
     addr = _gcs_address(address)
     nodes = list_nodes(addr)

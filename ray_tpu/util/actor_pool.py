@@ -37,17 +37,23 @@ class ActorPool:
         return bool(self._idle)
 
     def get_next(self, timeout: float | None = None) -> Any:
-        """Next result in SUBMISSION order."""
+        """Next result in SUBMISSION order.  A timeout leaves the pending
+        task intact and retrievable (reference: wait-before-pop)."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        future = self._index_to_future.pop(self._next_return_index)
+        future = self._index_to_future[self._next_return_index]
+        if timeout is not None:
+            ready, _ = ray_tpu.wait([future], num_returns=1,
+                                    timeout=timeout)
+            if not ready:
+                raise TimeoutError("get_next timed out; result still "
+                                   "pending")
+        self._index_to_future.pop(self._next_return_index)
         self._next_return_index += 1
-        # Re-idle BEFORE get: a raising task or a get timeout must not
-        # leak the actor out of the pool (reference actor_pool.py does the
-        # same).
+        # Re-idle BEFORE get: a raising task must not leak the actor.
         _, actor = self._future_to_actor.pop(future)
         self._idle.append(actor)
-        return ray_tpu.get(future, timeout=timeout)
+        return ray_tpu.get(future)
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:
         """Next result in COMPLETION order."""

@@ -1,0 +1,133 @@
+"""Metrics: counters/gauges/histograms with tag support + Prometheus text
+exposition.
+
+Reference parity: src/ray/stats/metric.h:26 (Count/Gauge/Histogram defs,
+metric_defs.h:46-110) and the user API python/ray/util/metrics.py; export
+follows the per-node agent -> Prometheus text format path
+(_private/metrics_agent.py, prometheus_exporter.py) — here each daemon
+serves its registry over a Metrics RPC and the CLI/state API renders the
+exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_registry: Dict[str, "Metric"] = {}
+_registry_lock = threading.Lock()
+
+
+class Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        if not name.replace("_", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                # Re-declaration returns the same underlying series store
+                # (common for module reloads); types must agree.
+                if existing.TYPE != self.TYPE:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.TYPE}, cannot redeclare as {self.TYPE}")
+                self._values = existing._values
+                self._lock = existing._lock
+            _registry[name] = self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        tags = tags or {}
+        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+
+    def _series(self) -> List[Tuple[Tuple[str, ...], float]]:
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Bucketless summary: tracks count/sum/min/max per series (the
+    reference exports full buckets; sum+count cover rate/mean queries)."""
+
+    TYPE = "histogram"
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        with self._lock:
+            cur = self._values.get(key)
+            if cur is None:
+                cur = {"count": 0.0, "sum": 0.0, "min": value, "max": value}
+                self._values[key] = cur
+            cur["count"] += 1
+            cur["sum"] += value
+            cur["min"] = min(cur["min"], value)
+            cur["max"] = max(cur["max"], value)
+
+
+def collect() -> Dict[str, dict]:
+    """Snapshot of every metric in this process."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    out: Dict[str, dict] = {}
+    for m in metrics:
+        out[m.name] = {
+            "type": m.TYPE,
+            "description": m.description,
+            "tag_keys": list(m.tag_keys),
+            "series": [
+                {"tags": dict(zip(m.tag_keys, key)), "value": value}
+                for key, value in m._series()],
+        }
+    return out
+
+
+def prometheus_text(snapshot: Optional[Dict[str, dict]] = None,
+                    extra_tags: Optional[Dict[str, str]] = None) -> str:
+    """Render a collect() snapshot in Prometheus exposition format."""
+    snapshot = snapshot if snapshot is not None else collect()
+    extra = extra_tags or {}
+    lines: List[str] = []
+    for name, m in sorted(snapshot.items()):
+        full = f"ray_tpu_{name}"
+        if m.get("description"):
+            lines.append(f"# HELP {full} {m['description']}")
+        ptype = m["type"] if m["type"] != "histogram" else "summary"
+        lines.append(f"# TYPE {full} {ptype}")
+        for series in m["series"]:
+            tags = {**extra, **series["tags"]}
+            label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+            label = "{" + label + "}" if label else ""
+            v = series["value"]
+            if isinstance(v, dict):  # histogram summary
+                for suffix in ("count", "sum", "min", "max"):
+                    lines.append(f"{full}_{suffix}{label} {v[suffix]}")
+            else:
+                lines.append(f"{full}{label} {v}")
+    return "\n".join(lines) + "\n"
