@@ -58,12 +58,15 @@ NODE_DEATH_TIMEOUT_S = _cfg().node_death_timeout_s
 class KvManager:
     def __init__(self):
         self._data: dict[str, dict[str, bytes]] = {}
+        self.on_change = None  # set by GcsServer for persistence
 
     async def kv_put(self, req):
         ns = self._data.setdefault(req.get("ns", ""), {})
         existed = req["key"] in ns
         if req.get("overwrite", True) or not existed:
             ns[req["key"]] = req["value"]
+            if self.on_change is not None:
+                self.on_change()
         return {"existed": existed}
 
     async def kv_get(self, req):
@@ -82,10 +85,50 @@ class KvManager:
         return {"keys": [k for k in ns if k.startswith(prefix)]}
 
 
+class GcsTableStorage:
+    """Pluggable control-plane persistence (reference:
+    gcs/store_client/ — in_memory_store_client.h vs redis_store_client.h,
+    selected by gcs_storage, ray_config_def.h:382).  The file backend
+    snapshots the durable tables (actors, placement groups, KV, job
+    counter) atomically; node membership is NOT persisted — nodes
+    re-register through the heartbeat reregister path."""
+
+    def __init__(self, path: str | None):
+        self.path = path  # None = memory-only
+
+    def load(self) -> dict | None:
+        import pickle
+        if not self.path or not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            return pickle.load(f)
+
+    def save_blob(self, blob: bytes) -> None:
+        if not self.path:
+            return
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1",
+                 storage: GcsTableStorage | None = None):
         self.host = host
+        self.storage = storage or GcsTableStorage(
+            os.environ.get("RAY_TPU_GCS_PERSIST") or None)
+        self._persist_pending = False
         self.kv = KvManager()
+        self.kv.on_change = self._schedule_persist
+        self._task_events: list = []  # ring buffer for the timeline
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.node_heartbeat: dict[NodeID, float] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
@@ -108,6 +151,82 @@ class GcsServer:
         ev = self._change_event
         self._change_event = asyncio.Event()
         ev.set()
+        self._schedule_persist()
+
+    def _schedule_persist(self):
+        if self.storage.path and not self._persist_pending:
+            self._persist_pending = True
+            asyncio.ensure_future(self._persist_soon())
+
+    async def _persist_soon(self):
+        """Debounced snapshot: batch a burst of changes into one write."""
+        await asyncio.sleep(0.2)
+        self._persist_pending = False
+        try:
+            # Serialize ON the loop thread (no mutation can interleave —
+            # a torn snapshot would mix pre/post-transition records), then
+            # hand only the opaque bytes to the executor for disk IO.
+            import pickle
+            blob = pickle.dumps(self._durable_state())
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.storage.save_blob, blob)
+        except Exception:
+            logger.exception("GCS persistence write failed")
+
+    def _durable_state(self) -> dict:
+        return {
+            "actors": dict(self.actors),
+            "named_actors": dict(self.named_actors),
+            "placement_groups": dict(self.placement_groups),
+            "kv": {ns: dict(t) for ns, t in self.kv._data.items()},
+            "next_job": self.next_job,
+            "cluster_version": self._cluster_version,
+        }
+
+    def _restore(self) -> None:
+        state = self.storage.load()
+        if not state:
+            return
+        self.actors.update(state.get("actors", {}))
+        self.named_actors.update(state.get("named_actors", {}))
+        self.placement_groups.update(state.get("placement_groups", {}))
+        self.kv._data.update(state.get("kv", {}))
+        self.next_job = max(self.next_job, state.get("next_job", 0))
+        self._cluster_version = state.get("cluster_version", 0)
+        logger.info("restored GCS state: %d actors, %d PGs, job=%d",
+                    len(self.actors), len(self.placement_groups),
+                    self.next_job)
+        asyncio.ensure_future(self._reconcile_restored())
+
+    async def _reconcile_restored(self):
+        """Post-restart reconciliation (reference: RayletNotifyGCSRestart,
+        core_worker.proto:403): ping restored ALIVE actors; unreachable
+        ones go through the normal interruption/restart path.  PGs lose
+        their bundle placements (nodes re-register fresh) and reschedule."""
+        for info in list(self.placement_groups.values()):
+            if info.state in ("CREATED", "PENDING", "RESCHEDULING"):
+                info.state = "PENDING"
+                info.bundle_nodes = [None] * len(info.bundles)
+                info.bundle_addresses = [""] * len(info.bundles)
+                asyncio.ensure_future(self._schedule_pg(info))
+        for actor in list(self.actors.values()):
+            if actor.state in ("PENDING", "RESTARTING"):
+                # Never failed — resume scheduling without burning a
+                # restart from the budget.
+                asyncio.ensure_future(self._schedule_actor(actor))
+                continue
+            if actor.state != "ALIVE":
+                continue
+            reachable = False
+            if actor.address:
+                try:
+                    await self.pool.get(actor.address).call(
+                        "CoreWorker", "Ping", {}, timeout=5)
+                    reachable = True
+                except Exception:
+                    reachable = False
+            if not reachable:
+                await self._on_actor_interrupted(actor, "GCS restarted")
 
     async def _wait_change(self, timeout: float) -> bool:
         """Wait until the next state change (or timeout); returns whether a
@@ -146,6 +265,21 @@ class GcsServer:
     async def get_nodes(self, req):
         return {"nodes": list(self.nodes.values()),
                 "version": self._cluster_version}
+
+    async def add_task_events(self, req):
+        """Sink for worker task-event buffers (reference: TaskEventBuffer
+        task_event_buffer.h:188 streaming to GCS for observability)."""
+        self._task_events.extend(req.get("events", []))
+        overflow = len(self._task_events) - 20000
+        if overflow > 0:
+            del self._task_events[:overflow]
+        return {"ok": True}
+
+    async def get_task_events(self, req):
+        limit = req.get("limit", 10000)
+        if limit <= 0:
+            return {"events": []}
+        return {"events": self._task_events[-limit:]}
 
     async def get_metrics(self, req):
         from ray_tpu.util import metrics as mt
@@ -706,6 +840,7 @@ class GcsServer:
     async def start(self, port: int = 0) -> int:
         self.server.register_service("Kv", self.kv)
         self.server.register_service("Gcs", self)
+        self._restore()
         port = await self.server.start(port)
         self._health_task = asyncio.ensure_future(self._health_loop())
         return port
