@@ -87,6 +87,12 @@ _D("node_death_timeout_s", 5.0, float,
 _D("spill_enabled", True, _bool, "spill to disk instead of LRU eviction")
 _D("spill_high_watermark", 0.8, float, "store fraction that starts a sweep")
 _D("spill_low_watermark", 0.5, float, "sweep target store fraction")
+# -- memory monitor --------------------------------------------------------
+_D("memory_monitor_enabled", True, _bool,
+   "kill workers when node memory nears exhaustion")
+_D("memory_usage_threshold", 0.95, float,
+   "node memory fraction that triggers OOM worker killing")
+_D("memory_monitor_interval_s", 1.0, float, "memory check period")
 # -- serve -----------------------------------------------------------------
 _D("serve_controller_threads", 64, int,
    "controller thread pool (long-polls + control loop)")

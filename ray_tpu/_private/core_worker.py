@@ -143,6 +143,10 @@ class CoreWorker:
         self._running_tasks: dict = {}    # TaskID -> executing thread id
         self._cancel_lock = threading.Lock()
         self._renv_cache: dict = {}       # user runtime_env json -> descriptor
+        # Task timeline events, flushed to the GCS in batches (reference:
+        # core_worker/task_event_buffer.h:188).
+        self._task_events: list = []
+        self._task_event_flusher = None
         self.actor_submitters: dict[ActorID, _ActorSubmitter] = {}
         self.borrowed: dict[ObjectID, str] = {}  # borrowed ref -> owner addr
         self._put_index = 0
@@ -500,19 +504,18 @@ class CoreWorker:
         nodes = await self._node_table()
         # Own node stays in the candidate list: a local store miss with a
         # local location means the object was SPILLED — the hostd restores
-        # it from disk through the same PullObject RPC.
+        # it from disk through the same pull path.
         for loc in locations:
             addr = nodes.get(loc)
             if addr is None:
                 continue
             try:
-                reply = await self.pool.get(addr).call(
-                    "NodeManager", "PullObject", {"id": oid.binary()})
+                fetched = await self._pull_from_node(addr, oid)
             except Exception:
                 continue
-            if not reply.get("found"):
+            if fetched is None:
                 continue
-            data, metadata = reply["data"], reply["metadata"]
+            data, metadata = fetched
             if self.store is not None:
                 try:
                     if not self.store.contains(oid):
@@ -527,6 +530,48 @@ class CoreWorker:
                     pass
             return data, metadata
         return None
+
+    # Chunked node-to-node transfer (reference: object_manager/ chunked
+    # push/pull, push_manager.h in-flight chunk throttling).
+    PULL_CHUNK_BYTES = 8 << 20
+    PULL_MAX_INFLIGHT = 4
+
+    async def _pull_from_node(self, addr: str, oid: ObjectID):
+        """Fetch (data, metadata) from one node.  Small objects (the
+        common case) cost ONE RPC; past max_inline the daemon answers
+        too_large and the payload streams as bounded-concurrency chunks."""
+        client = self.pool.get(addr)
+        reply = await client.call(
+            "NodeManager", "PullObject",
+            {"id": oid.binary(), "max_inline": self.PULL_CHUNK_BYTES})
+        if not reply.get("found"):
+            return None
+        if not reply.get("too_large"):
+            return reply["data"], reply["metadata"]
+        size = reply["data_size"]
+        metadata = reply["metadata"]
+        out = bytearray(size)
+        sem = asyncio.Semaphore(self.PULL_MAX_INFLIGHT)
+        failed = []
+
+        async def fetch(offset: int):
+            length = min(self.PULL_CHUNK_BYTES, size - offset)
+            async with sem:
+                chunk = await client.call(
+                    "NodeManager", "PullObjectChunk",
+                    {"id": oid.binary(), "offset": offset,
+                     "length": length})
+            if not chunk.get("found"):
+                failed.append(offset)
+                return
+            out[offset:offset + length] = chunk["data"]
+
+        results = await asyncio.gather(
+            *[fetch(off) for off in range(0, size, self.PULL_CHUNK_BYTES)],
+            return_exceptions=True)
+        if failed or any(isinstance(r, BaseException) for r in results):
+            return None
+        return bytes(out), metadata
 
     _node_cache: tuple | None = None
 
@@ -1278,6 +1323,37 @@ class CoreWorker:
             self._exec_pool = ThreadPoolExecutor(
                 max_workers=mc, thread_name_prefix="actor-exec")
 
+    def _record_task_event(self, spec: TaskSpec, started: float):
+        """Buffer one execution event; a loop-side flusher ships batches."""
+        self._task_events.append({
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "worker_id": self.worker_id.hex()[:12],
+            "pid": os.getpid(),
+            "node_id": self.node_id.hex()[:12] if self.node_id else "",
+            "start": started,
+            "end": time.time(),
+        })
+        if self._task_event_flusher is None:
+            def _start_flusher():
+                if self._task_event_flusher is None:
+                    self._task_event_flusher = asyncio.ensure_future(
+                        self._flush_task_events())
+            self.io.loop.call_soon_threadsafe(_start_flusher)
+
+    async def _flush_task_events(self):
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            if not self._task_events:
+                continue
+            batch, self._task_events = self._task_events, []
+            try:
+                await self.gcs.call("Gcs", "add_task_events",
+                                    {"events": batch})
+            except Exception:
+                pass
+
     def _pack_reply(self, spec: TaskSpec, result) -> dict:
         return {"returns": self._pack_returns(spec, result), "error": None}
 
@@ -1332,6 +1408,7 @@ class CoreWorker:
 
     def _execute_task(self, spec: TaskSpec) -> dict:
         from ray_tpu.exceptions import TaskCancelledError
+        _t0 = time.time()
         if spec.task_id in self._cancelled_exec:
             self._cancelled_exec.discard(spec.task_id)
             return {"returns": [],
@@ -1367,6 +1444,7 @@ class CoreWorker:
             with self._cancel_lock:
                 self._running_tasks.pop(spec.task_id, None)
             self._cancelled_exec.discard(spec.task_id)
+            self._record_task_event(spec, _t0)
             # Don't leak this task's context (e.g. its placement group) to
             # whatever runs on this reused worker next.
             self.current_task_spec = None

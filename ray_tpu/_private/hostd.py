@@ -52,6 +52,9 @@ def _metrics():
                 "objects_restored", "spilled objects read back"),
             "store_used_bytes": mt.Gauge(
                 "store_used_bytes", "shm object store bytes in use"),
+            "oom_workers_killed": mt.Counter(
+                "oom_workers_killed",
+                "workers killed by the memory monitor"),
         }
     return _M
 
@@ -89,6 +92,7 @@ class WorkerHandle:
         self.lease_bundle: tuple | None = None  # (pg_hex, index) if in a PG
         self.actor_id = None
         self.idle_since = time.monotonic()
+        self.leased_at = 0.0
         self.ready = asyncio.Event()
 
 
@@ -327,6 +331,7 @@ class NodeDaemon:
         _metrics()["leases_granted"].inc()
         lease_id = f"{self.node_id.hex()[:8]}-{self._lease_seq}"
         logger.info("lease %s -> worker pid=%d", lease_id, handle.proc.pid)
+        handle.leased_at = time.monotonic()
         handle.state = "leased"
         handle.lease_id = lease_id
         handle.lease_resources = demand
@@ -417,12 +422,22 @@ class NodeDaemon:
     # ---------------- object transfer ----------------
 
     async def pull_object(self, req):
-        """Read an object out of the local store for a remote node.
-        (reference: object_manager chunked pull; chunking TBD)"""
+        """Read an object out of the local store for a remote node.  With
+        req["max_inline"], larger objects reply {"too_large", data_size,
+        metadata} and the caller switches to the chunk protocol — small
+        objects (the common case) stay one round trip."""
         from ray_tpu._private.ids import ObjectID
+        max_inline = req.get("max_inline")
         buf = self.store.get(ObjectID(req["id"]), timeout_ms=int(
             req.get("timeout_ms", 0)))
         if buf is None:
+            spilled = self._spilled_meta(req["id"])
+            if spilled is None:
+                return {"found": False}
+            data_size, metadata = spilled
+            if max_inline is not None and data_size > max_inline:
+                return {"found": True, "too_large": True,
+                        "data_size": data_size, "metadata": metadata}
             restored = self._read_spilled(req["id"])
             if restored is None:
                 return {"found": False}
@@ -431,10 +446,51 @@ class NodeDaemon:
             return {"found": True, "data": data, "metadata": metadata,
                     "spilled": True}
         try:
+            if max_inline is not None and len(buf.data) > max_inline:
+                return {"found": True, "too_large": True,
+                        "data_size": len(buf.data),
+                        "metadata": buf.metadata}
             return {"found": True, "data": bytes(buf.data),
                     "metadata": buf.metadata}
         finally:
             buf.release()
+
+    async def pull_object_meta(self, req):
+        """Size/metadata probe for the chunked pull path (reference:
+        object_manager chunked transfer: ObjectBufferPool chunk layout)."""
+        from ray_tpu._private.ids import ObjectID
+        oid = ObjectID(req["id"])
+        buf = self.store.get(oid, timeout_ms=0)
+        if buf is not None:
+            try:
+                return {"found": True, "data_size": len(buf.data),
+                        "metadata": buf.metadata, "spilled": False}
+            finally:
+                buf.release()
+        spilled = self._spilled_meta(req["id"])
+        if spilled is None:
+            return {"found": False}
+        data_size, meta = spilled
+        return {"found": True, "data_size": data_size, "metadata": meta,
+                "spilled": True}
+
+    async def pull_object_chunk(self, req):
+        """One chunk of an object's payload (reference: push_manager.h
+        chunked pushes with in-flight throttling — here the PULLER
+        throttles)."""
+        from ray_tpu._private.ids import ObjectID
+        offset, length = req["offset"], req["length"]
+        buf = self.store.get(ObjectID(req["id"]), timeout_ms=0)
+        if buf is not None:
+            try:
+                return {"found": True,
+                        "data": bytes(buf.data[offset:offset + length])}
+            finally:
+                buf.release()
+        chunk = self._read_spilled_range(req["id"], offset, length)
+        if chunk is None:
+            return {"found": False}
+        return {"found": True, "data": chunk}
 
     async def push_object(self, req):
         from ray_tpu._private.ids import ObjectID
@@ -466,6 +522,73 @@ class NodeDaemon:
         stats["spilled_objects"] = len(self.spilled)
         stats["spilled_bytes"] = self.spilled_bytes
         return stats
+
+    # ---------------- memory monitor ----------------
+
+    @staticmethod
+    def _read_memory_fraction() -> float:
+        """Node memory usage fraction from /proc/meminfo (reference:
+        common/memory_monitor.cc cgroup/system probing)."""
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 2:
+                        info[parts[0].rstrip(":")] = int(parts[1])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    def _pick_oom_victim(self):
+        """Newest leased task worker first (its task is retriable), then
+        newest actor worker (restartable per policy) — reference:
+        raylet/worker_killing_policy.cc retriable-LIFO."""
+        leased = [w for w in self.workers.values()
+                  if w.state == "leased" and w.proc.poll() is None]
+        if leased:
+            return max(leased, key=lambda w: w.leased_at)
+        actors = [w for w in self.workers.values()
+                  if w.state == "actor" and w.proc.poll() is None]
+        if actors:
+            return max(actors, key=lambda w: w.leased_at)
+        return None
+
+    async def _memory_monitor_loop(self):
+        while True:
+            interval = _cfg().memory_monitor_interval_s
+            await asyncio.sleep(interval)
+            try:
+                threshold = _cfg().memory_usage_threshold
+                frac = self._read_memory_fraction()
+                if frac < threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                logger.error(
+                    "node memory at %.0f%% (threshold %.0f%%): killing "
+                    "worker pid=%d to relieve pressure", frac * 100,
+                    threshold * 100, victim.proc.pid)
+                _metrics()["oom_workers_killed"].inc()
+                self._release_lease(victim)
+                proc = victim.proc
+                self._kill_worker(victim)
+
+                async def escalate(p=proc):
+                    await asyncio.sleep(2.0)
+                    if p.poll() is None:  # SIGTERM ignored (native code)
+                        p.kill()
+                asyncio.ensure_future(escalate())
+                # Cooldown: give the kernel time to reclaim before judging
+                # again — otherwise one spike serially destroys the node.
+                await asyncio.sleep(max(3 * interval, 2.0))
+            except Exception:
+                logger.exception("memory monitor pass failed")
 
     # ---------------- spilling ----------------
 
@@ -526,6 +649,37 @@ class NodeDaemon:
                 data = f.read()
             return data, meta
         except FileNotFoundError:
+            return None
+
+    def _spilled_meta(self, id_binary: bytes):
+        """(data_size, metadata) without reading the payload."""
+        ent = self.spilled.get(id_binary)
+        if ent is None:
+            return None
+        path, _size = ent
+        try:
+            total = os.path.getsize(path)
+            with open(path, "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                meta = f.read(meta_len)
+            return total - 8 - meta_len, meta
+        except OSError:
+            return None
+
+    def _read_spilled_range(self, id_binary: bytes, offset: int,
+                            length: int):
+        """Seek+read one payload range — chunked pulls of spilled objects
+        must not re-read the whole file per chunk."""
+        ent = self.spilled.get(id_binary)
+        if ent is None:
+            return None
+        path, _size = ent
+        try:
+            with open(path, "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                f.seek(8 + meta_len + offset)
+                return f.read(length)
+        except OSError:
             return None
 
     def _drop_spilled(self, id_binary: bytes):
@@ -668,6 +822,10 @@ class NodeDaemon:
         self.server.register("NodeManager", "CancelBundle",
                              self.cancel_bundle)
         self.server.register("NodeManager", "PullObject", self.pull_object)
+        self.server.register("NodeManager", "PullObjectMeta",
+                             self.pull_object_meta)
+        self.server.register("NodeManager", "PullObjectChunk",
+                             self.pull_object_chunk)
         self.server.register("NodeManager", "PushObject", self.push_object)
         self.server.register("NodeManager", "FreeObject", self.free_object)
         self.server.register("NodeManager", "FreeObjects", self.free_objects)
@@ -685,6 +843,9 @@ class NodeDaemon:
         if self.spill_enabled:
             self.store.set_eviction(False)
             self._tasks.append(asyncio.ensure_future(self._spill_loop()))
+        if _cfg().memory_monitor_enabled:
+            self._tasks.append(
+                asyncio.ensure_future(self._memory_monitor_loop()))
         return port
 
     def install_signal_handlers(self):

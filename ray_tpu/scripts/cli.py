@@ -123,6 +123,8 @@ def cmd_list(args) -> int:
                              ["placement_group_id", "state", "strategy",
                               "bundles"]),
         "objects": (state.list_objects, None),
+        "tasks": (state.list_tasks,
+                  ["name", "node_id", "pid", "start", "end"]),
     }.get(kind)
     if fn is None:
         print(f"unknown kind {args.kind!r}", file=sys.stderr)
@@ -142,6 +144,17 @@ def cmd_list(args) -> int:
 def cmd_metrics(args) -> int:
     from ray_tpu import state
     print(state.prometheus_metrics(args.address), end="")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu import state
+    events = state.timeline(args.address)
+    out = getattr(args, "out", None) or "ray_tpu_timeline.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {out} "
+          f"(open in chrome://tracing or perfetto)")
     return 0
 
 
@@ -172,15 +185,19 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_start)
 
     for name, fn in (("stop", cmd_stop), ("status", cmd_status),
-                     ("memory", cmd_memory), ("metrics", cmd_metrics)):
+                     ("memory", cmd_memory), ("metrics", cmd_metrics),
+                     ("timeline", cmd_timeline)):
         q = sub.add_parser(name)
         q.add_argument("--address", required=True)
         q.add_argument("--json", action="store_true")
+        if name == "timeline":
+            q.add_argument("--out", default="ray_tpu_timeline.json")
         q.set_defaults(fn=fn)
 
     q = sub.add_parser("list", help="list live cluster entities")
     q.add_argument("kind", choices=["nodes", "actors", "workers",
-                                    "placement-groups", "objects"])
+                                    "placement-groups", "objects",
+                                    "tasks"])
     q.add_argument("--address", required=True)
     q.add_argument("--json", action="store_true")
     q.set_defaults(fn=cmd_list)

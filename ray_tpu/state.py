@@ -137,6 +137,35 @@ def list_objects(address: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+def list_tasks(address: Optional[str] = None,
+               limit: int = 10000) -> List[Dict[str, Any]]:
+    """Recently executed tasks from the GCS task-event sink (reference:
+    experimental/state/api.py list_tasks over task events)."""
+    addr = _gcs_address(address)
+    reply = _run(_gcs_call(addr, "get_task_events", {"limit": limit}))
+    return list(reply.get("events", []))
+
+
+def timeline(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome trace events (chrome://tracing / perfetto 'X' phases) —
+    reference: `ray timeline` scripts.py:1840."""
+    events = list_tasks(address)
+    out = []
+    for e in events:
+        out.append({
+            "name": e["name"],
+            "cat": "actor_task" if e.get("actor_id") else "task",
+            "ph": "X",
+            "ts": e["start"] * 1e6,
+            "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
+            "pid": f'{e.get("node_id", "")}:{e.get("pid", 0)}',
+            "tid": e.get("worker_id", ""),
+            "args": {"task_id": e.get("task_id"),
+                     "actor_id": e.get("actor_id")},
+        })
+    return out
+
+
 def cluster_metrics(address: Optional[str] = None) -> Dict[str, Any]:
     """Per-process metric snapshots: GCS + every alive node daemon
     (reference: state aggregation over per-node metrics agents)."""

@@ -572,3 +572,70 @@ def test_metrics_api_and_export(cluster):
     prom = state.prometheus_metrics()
     assert "ray_tpu_leases_granted" in prom
     assert 'component="gcs"' in prom
+
+
+def test_task_events_and_timeline(cluster, tmp_path):
+    """Task execution events stream to the GCS; state.list_tasks and the
+    Chrome-trace timeline render them (reference: TaskEventBuffer +
+    `ray timeline`)."""
+    import json
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu import state
+    from ray_tpu.scripts import cli
+
+    @ray_tpu.remote
+    def traced_task(x):
+        return x
+
+    ray_tpu.get([traced_task.remote(i) for i in range(5)])
+    deadline = time.time() + 15
+    tasks = []
+    while time.time() < deadline:
+        tasks = [t for t in state.list_tasks()
+                 if t["name"].endswith("traced_task")]
+        if len(tasks) >= 5:
+            break
+        time.sleep(0.5)
+    assert len(tasks) >= 5
+    assert all(t["end"] >= t["start"] for t in tasks)
+
+    out = tmp_path / "trace.json"
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["timeline", "--address", cluster["gcs_address"],
+                       "--out", str(out)])
+    assert rc == 0
+    events = json.loads(out.read_text())
+    assert any(e["ph"] == "X" and e["name"].endswith("traced_task")
+               for e in events)
+
+
+def test_memory_monitor_policy():
+    """OOM victim policy: newest leased task worker first, actors only as
+    a last resort (reference: raylet worker_killing_policy retriable-LIFO);
+    /proc/meminfo probe returns a sane fraction."""
+    from ray_tpu._private.hostd import NodeDaemon
+
+    frac = NodeDaemon._read_memory_fraction()
+    assert 0.0 < frac < 1.0
+
+    class FakeProc:
+        def poll(self):
+            return None
+
+    class H:
+        def __init__(self, state, t):
+            self.state = state
+            self.leased_at = t
+            self.proc = FakeProc()
+
+    daemon = NodeDaemon.__new__(NodeDaemon)  # policy only; no daemon state
+    daemon.workers = {1: H("idle", 0), 2: H("leased", 10.0),
+                      3: H("leased", 20.0), 4: H("actor", 30.0)}
+    assert NodeDaemon._pick_oom_victim(daemon).leased_at == 20.0
+    daemon.workers = {1: H("idle", 0), 4: H("actor", 30.0)}
+    assert NodeDaemon._pick_oom_victim(daemon).state == "actor"
+    daemon.workers = {1: H("idle", 0)}
+    assert NodeDaemon._pick_oom_victim(daemon) is None

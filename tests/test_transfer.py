@@ -1,0 +1,56 @@
+"""Cross-node object transfer tests (reference: object_manager/ chunked
+push/pull with in-flight throttling)."""
+
+import pytest
+
+import ray_tpu
+
+
+def test_chunked_cross_node_transfer():
+    """A >chunk-size object pulls across nodes as bounded-concurrency
+    chunks (reference: object_manager chunked push/pull)."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2,
+                                      "object_store_memory": 96 << 20})
+    try:
+        cluster.add_node(num_cpus=2, object_store_memory=96 << 20)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+
+        blob = np.arange(24 << 20, dtype=np.uint8) % 199  # 24MB = 3 chunks
+
+        @ray_tpu.remote(num_cpus=2)
+        def produce():
+            return blob
+
+        @ray_tpu.remote(num_cpus=2)
+        def consume(x):
+            return int(x.sum()), x.shape[0]
+
+        # Producer and consumer each demand 2 CPUs: they land on different
+        # nodes, so the arg crosses the node boundary.
+        ref = produce.remote()
+        ray_tpu.wait([ref], num_returns=1, timeout=60, fetch_local=False)
+        total, n = ray_tpu.get(consume.remote(ref), timeout=120)
+        assert n == 24 << 20
+        assert total == int(blob.sum())
+
+        # Deterministic chunked-path check: pull the big object from its
+        # hosting node via the chunk protocol directly.
+        from ray_tpu import api
+        w = api._worker
+        big_ref = ray_tpu.put(blob)
+        st = w.objects[big_ref.id]
+        (loc,) = tuple(st.locations)
+        nodes = w.io.run(w._node_table())
+        fetched = w.io.run(w._pull_from_node(nodes[loc], big_ref.id))
+        assert fetched is not None
+        data, _meta = fetched
+        assert len(data) > w.PULL_CHUNK_BYTES  # really took the chunk path
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
