@@ -17,6 +17,7 @@ from ray_tpu.rllib.env import (  # noqa: F401
     make_vector_env,
     register_env,
 )
+from ray_tpu.rllib.a2c import A2C, A2CConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerThread  # noqa: F401
 from ray_tpu.rllib.learner import JaxLearner, ppo_loss  # noqa: F401
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
@@ -27,6 +28,7 @@ from ray_tpu.rllib.vtrace import vtrace  # noqa: F401
 from ray_tpu.rllib.worker_set import WorkerSet  # noqa: F401
 
 __all__ = [
+    "A2C", "A2CConfig",
     "Algorithm", "AlgorithmConfig", "CartPoleVector", "Env", "VectorEnv",
     "IMPALA", "IMPALAConfig", "JaxLearner", "JaxPolicy", "LearnerThread",
     "PPO", "PPOConfig", "RolloutWorker", "SampleBatch", "WorkerSet",

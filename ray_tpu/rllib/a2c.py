@@ -1,0 +1,63 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference parity: rllib/algorithms/a2c/a2c.py — PPO's synchronous
+sample/update plumbing with the plain policy-gradient loss (no ratio
+clipping, single pass over the batch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def a2c_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
+    vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+    ent_coeff = cfg.get("entropy_coeff", 0.0)
+
+    logits, values = apply(params, mb[SampleBatch.OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    actions = mb[SampleBatch.ACTIONS].astype(jnp.int32)
+    logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+
+    adv = mb[SampleBatch.ADVANTAGES]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+    policy_loss = -(logp * adv).mean()
+    vf_loss = ((values - mb[SampleBatch.VALUE_TARGETS]) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"total_loss": total, "policy_loss": policy_loss,
+                   "vf_loss": vf_loss, "entropy": entropy}
+
+
+class A2CConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = A2C
+        # On-policy single pass, as in the reference A2C.
+        self.num_sgd_iter = 1
+        self.sgd_minibatch_size = 0   # 0 = whole batch
+        self.train_batch_size = 2048
+        self.lr = 1e-3
+        self.entropy_coeff = 0.01
+
+
+class A2C(PPO):
+    def _make_learner(self) -> JaxLearner:
+        cfg = self.config
+        mb = cfg.sgd_minibatch_size or cfg.train_batch_size
+        return JaxLearner(
+            self.obs_dim, self.num_actions, loss_fn=a2c_loss,
+            config={"lr": cfg.lr, "grad_clip": cfg.grad_clip,
+                    "num_sgd_iter": cfg.num_sgd_iter,
+                    "sgd_minibatch_size": mb,
+                    "vf_loss_coeff": getattr(cfg, "vf_loss_coeff", 0.5),
+                    "entropy_coeff": getattr(cfg, "entropy_coeff", 0.0)},
+            hidden=cfg.model_hidden, seed=cfg.seed)

@@ -41,19 +41,25 @@ class PPO(Algorithm):
                 rollout_fragment_length=cfg.rollout_fragment_length,
                 gamma=cfg.gamma, lam=cfg.lambda_,
                 hidden=cfg.model_hidden, seed=cfg.seed, postprocess=True))
-        self.learner = JaxLearner(
+        self.learner = self._make_learner()
+        self.workers.sync_weights(self.learner.get_weights())
+
+    def _make_learner(self) -> JaxLearner:
+        """Overridable learner factory (A2C swaps the loss/config here
+        without re-running worker construction or double weight syncs)."""
+        cfg = self.config
+        return JaxLearner(
             self.obs_dim, self.num_actions, loss_fn=ppo_loss,
             config={
                 "lr": cfg.lr, "grad_clip": cfg.grad_clip,
                 "num_sgd_iter": cfg.num_sgd_iter,
                 "sgd_minibatch_size": cfg.sgd_minibatch_size,
                 "clip_param": getattr(cfg, "clip_param", 0.2),
-                "vf_clip_param": getattr(cfg, "vf_clip_param", 10.0),
+                "vf_clip_param": getattr(cfg, "vf_clip_param", 100.0),
                 "vf_loss_coeff": getattr(cfg, "vf_loss_coeff", 0.5),
                 "entropy_coeff": getattr(cfg, "entropy_coeff", 0.0),
             },
             hidden=cfg.model_hidden, seed=cfg.seed)
-        self.workers.sync_weights(self.learner.get_weights())
 
     def training_step(self) -> Dict[str, Any]:
         """Reference: ppo.py:384."""
