@@ -80,6 +80,8 @@ _D("worker_idle_ttl_s", 60.0, float,
    "idle pooled workers are reaped after this")
 _D("max_workers_per_node", 0, int,
    "worker-pool cap per node; 0 = max(8, 4x CPUs)")
+_D("max_startup_concurrency", 0, int,
+   "concurrent worker spawns per node; 0 = host core count")
 _D("heartbeat_interval_s", 0.5, float, "hostd -> GCS heartbeat period")
 _D("node_death_timeout_s", 5.0, float,
    "missed-heartbeat window before a node is declared dead")

@@ -125,6 +125,13 @@ class NodeDaemon:
         self._shutdown = asyncio.Event()
         self.max_workers = _cfg().max_workers_per_node or max(
             8, int(self.resources_total.get("CPU", 1)) * 4)
+        # Startup throttling (reference: worker_pool.h:245 startup tokens /
+        # maximum_startup_concurrency scales with host cores): concurrent
+        # python spawns contend for cores — past this many in-flight
+        # spawns, lease requests wait for an existing worker instead of
+        # forking another interpreter.
+        self.max_startup_concurrency = (
+            _cfg().max_startup_concurrency or max(1, os.cpu_count() or 1))
         self._capacity_freed: asyncio.Event | None = None  # made on start()
         # Object spilling (reference: raylet LocalObjectManager
         # local_object_manager.h:41 + _private/external_storage.py:246
@@ -180,6 +187,8 @@ class NodeDaemon:
         handle.state = "idle"
         handle.idle_since = time.monotonic()
         handle.ready.set()
+        # Wake lease requests parked behind the startup throttle.
+        self._notify_capacity()
         return {"ok": True, "node_id": self.node_id}
 
     async def _get_worker(self, job_id: int, timeout: float = 60.0,
@@ -198,6 +207,15 @@ class NodeDaemon:
                     handle.state = "claimed"
                     return handle
             live = [w for w in self.workers.values() if w.proc.poll() is None]
+            starting = sum(1 for w in live if w.state == "starting")
+            if starting >= self.max_startup_concurrency:
+                # Throttle check comes BEFORE eviction: only kill an idle
+                # worker when a replacement spawn will actually follow.
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    return None
+                await self._wait_capacity(min(remaining, 0.25))
+                continue
             if len(live) >= self.max_workers:
                 # Evict an idle worker that can't serve this lease — other
                 # job OR same job with a different runtime-env hash.

@@ -18,20 +18,14 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 
 
 def a2c_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
+    from ray_tpu.rllib.learner import policy_terms
+
     vf_coeff = cfg.get("vf_loss_coeff", 0.5)
     ent_coeff = cfg.get("entropy_coeff", 0.0)
 
-    logits, values = apply(params, mb[SampleBatch.OBS])
-    logp_all = jax.nn.log_softmax(logits)
-    actions = mb[SampleBatch.ACTIONS].astype(jnp.int32)
-    logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
-
-    adv = mb[SampleBatch.ADVANTAGES]
-    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-
+    values, logp, adv, entropy = policy_terms(apply, params, mb)
     policy_loss = -(logp * adv).mean()
     vf_loss = ((values - mb[SampleBatch.VALUE_TARGETS]) ** 2).mean()
-    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
     total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
     return total, {"total_loss": total, "policy_loss": policy_loss,
                    "vf_loss": vf_loss, "entropy": entropy}

@@ -111,6 +111,19 @@ class JaxLearner:
         self.opt_state = jax.device_put(state["opt_state"])
 
 
+def policy_terms(apply, params, mb):
+    """Shared per-minibatch terms: (values, taken-action logp, normalized
+    advantages, entropy) — used by the PPO and A2C losses."""
+    logits, values = apply(params, mb[SampleBatch.OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    actions = mb[SampleBatch.ACTIONS].astype(jnp.int32)
+    logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+    adv = mb[SampleBatch.ADVANTAGES]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    return values, logp, adv, entropy
+
+
 def ppo_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
     """Clipped-surrogate PPO loss.  Reference behavior:
     rllib/algorithms/ppo/ppo_torch_policy.py (loss)."""
@@ -119,13 +132,7 @@ def ppo_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
     vf_coeff = cfg.get("vf_loss_coeff", 0.5)
     ent_coeff = cfg.get("entropy_coeff", 0.0)
 
-    logits, values = apply(params, mb[SampleBatch.OBS])
-    logp_all = jax.nn.log_softmax(logits)
-    actions = mb[SampleBatch.ACTIONS].astype(jnp.int32)
-    logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
-
-    adv = mb[SampleBatch.ADVANTAGES]
-    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    values, logp, adv, entropy = policy_terms(apply, params, mb)
 
     ratio = jnp.exp(logp - mb[SampleBatch.ACTION_LOGP])
     surr = jnp.minimum(ratio * adv,
@@ -138,7 +145,6 @@ def ppo_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
     vf_err = jnp.minimum((values - targets) ** 2, vf_clip)
     vf_loss = vf_err.mean()
 
-    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
     total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
     kl = (mb[SampleBatch.ACTION_LOGP] - logp).mean()
     return total, {"total_loss": total, "policy_loss": policy_loss,

@@ -20,9 +20,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import ray_tpu  # noqa: E402
 
 
-def timeit(name, fn, n, results):
-    # Warmup round.
+def timeit(name, fn, n, results, settle: float = 0.0):
+    # Warmup round, then let background churn (frees, spills, worker
+    # spawns) drain so sections don't pollute each other.
     fn(max(1, n // 10))
+    if settle:
+        time.sleep(settle)
     t0 = time.perf_counter()
     fn(n)
     dt = time.perf_counter() - t0
@@ -60,6 +63,7 @@ def main():
             ray_tpu.put(big)
 
     timeit("put_1MiB", put_1mb, 500, results)
+    time.sleep(3.0)  # drain the dropped-ref free/spill storm
 
     # --- tasks -------------------------------------------------------------
     @ray_tpu.remote
@@ -70,12 +74,12 @@ def main():
         for _ in range(n):
             ray_tpu.get(nop.remote())
 
-    timeit("task_sync_roundtrip", task_sync, 200, results)
+    timeit("task_sync_roundtrip", task_sync, 200, results, settle=1.0)
 
     def task_pipelined(n):
         ray_tpu.get([nop.remote() for _ in range(n)])
 
-    timeit("task_pipelined", task_pipelined, 1000, results)
+    timeit("task_pipelined", task_pipelined, 1000, results, settle=1.0)
 
     # --- actors ------------------------------------------------------------
     @ray_tpu.remote
