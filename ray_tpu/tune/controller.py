@@ -53,7 +53,8 @@ class TuneController:
                  max_concurrent: int = 8,
                  resources_per_trial: Optional[dict] = None,
                  run_config: Optional[RunConfig] = None,
-                 max_failures_per_trial: int = 0):
+                 max_failures_per_trial: int = 0,
+                 experiment_path: Optional[str] = None):
         self._trainable = trainable
         self._searcher = searcher
         self._scheduler = scheduler or sched_mod.FIFOScheduler()
@@ -63,6 +64,10 @@ class TuneController:
         self._max_failures = max_failures_per_trial
         self.trials: List[Trial] = []
         self._next_index = 0
+        self._experiment_path = experiment_path
+        if experiment_path:
+            import os
+            os.makedirs(experiment_path, exist_ok=True)
 
     # ------------------------------------------------------------------
 
@@ -198,10 +203,84 @@ class TuneController:
             trial.actor = None
         trial.pending_ref = None
 
+    # ---------------- experiment state (reference:
+    # tune/execution/experiment_state.py + Tuner.restore) ----------------
+
+    def save_experiment_state(self) -> None:
+        """Snapshot trials + searcher/scheduler so a killed experiment
+        resumes where it stopped (finished trials keep results; in-flight
+        trials restart from their latest checkpoint)."""
+        if not self._experiment_path:
+            return
+        import os
+
+        import cloudpickle
+        state = {
+            "next_index": self._next_index,
+            "searcher": self._searcher,
+            "scheduler": self._scheduler,
+            "trials": [{
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "state": t.state,
+                "last_result": t.last_result,
+                "results": t.results,
+                "checkpoint": t.checkpoint,
+                "iteration": t.iteration,
+                "restarts": t.restarts,
+                "error": repr(t.error) if t.error is not None else None,
+            } for t in self.trials],
+        }
+        path = os.path.join(self._experiment_path, "experiment_state.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, path)
+
+    def restore_experiment_state(self, path: str,
+                                 resume_errored: bool = True) -> None:
+        import os
+
+        import cloudpickle
+        with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
+            state = cloudpickle.load(f)
+        self._next_index = state["next_index"]
+        self._searcher = state["searcher"]
+        self._scheduler = state["scheduler"]
+        self.trials = []
+        for ts in state["trials"]:
+            trial = Trial(trial_id=ts["trial_id"], config=ts["config"])
+            trial.last_result = ts["last_result"]
+            trial.results = ts["results"]
+            trial.checkpoint = ts["checkpoint"]
+            trial.iteration = ts["iteration"]
+            trial.restarts = ts["restarts"]
+            # In-flight trials resume from their latest checkpoint;
+            # errored ones too when resume_errored (reference:
+            # Tuner.restore resume_errored/restart_errored flags).
+            resumable = ("RUNNING", "PENDING") + (
+                ("ERROR",) if resume_errored else ())
+            if ts["state"] in resumable:
+                trial.state = "PENDING"
+            else:
+                trial.state = ts["state"]
+                if ts["state"] == "ERROR" and ts.get("error"):
+                    trial.error = RuntimeError(ts["error"])
+            self.trials.append(trial)
+        self._experiment_path = path
+
     def run(self, deadline_s: Optional[float] = None):
         start = time.monotonic()
+        last_save = 0.0
         while self.step():
+            # Snapshot cost grows with history — throttle mid-run saves
+            # (a crash loses at most save_interval of progress; resume
+            # replays from the last checkpointed state).
+            if time.monotonic() - last_save >= 5.0:
+                self.save_experiment_state()
+                last_save = time.monotonic()
             if deadline_s and time.monotonic() - start > deadline_s:
                 for t in self._running():
                     self._stop_trial(t, "TERMINATED")
                 break
+        self.save_experiment_state()

@@ -90,6 +90,46 @@ class Tuner:
         self._param_space = dict(param_space or {})
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or RunConfig()
+        self._restore_path: Optional[str] = None
+
+    def _experiment_path(self) -> Optional[str]:
+        """storage_path/name (reference: air.RunConfig storage layout);
+        experiment state persists here for Tuner.restore."""
+        import os
+        import time as _time
+        rc = self._run_config
+        if self._restore_path:
+            return self._restore_path
+        if rc.storage_path is None and rc.name is None:
+            return None
+        root = rc.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        name = rc.name or f"tune_{int(_time.time())}"
+        return os.path.join(root, name)
+
+    @classmethod
+    def restore(cls, path: str, trainable=None, *,
+                resume_errored: bool = True,
+                resources_per_trial: Optional[dict] = None) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory
+        (reference: Tuner.restore / tune/execution/experiment_state.py).
+        Finished trials keep their results; in-flight trials restart from
+        their latest checkpoint."""
+        import os
+
+        import cloudpickle
+        with open(os.path.join(path, "tuner.pkl"), "rb") as f:
+            meta = cloudpickle.load(f)
+        tuner = cls(trainable if trainable is not None
+                    else meta["trainable"],
+                    param_space=meta["param_space"],
+                    tune_config=meta["tune_config"],
+                    run_config=meta["run_config"],
+                    resources_per_trial=(resources_per_trial
+                                         or meta["resources"]))
+        tuner._restore_path = path
+        tuner._resume_errored = resume_errored
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
@@ -97,6 +137,7 @@ class Tuner:
             self._param_space, num_samples=tc.num_samples, seed=tc.seed)
         if tc.scheduler is not None:
             tc.scheduler.set_search_properties(tc.metric, tc.mode)
+        exp_path = self._experiment_path()
         controller = TuneController(
             self._trainable,
             searcher=searcher,
@@ -105,7 +146,25 @@ class Tuner:
             resources_per_trial=self._resources,
             run_config=self._run_config,
             max_failures_per_trial=(
-                self._run_config.failure_config.max_failures))
+                self._run_config.failure_config.max_failures),
+            experiment_path=exp_path)
+        if self._restore_path:
+            controller.restore_experiment_state(
+                self._restore_path,
+                resume_errored=getattr(self, "_resume_errored", True))
+        elif exp_path:
+            import os
+
+            import cloudpickle
+            os.makedirs(exp_path, exist_ok=True)
+            with open(os.path.join(exp_path, "tuner.pkl"), "wb") as f:
+                cloudpickle.dump({
+                    "trainable": self._trainable,
+                    "param_space": self._param_space,
+                    "tune_config": tc,
+                    "run_config": self._run_config,
+                    "resources": self._resources,
+                }, f)
         controller.run(deadline_s=tc.time_budget_s)
         results = []
         for trial in controller.trials:
