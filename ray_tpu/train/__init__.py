@@ -7,6 +7,8 @@ jax.distributed + XLA collectives instead of torch.distributed/NCCL.
 """
 
 from ray_tpu.train.backend import (  # noqa: F401
+    TorchBackend,
+    TorchConfig,
     Backend,
     BackendConfig,
     TpuBackend,
@@ -17,6 +19,7 @@ from ray_tpu.train.backend_executor import (  # noqa: F401
     TrainingFailedError,
 )
 from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
+    TorchTrainer,
     BaseTrainer,
     DataParallelTrainer,
     JaxTrainer,

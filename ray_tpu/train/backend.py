@@ -126,3 +126,64 @@ class TpuBackend(Backend):
             worker_group.execute(_shutdown_jax_distributed)
         except Exception:
             pass
+
+
+# ------------------------- Torch backend -----------------------------------
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """torch.distributed process-group fabric (reference:
+    train/torch/config.py:155 _TorchBackend; :69 _setup_torch_process_group
+    -> dist.init_process_group:113).  Backend "gloo" (CPU; this image ships
+    CPU torch — on CUDA hosts "nccl" slots in unchanged)."""
+
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+    def backend_cls(self):
+        return TorchBackend
+
+
+def _init_torch_process_group(master_addr: str, master_port: int,
+                              backend: str, rank: int, world_size: int,
+                              timeout_s: float):
+    import datetime
+
+    import torch.distributed as dist
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    dist.init_process_group(
+        backend=backend,
+        init_method=f"tcp://{master_addr}:{master_port}",
+        rank=rank, world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return {"rank": dist.get_rank(), "world_size": dist.get_world_size()}
+
+
+def _shutdown_torch_process_group():
+    import torch.distributed as dist
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, config: TorchConfig):
+        port = worker_group.execute_single(0, _find_free_port)
+        host = worker_group.execute_single(0, _coordinator_host)
+        n = len(worker_group)
+        import ray_tpu
+        refs = [worker.actor.run.remote(
+                    _init_torch_process_group, host, port, config.backend,
+                    rank, n, config.init_timeout_s)
+                for rank, worker in enumerate(worker_group.workers)]
+        infos = ray_tpu.get(refs, timeout=config.init_timeout_s)
+        if any(i["world_size"] != n for i in infos):
+            raise RuntimeError(f"torch process group mismatch: {infos}")
+
+    def on_shutdown(self, worker_group: WorkerGroup, config: TorchConfig):
+        try:
+            worker_group.execute(_shutdown_torch_process_group)
+        except Exception:
+            pass

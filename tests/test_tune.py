@@ -138,3 +138,46 @@ def test_tuner_over_trainer(cluster):
     ).fit()
     assert len(results) == 2
     assert results.get_best_result().metrics["final"] == 10
+
+
+def test_tuner_experiment_resume(cluster, tmp_path):
+    """Tuner.restore: finished trials keep results; unfinished trials
+    restart from their latest checkpoint (reference:
+    tune/execution/experiment_state.py + Tuner.restore)."""
+
+    def objective(config):
+        import time as _t
+
+        from ray_tpu.train import session
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for step in range(start, 4):
+            if config["crash"] and step == 2 and start == 0:
+                raise RuntimeError("simulated preemption")
+            tune.report({"score": config["x"] * 10 + step},
+                        checkpoint=Checkpoint.from_dict({"step": step + 1}))
+            _t.sleep(0.05)
+
+    exp = str(tmp_path / "exp")
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2]),
+                     "crash": tune.grid_search([True])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="resume-exp", storage_path=exp),
+        resources_per_trial={"CPU": 1},
+    )
+    first = tuner.fit()
+    # Both trials crashed at step 2 (max_failures=0 -> ERROR), but their
+    # step-2 checkpoints + partial results are in the experiment state.
+    assert len(first.errors) == 2
+
+    restored = tune.Tuner.restore(f"{exp}/resume-exp", objective)
+    second = restored.fit()
+    assert not second.errors
+    # Resumed from checkpoint: start==2 skips the crash branch and each
+    # trial finishes through step 3.
+    best = second.get_best_result()
+    assert best.metrics["score"] == 23  # x=2, step=3
+    for r in second:
+        assert r.metrics["score"] % 10 == 3
