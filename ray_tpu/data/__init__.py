@@ -12,6 +12,15 @@ from ray_tpu.data.dataset import (  # noqa: F401
     GroupedData,
 )
 from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401
+from ray_tpu.data.preprocessors import (  # noqa: F401
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    Preprocessor,
+    StandardScaler,
+)
 from ray_tpu.data.read_api import (  # noqa: F401
     from_arrow,
     from_items,

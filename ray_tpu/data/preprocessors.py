@@ -1,0 +1,170 @@
+"""Preprocessors: fit statistics on a Dataset, transform batches.
+
+Reference parity: python/ray/data/preprocessor.py (Preprocessor:
+fit/transform/fit_transform, transform_batch for serving) and
+preprocessors/ (BatchMapper, StandardScaler, MinMaxScaler, LabelEncoder,
+Concatenator, Chain).  Statistics come from the Dataset's distributed
+aggregates (per-block remote partials); transforms run as normal fused
+map_batches stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    """Base: subclasses implement _fit(dataset) (stats) and
+    _transform_batch(batch)."""
+
+    _fitted = False
+
+    def fit(self, dataset) -> "Preprocessor":
+        self._fit(dataset)
+        self._fitted = True
+        return self
+
+    def transform(self, dataset):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        return dataset.map_batches(self._transform_batch)
+
+    def fit_transform(self, dataset):
+        return self.fit(dataset).transform(dataset)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]):
+        """Single-batch form (serving path; reference:
+        preprocessor.transform_batch)."""
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        return self._transform_batch(dict(batch))
+
+    # -- subclass hooks ----------------------------------------------------
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, dataset) -> None:
+        pass
+
+    def _transform_batch(self, batch):
+        raise NotImplementedError
+
+
+class BatchMapper(Preprocessor):
+    """Stateless batch transform (reference: preprocessors/batch_mapper)."""
+
+    def __init__(self, fn: Callable[[Dict[str, np.ndarray]], Dict]):
+        self._fn = fn
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_batch(self, batch):
+        return self._fn(batch)
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference: preprocessors/scaler)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, dataset) -> None:
+        # Dataset._execute() materializes blocks once; per-column aggregate
+        # calls afterwards are remote partials over the cached block refs.
+        for col in self.columns:
+            self.stats_[col] = (float(dataset.mean(col)),
+                                float(dataset.std(col)))
+
+    def _transform_batch(self, batch):
+        for col in self.columns:
+            mean, std = self.stats_[col]
+            batch[col] = (np.asarray(batch[col], np.float64) - mean) \
+                / (std if std > 0 else 1.0)
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, dataset) -> None:
+        for col in self.columns:
+            self.stats_[col] = (float(dataset.min(col)),
+                                float(dataset.max(col)))
+
+    def _transform_batch(self, batch):
+        for col in self.columns:
+            lo, hi = self.stats_[col]
+            span = (hi - lo) or 1.0
+            batch[col] = (np.asarray(batch[col], np.float64) - lo) / span
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical column -> contiguous int codes (reference:
+    preprocessors/encoder.LabelEncoder)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Optional[List[Any]] = None
+
+    def _fit(self, dataset) -> None:
+        self.classes_ = sorted(dataset.unique(self.label_column))
+
+    def _transform_batch(self, batch):
+        index = {v: i for i, v in enumerate(self.classes_)}
+        col = batch[self.label_column]
+        batch[self.label_column] = np.array(
+            [index[v] for v in np.asarray(col).tolist()], np.int64)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Merge numeric columns into one vector column (reference:
+    preprocessors/concatenator — the standard last step before ML
+    ingest)."""
+
+    def __init__(self, columns: List[str], output_column: str = "features",
+                 dtype=np.float32):
+        self.columns = list(columns)
+        self.output_column = output_column
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_batch(self, batch):
+        stacked = np.stack(
+            [np.asarray(batch.pop(c), self.dtype) for c in self.columns],
+            axis=1)
+        batch[self.output_column] = stacked
+        return batch
+
+
+class Chain(Preprocessor):
+    """Sequential composition; fit runs left to right with intermediate
+    transforms (reference: preprocessors/chain)."""
+
+    def __init__(self, *stages: Preprocessor):
+        self.stages = list(stages)
+
+    def _needs_fit(self) -> bool:
+        return any(s._needs_fit() for s in self.stages)
+
+    def fit(self, dataset) -> "Chain":
+        for stage in self.stages:
+            dataset = stage.fit_transform(dataset)
+        self._fitted = True
+        return self
+
+    def _transform_batch(self, batch):
+        for stage in self.stages:
+            batch = stage._transform_batch(batch)
+        return batch

@@ -263,3 +263,35 @@ def test_util_actor_pool_and_queue(cluster):
     assert ray_tpu.get(ref) is True
     q.shutdown()
     ray_tpu.kill(p)
+
+
+def test_preprocessors(cluster):
+    """Preprocessor fit/transform + chain + serving-path transform_batch
+    (reference: data/preprocessor.py + preprocessors/)."""
+    from ray_tpu import data as rdata
+    from ray_tpu.data import (
+        Chain, Concatenator, LabelEncoder, MinMaxScaler, StandardScaler)
+
+    ds = rdata.from_items([
+        {"a": float(i), "b": float(i * 2), "label": ["x", "y"][i % 2]}
+        for i in range(100)])
+
+    scaler = StandardScaler(["a"]).fit(ds)
+    out = scaler.transform(ds).take_all()
+    vals = np.array([r["a"] for r in out])
+    assert abs(vals.mean()) < 1e-6 and abs(vals.std() - 1.0) < 0.02
+
+    chain = Chain(MinMaxScaler(["a", "b"]), LabelEncoder("label"),
+                  Concatenator(["a", "b"])).fit(ds)
+    rows = chain.transform(ds).take_all()
+    assert np.asarray(rows[0]["features"]).shape == (2,)
+    assert set(r["label"] for r in rows) == {0, 1}
+    feats = np.array([r["features"] for r in rows])
+    assert feats.min() >= 0.0 and feats.max() <= 1.0
+
+    # Serving path: one batch, no dataset.
+    batch = chain.transform_batch(
+        {"a": np.array([0.0, 99.0]), "b": np.array([0.0, 198.0]),
+         "label": np.array(["x", "y"])})
+    assert batch["features"].shape == (2, 2)
+    assert batch["features"][1, 0] == 1.0
