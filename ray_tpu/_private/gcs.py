@@ -129,6 +129,8 @@ class GcsServer:
         self.kv = KvManager()
         self.kv.on_change = self._schedule_persist
         self._task_events: list = []  # ring buffer for the timeline
+        self._log_lines: list = []    # (seq, record) worker-log ring
+        self._log_seq = 0
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.node_heartbeat: dict[NodeID, float] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
@@ -274,6 +276,24 @@ class GcsServer:
         if overflow > 0:
             del self._task_events[:overflow]
         return {"ok": True}
+
+    async def add_log_lines(self, req):
+        """Worker-log sink (reference: log lines flow to the driver over
+        GCS pubsub, _private/gcs_pubsub.py)."""
+        for rec in req.get("lines", []):
+            self._log_seq += 1
+            self._log_lines.append((self._log_seq, rec))
+        overflow = len(self._log_lines) - 10000
+        if overflow > 0:
+            del self._log_lines[:overflow]
+        return {"ok": True, "seq": self._log_seq}
+
+    async def get_log_lines(self, req):
+        after = req.get("after_seq", 0)
+        job = req.get("job_id")
+        out = [(seq, rec) for seq, rec in self._log_lines if seq > after
+               and (job is None or rec.get("job_id") == job)]
+        return {"lines": out, "seq": self._log_seq}
 
     async def get_task_events(self, req):
         limit = req.get("limit", 10000)

@@ -93,6 +93,8 @@ class WorkerHandle:
         self.actor_id = None
         self.idle_since = time.monotonic()
         self.leased_at = 0.0
+        self.log_paths: dict = {}
+        self.log_offsets: dict = {}
         self.ready = asyncio.Event()
 
 
@@ -171,6 +173,9 @@ class NodeDaemon:
         err = open(log_base + ".err", "ab")
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
         handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env))
+        handle.log_paths = {"stdout": log_base + ".out",
+                            "stderr": log_base + ".err"}
+        handle.log_offsets = {"stdout": 0, "stderr": 0}
         _metrics()["workers_spawned"].inc()
         self.workers[proc.pid] = handle
         logger.info("spawned worker pid=%d job=%d env=%s", proc.pid, job_id,
@@ -541,6 +546,54 @@ class NodeDaemon:
         stats["spilled_bytes"] = self.spilled_bytes
         return stats
 
+    # ---------------- worker log streaming ----------------
+
+    def _collect_worker_log_lines(self, handle,
+                                  final: bool = False) -> list:
+        """New COMPLETE lines from a worker's log files.  Only consumes up
+        to the last newline so a line straddling the read boundary (or a
+        mid-write flush) is never split — unless `final` (worker dead:
+        flush everything, including a trailing partial line)."""
+        lines = []
+        for stream, path in handle.log_paths.items():
+            try:
+                with open(path, "rb") as f:
+                    f.seek(handle.log_offsets[stream])
+                    chunk = f.read(256 * 1024)
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            if not final:
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    continue  # no complete line yet; re-read next tick
+                chunk = chunk[:cut + 1]
+            handle.log_offsets[stream] += len(chunk)
+            for raw in chunk.decode("utf-8", "replace").splitlines():
+                lines.append({"pid": handle.proc.pid,
+                              "job_id": handle.job_id,
+                              "stream": stream, "line": raw})
+        return lines
+
+    async def _publish_log_lines(self, lines: list) -> None:
+        if not lines:
+            return
+        try:
+            await self.gcs.call("Gcs", "add_log_lines", {"lines": lines})
+        except Exception:
+            pass
+
+    async def _log_tail_loop(self):
+        """Tail worker stdout/stderr into the GCS log channel (reference:
+        _private/log_monitor.py -> GCS pubsub -> driver echo)."""
+        while True:
+            await asyncio.sleep(0.5)
+            lines = []
+            for handle in list(self.workers.values()):
+                lines.extend(self._collect_worker_log_lines(handle))
+            await self._publish_log_lines(lines)
+
     # ---------------- memory monitor ----------------
 
     @staticmethod
@@ -810,6 +863,10 @@ class NodeDaemon:
             now = time.monotonic()
             for handle in list(self.workers.values()):
                 if handle.proc.poll() is not None:
+                    # Final log read FIRST: a crashing worker's traceback
+                    # is exactly what must reach the driver.
+                    await self._publish_log_lines(
+                        self._collect_worker_log_lines(handle, final=True))
                     self.workers.pop(handle.proc.pid, None)
                     self._release_lease(handle)
                     if handle.state == "actor" and handle.actor_id is not None:
@@ -864,6 +921,7 @@ class NodeDaemon:
         if _cfg().memory_monitor_enabled:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
+        self._tasks.append(asyncio.ensure_future(self._log_tail_loop()))
         return port
 
     def install_signal_handlers(self):

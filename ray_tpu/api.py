@@ -129,8 +129,47 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
             if group is not None:
                 group.reap()
             raise
+        if log_to_driver:
+            _start_log_echo(_worker)
         atexit.register(shutdown)
         return _connection_info()
+
+
+_log_echo_stop = None
+
+
+def _start_log_echo(worker):
+    """Echo worker stdout/stderr to the driver terminal (reference:
+    worker.py log streaming via GCS pubsub; prefix = (pid, stream))."""
+    global _log_echo_stop
+    import sys
+    import threading as _th
+    import time as _time
+
+    stop = _th.Event()
+    _log_echo_stop = stop
+    job = worker._job_int()
+
+    def loop():
+        after = 0
+        while not stop.is_set():
+            _time.sleep(0.5)
+            try:
+                reply = worker.io.run(worker.gcs.call(
+                    "Gcs", "get_log_lines",
+                    {"after_seq": after, "job_id": job}, timeout=10),
+                    timeout=15)
+            except Exception:
+                continue
+            # Advance past EVERYTHING the GCS scanned (global seq), not
+            # just this job's lines, or quiet jobs rescan the whole ring.
+            after = max(after, reply.get("seq", after))
+            for seq, rec in reply.get("lines", []):
+                out = (sys.stderr if rec["stream"] == "stderr"
+                       else sys.stdout)
+                print(f"(pid={rec['pid']}) {rec['line']}", file=out)
+
+    _th.Thread(target=loop, daemon=True, name="raytpu-log-echo").start()
 
 
 def _connection_info():
@@ -143,7 +182,10 @@ _applied_system_config: list = []
 
 def shutdown():
     """Disconnect; if we bootstrapped the cluster, tear it down."""
-    global _worker, _cluster, _applied_system_config
+    global _worker, _cluster, _applied_system_config, _log_echo_stop
+    if _log_echo_stop is not None:
+        _log_echo_stop.set()
+        _log_echo_stop = None
     with _global_lock:
         if _worker is None:
             return

@@ -639,3 +639,29 @@ def test_memory_monitor_policy():
     assert NodeDaemon._pick_oom_victim(daemon).state == "actor"
     daemon.workers = {1: H("idle", 0)}
     assert NodeDaemon._pick_oom_victim(daemon) is None
+
+
+def test_worker_logs_stream_to_gcs(cluster):
+    """Worker prints reach the GCS log channel tagged with pid/stream
+    (reference: log_monitor -> GCS pubsub -> driver echo)."""
+    from ray_tpu import api
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-worker-stdout")
+        import sys
+        print("hello-from-worker-stderr", file=sys.stderr)
+        return 1
+
+    ray_tpu.get(chatty.remote())
+    w = api._worker
+    deadline = time.time() + 15
+    seen = set()
+    while time.time() < deadline and len(seen) < 2:
+        reply = w.io.run(w.gcs.call("Gcs", "get_log_lines",
+                                    {"after_seq": 0}), timeout=10)
+        for _seq, rec in reply["lines"]:
+            if "hello-from-worker" in rec["line"]:
+                seen.add(rec["stream"])
+        time.sleep(0.3)
+    assert seen == {"stdout", "stderr"}
