@@ -291,8 +291,13 @@ class GcsServer:
     async def get_log_lines(self, req):
         after = req.get("after_seq", 0)
         job = req.get("job_id")
-        out = [(seq, rec) for seq, rec in self._log_lines if seq > after
-               and (job is None or rec.get("job_id") == job)]
+        # Ring is seq-ordered: bisect to the first unseen entry instead of
+        # scanning 10k records per poll per driver.
+        import bisect
+        start = bisect.bisect_right(
+            self._log_lines, after, key=lambda e: e[0])
+        out = [(seq, rec) for seq, rec in self._log_lines[start:]
+               if job is None or rec.get("job_id") == job]
         return {"lines": out, "seq": self._log_seq}
 
     async def get_task_events(self, req):

@@ -548,41 +548,55 @@ class NodeDaemon:
 
     # ---------------- worker log streaming ----------------
 
-    def _collect_worker_log_lines(self, handle,
-                                  final: bool = False) -> list:
+    def _collect_worker_log_lines(self, handle, final: bool = False):
         """New COMPLETE lines from a worker's log files.  Only consumes up
         to the last newline so a line straddling the read boundary (or a
         mid-write flush) is never split — unless `final` (worker dead:
-        flush everything, including a trailing partial line)."""
+        loop to EOF and flush everything, including a trailing partial
+        line).  Returns (lines, undo) where undo restores the offsets if
+        the publish fails (lines must not be lost to a GCS blip)."""
         lines = []
+        undo = []
         for stream, path in handle.log_paths.items():
+            prev = handle.log_offsets[stream]
+            consumed = 0
             try:
                 with open(path, "rb") as f:
-                    f.seek(handle.log_offsets[stream])
-                    chunk = f.read(256 * 1024)
+                    f.seek(prev)
+                    while True:
+                        chunk = f.read(256 * 1024)
+                        if not chunk:
+                            break
+                        if not final:
+                            cut = chunk.rfind(b"\n")
+                            if cut < 0:
+                                break  # no complete line yet
+                            chunk = chunk[:cut + 1]
+                        consumed += len(chunk)
+                        for raw in chunk.decode(
+                                "utf-8", "replace").splitlines():
+                            lines.append({"pid": handle.proc.pid,
+                                          "job_id": handle.job_id,
+                                          "stream": stream, "line": raw})
+                        if not final:
+                            break  # one bounded read per tick
             except OSError:
                 continue
-            if not chunk:
-                continue
-            if not final:
-                cut = chunk.rfind(b"\n")
-                if cut < 0:
-                    continue  # no complete line yet; re-read next tick
-                chunk = chunk[:cut + 1]
-            handle.log_offsets[stream] += len(chunk)
-            for raw in chunk.decode("utf-8", "replace").splitlines():
-                lines.append({"pid": handle.proc.pid,
-                              "job_id": handle.job_id,
-                              "stream": stream, "line": raw})
-        return lines
+            if consumed:
+                handle.log_offsets[stream] = prev + consumed
+                undo.append((handle, stream, prev))
+        return lines, undo
 
-    async def _publish_log_lines(self, lines: list) -> None:
+    async def _publish_log_lines(self, lines: list, undo: list) -> None:
         if not lines:
             return
         try:
             await self.gcs.call("Gcs", "add_log_lines", {"lines": lines})
         except Exception:
-            pass
+            # Rewind so the next tick re-reads — a GCS blip must not
+            # create silent gaps in the stream.
+            for handle, stream, prev in undo:
+                handle.log_offsets[stream] = prev
 
     async def _log_tail_loop(self):
         """Tail worker stdout/stderr into the GCS log channel (reference:
@@ -590,9 +604,12 @@ class NodeDaemon:
         while True:
             await asyncio.sleep(0.5)
             lines = []
+            undo = []
             for handle in list(self.workers.values()):
-                lines.extend(self._collect_worker_log_lines(handle))
-            await self._publish_log_lines(lines)
+                ls, ud = self._collect_worker_log_lines(handle)
+                lines.extend(ls)
+                undo.extend(ud)
+            await self._publish_log_lines(lines, undo)
 
     # ---------------- memory monitor ----------------
 
@@ -865,8 +882,9 @@ class NodeDaemon:
                 if handle.proc.poll() is not None:
                     # Final log read FIRST: a crashing worker's traceback
                     # is exactly what must reach the driver.
-                    await self._publish_log_lines(
-                        self._collect_worker_log_lines(handle, final=True))
+                    ls, ud = self._collect_worker_log_lines(handle,
+                                                            final=True)
+                    await self._publish_log_lines(ls, ud)
                     self.workers.pop(handle.proc.pid, None)
                     self._release_lease(handle)
                     if handle.state == "actor" and handle.actor_id is not None:

@@ -164,10 +164,15 @@ def _start_log_echo(worker):
             # Advance past EVERYTHING the GCS scanned (global seq), not
             # just this job's lines, or quiet jobs rescan the whole ring.
             after = max(after, reply.get("seq", after))
-            for seq, rec in reply.get("lines", []):
-                out = (sys.stderr if rec["stream"] == "stderr"
-                       else sys.stdout)
-                print(f"(pid={rec['pid']}) {rec['line']}", file=out)
+            try:
+                for seq, rec in reply.get("lines", []):
+                    out = (sys.stderr if rec["stream"] == "stderr"
+                           else sys.stdout)
+                    print(f"(pid={rec['pid']}) {rec['line']}", file=out)
+            except (BrokenPipeError, OSError):
+                return  # stdout gone (piped driver exited) — stop echoing
+            except Exception:
+                pass
 
     _th.Thread(target=loop, daemon=True, name="raytpu-log-echo").start()
 

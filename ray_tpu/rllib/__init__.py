@@ -18,9 +18,14 @@ from ray_tpu.rllib.env import (  # noqa: F401
     register_env,
 )
 from ray_tpu.rllib.a2c import A2C, A2CConfig  # noqa: F401
+from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerThread  # noqa: F401
 from ray_tpu.rllib.learner import JaxLearner, ppo_loss  # noqa: F401
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
+from ray_tpu.rllib.replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae  # noqa: F401
@@ -28,7 +33,8 @@ from ray_tpu.rllib.vtrace import vtrace  # noqa: F401
 from ray_tpu.rllib.worker_set import WorkerSet  # noqa: F401
 
 __all__ = [
-    "A2C", "A2CConfig",
+    "A2C", "A2CConfig", "DQN", "DQNConfig",
+    "PrioritizedReplayBuffer", "ReplayBuffer",
     "Algorithm", "AlgorithmConfig", "CartPoleVector", "Env", "VectorEnv",
     "IMPALA", "IMPALAConfig", "JaxLearner", "JaxPolicy", "LearnerThread",
     "PPO", "PPOConfig", "RolloutWorker", "SampleBatch", "WorkerSet",

@@ -1,0 +1,191 @@
+"""DQN: deep Q-learning with replay and a target network.
+
+Reference parity: rllib/algorithms/dqn/ (dqn.py training_step: store
+rollouts into the replay buffer, sample minibatches, TD update, periodic
+target sync; simple_q loss with optional double-Q).  TPU-first: the TD
+update (gather Q, double-Q target, huber loss, optimizer step) is one
+jitted XLA program; epsilon-greedy exploration runs on the rollout
+actors via per-worker epsilon schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import make_model
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.worker_set import WorkerSet
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.lr = 1e-3
+        self.grad_clip = 10.0
+        self.replay_buffer_capacity = 50_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 128
+        self.updates_per_step = 32
+        self.target_update_freq = 250      # updates between target syncs
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 8_000
+        self.n_step_gamma = None           # defaults to cfg.gamma
+
+
+class _QLearner:
+    """Jitted TD update over (s, a, r, s', done) minibatches."""
+
+    def __init__(self, obs_dim: int, num_actions: int, cfg: DQNConfig,
+                 hidden, seed: int):
+        init_params, self.apply = make_model(obs_dim, num_actions, hidden)
+        # The ActorCritic's logits head doubles as Q-values; the value
+        # head is unused here.
+        self.params = init_params(jax.random.key(seed))
+        # JAX arrays are immutable and updates REPLACE params, so plain
+        # aliasing is a correct target-network snapshot.
+        self.target_params = self.params
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr, eps=1e-5))
+        self.opt_state = self.tx.init(self.params)
+        self.num_updates = 0
+        gamma = cfg.n_step_gamma or cfg.gamma
+        double_q = cfg.double_q
+        apply = self.apply
+
+        def loss(params, target_params, batch):
+            q_all, _ = apply(params, batch["obs"])
+            actions = batch["actions"].astype(jnp.int32)
+            q = jnp.take_along_axis(q_all, actions[:, None], axis=1)[:, 0]
+            q_next_t, _ = apply(target_params, batch["next_obs"])
+            if double_q:
+                q_next_online, _ = apply(params, batch["next_obs"])
+                best = jnp.argmax(q_next_online, axis=1)
+            else:
+                best = jnp.argmax(q_next_t, axis=1)
+            q_target_next = jnp.take_along_axis(
+                q_next_t, best[:, None], axis=1)[:, 0]
+            target = batch["rewards"] + gamma * q_target_next * (
+                1.0 - batch["dones"].astype(jnp.float32))
+            td = q - jax.lax.stop_gradient(target)
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                              jnp.abs(td) - 0.5)
+            return huber.mean(), {"td_error_mean": jnp.abs(td).mean(),
+                                  "q_mean": q.mean()}
+
+        def step(params, opt_state, target_params, batch):
+            (total, metrics), grads = jax.value_and_grad(
+                loss, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["loss"] = total
+            return params, opt_state, metrics
+
+        self._step = jax.jit(step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._step(
+            self.params, self.opt_state, self.target_params, jb)
+        self.num_updates += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self) -> None:
+        self.target_params = self.params
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def get_state(self):
+        return {"params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state):
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+
+
+def _to_transitions(batch: SampleBatch) -> SampleBatch:
+    """Time-major fragment [T, B] -> flat (s, a, r, s', done) rows.  The
+    next obs within a fragment is the next timestep; the last timestep
+    bootstraps from the fragment's bootstrap_obs."""
+    obs = batch[SampleBatch.OBS]                     # [T, B, D]
+    next_obs = np.concatenate(
+        [obs[1:], batch["bootstrap_obs"][None]], axis=0)
+    # Only true termination zeroes the bootstrap term; a TRUNCATED episode
+    # (time limit) still bootstraps from next_obs — treating it as
+    # terminal would teach Q that surviving to the limit is worthless.
+    done = batch[SampleBatch.TERMINATEDS]
+    flat = lambda x: x.reshape((-1,) + x.shape[2:])
+    return SampleBatch({
+        "obs": flat(obs), "next_obs": flat(next_obs),
+        "actions": flat(batch[SampleBatch.ACTIONS]),
+        "rewards": flat(batch[SampleBatch.REWARDS]),
+        "dones": flat(done),
+    })
+
+
+class DQN(Algorithm):
+    def setup(self) -> None:
+        cfg = self.config
+        self.workers = WorkerSet(
+            num_workers=cfg.num_rollout_workers,
+            num_cpus_per_worker=cfg.num_cpus_per_worker,
+            worker_kwargs=dict(
+                env=cfg.env, num_envs=cfg.num_envs_per_worker,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                gamma=cfg.gamma, lam=cfg.lambda_,
+                hidden=cfg.model_hidden, seed=cfg.seed,
+                postprocess=False,
+                epsilon_schedule=(cfg.epsilon_initial, cfg.epsilon_final,
+                                  cfg.epsilon_decay_steps)))
+        self.learner = _QLearner(self.obs_dim, self.num_actions, cfg,
+                                 cfg.model_hidden, cfg.seed)
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity,
+                                   seed=cfg.seed)
+        self.workers.sync_weights(self.learner.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        """Reference: dqn.py training_step — sample -> store -> N TD
+        updates -> periodic target sync -> weight broadcast."""
+        cfg = self.config
+        batches, metrics_list = self.workers.sample_sync()
+        episodes = self._record_metrics(metrics_list)
+        for b in batches:
+            self.buffer.add(_to_transitions(b))
+
+        learner_metrics: Dict[str, float] = {}
+        updates = 0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_step):
+                learner_metrics = self.learner.update(
+                    self.buffer.sample(cfg.train_batch_size))
+                updates += 1
+                if self.learner.num_updates % cfg.target_update_freq == 0:
+                    self.learner.sync_target()
+            self.workers.sync_weights(self.learner.get_weights())
+
+        return {"episodes_this_iter": episodes,
+                "buffer_size": len(self.buffer),
+                "learner_updates_total": self.learner.num_updates,
+                "updates_this_iter": updates,
+                **{f"learner/{k}": v for k, v in learner_metrics.items()}}
+
+    def save_to_dict(self) -> Dict[str, Any]:
+        return {"learner_state": self.learner.get_state(),
+                "config": self.config.to_dict()}
+
+    def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        self.learner.set_state(state["learner_state"])
+        self.workers.sync_weights(self.learner.get_weights())

@@ -46,7 +46,7 @@ class JaxPolicy:
 
         def _greedy(params, obs):
             logits, value = self.apply(params, obs)
-            return jnp.argmax(logits, axis=-1), value
+            return jnp.argmax(logits, axis=-1), value, logits
 
         with self._ctx():
             self.params = init_params(jax.random.key(seed))
@@ -70,9 +70,9 @@ class JaxPolicy:
                 a, logp, v, logits = self._sample(self.params, obs, sub)
                 return (np.asarray(a), np.asarray(logp), np.asarray(v),
                         np.asarray(logits))
-            a, v = self._greedy(self.params, obs)
+            a, v, logits = self._greedy(self.params, obs)
             z = np.zeros(len(obs), np.float32)
-            return np.asarray(a), z, np.asarray(v), None
+            return np.asarray(a), z, np.asarray(v), np.asarray(logits)
 
     def value(self, obs: np.ndarray) -> np.ndarray:
         _, _, v, _ = self.compute_actions(obs)

@@ -47,7 +47,8 @@ class RolloutWorker:
                  rollout_fragment_length: int = 64,
                  gamma: float = 0.99, lam: float = 0.95,
                  hidden=(64, 64), seed: int = 0,
-                 postprocess: bool = True):
+                 postprocess: bool = True,
+                 epsilon_schedule=None):
         # In a remote worker process, force the whole jax platform to CPU
         # before the first jax use: rollout actors must not even initialize
         # the TPU runtime (one chip, many actor processes).  In the driver
@@ -63,6 +64,11 @@ class RolloutWorker:
                                 self.env.num_actions, hidden, seed=seed)
         self.obs = self.env.reset_all(seed)
         self._total_steps = 0
+        # Epsilon-greedy exploration for value-based algorithms
+        # (reference: rllib/utils/exploration/epsilon_greedy.py):
+        # (initial, final, decay_steps) linear schedule on env steps.
+        self._epsilon_schedule = epsilon_schedule
+        self._np_rng = np.random.default_rng(seed + 99)
 
     # -- weights -----------------------------------------------------------
     def get_weights(self):
@@ -92,7 +98,18 @@ class RolloutWorker:
 
         obs = self.obs
         for t in range(T):
-            actions, logp, vf, logits = self.policy.compute_actions(obs)
+            # Value-based (epsilon) mode acts GREEDILY on Q plus epsilon
+            # noise; policy-gradient mode samples the distribution.
+            actions, logp, vf, logits = self.policy.compute_actions(
+                obs, explore=self._epsilon_schedule is None)
+            if self._epsilon_schedule is not None:
+                e0, e1, decay = self._epsilon_schedule
+                frac = min(1.0, self._total_steps / max(decay, 1))
+                eps = e0 + (e1 - e0) * frac
+                explore_mask = self._np_rng.random(B) < eps
+                random_actions = self._np_rng.integers(
+                    0, self.env.num_actions, size=B)
+                actions = np.where(explore_mask, random_actions, actions)
             obs_buf[t] = obs
             act_buf[t] = actions
             logp_buf[t] = logp
