@@ -57,6 +57,17 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
         _applied_system_config = list(_system_config or {})
         for k, v in (_system_config or {}).items():
             _os.environ[f"RAY_TPU_{k.upper()}"] = str(v)
+        if address and address.startswith("ray_tpu://"):
+            # Thin-client mode (reference: Ray Client, ray://): no local
+            # store/daemons — every call proxies to the client server.
+            from ray_tpu.util.client import ClientWorker
+            _worker = ClientWorker(address[len("ray_tpu://"):])
+            _cluster = {"group": None, "gcs": address, "owned": False}
+            if log_to_driver:
+                _start_log_echo(_worker)
+            atexit.register(shutdown)
+            return _connection_info()
+
         from ray_tpu._private import node as node_mod
         from ray_tpu._private.core_worker import CoreWorker
         from ray_tpu._private.rpc import RpcClient

@@ -141,6 +141,14 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_client_server(args) -> int:
+    """Run a thin-client server attached to the cluster (reference:
+    `ray start --ray-client-server-port`)."""
+    from ray_tpu.util.client.server import serve_forever
+    serve_forever(args.address, args.host, args.port)
+    return 0
+
+
 def cmd_metrics(args) -> int:
     from ray_tpu import state
     print(state.prometheus_metrics(args.address), end="")
@@ -193,6 +201,13 @@ def main(argv=None) -> int:
         if name == "timeline":
             q.add_argument("--out", default="ray_tpu_timeline.json")
         q.set_defaults(fn=fn)
+
+    q = sub.add_parser("client-server",
+                       help="serve thin clients (ray_tpu:// mode)")
+    q.add_argument("--address", required=True)
+    q.add_argument("--port", type=int, default=10001)
+    q.add_argument("--host", default="0.0.0.0")
+    q.set_defaults(fn=cmd_client_server)
 
     q = sub.add_parser("list", help="list live cluster entities")
     q.add_argument("kind", choices=["nodes", "actors", "workers",

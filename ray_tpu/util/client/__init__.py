@@ -1,0 +1,13 @@
+"""ray_tpu.util.client — thin-client mode (`ray_tpu://host:port`).
+
+Reference parity: python/ray/util/client/ (ARCHITECTURE.md,
+ray_client.proto): a lightweight client proxies every API call over RPC
+to a client server colocated with the cluster, which executes them
+through an embedded driver.  Nothing cluster-side (shm store, daemons)
+is required on the client machine.
+"""
+
+from ray_tpu.util.client.server import ClientServer  # noqa: F401
+from ray_tpu.util.client.worker import ClientWorker  # noqa: F401
+
+__all__ = ["ClientServer", "ClientWorker"]

@@ -1,0 +1,272 @@
+"""Client server: the cluster-side half of thin-client mode.
+
+Reference parity: python/ray/util/client/server/ — a server-side driver
+executes proxied put/get/task/actor calls; per-client sessions pin the
+ObjectRefs and actor handles they created and release them on
+disconnect.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import cloudpickle
+
+logger = logging.getLogger("ray_tpu.client_server")
+
+
+class _Session:
+    def __init__(self):
+        self.refs: Dict[bytes, Any] = {}       # object id -> ObjectRef pin
+        self.actors: Dict[bytes, Any] = {}     # actor id -> ActorHandle
+        self.fns: Dict[bytes, Any] = {}        # fn hash -> deserialized
+
+
+class ClientServer:
+    """Hosts the RayClient RPC service over an embedded driver.
+
+    Handlers execute the (BLOCKING) public API in a thread executor —
+    running them inline would deadlock/stall whichever event loop hosts
+    this server."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu._private.rpc import RpcServer
+        self.server = RpcServer(host)
+        self.sessions: Dict[str, _Session] = {}
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="client-srv")
+        for name in ("Init", "Put", "Get", "Wait", "Task", "CreateActor",
+                     "ActorCall", "Kill", "Cancel", "GcsCall", "Release",
+                     "Disconnect", "WorkerCall"):
+            self.server.register(
+                "RayClient", name,
+                self._wrap(getattr(self, f"_do_{name.lower()}")))
+
+    def _wrap(self, fn):
+        import asyncio
+
+        async def handler(req):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(self._pool, fn, req)
+        return handler
+
+    async def start(self, port: int = 0) -> int:
+        return await self.server.start(port)
+
+    def _session(self, req) -> _Session:
+        sid = req.get("session", "default")
+        if sid not in self.sessions:
+            self.sessions[sid] = _Session()
+        return self.sessions[sid]
+
+    def _decode_args(self, session: _Session, blob: bytes):
+        """Client args arrive cloudpickled with ObjectRef/ActorHandle
+        placeholders; rebuild the server-side objects."""
+        from ray_tpu import api
+
+        args, kwargs = cloudpickle.loads(blob)
+
+        def fix(v):
+            if isinstance(v, dict):
+                if "__client_ref__" in v:
+                    return session.refs[v["__client_ref__"]]
+                if "__client_actor__" in v:
+                    handle = session.actors.get(v["__client_actor__"])
+                    if handle is None:
+                        handle = session.actors[v["__client_actor__"]] = \
+                            self._foreign_handle(v["__client_actor__"])
+                    return handle
+                return {k: fix(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return type(v)(fix(x) for x in v)
+            return v
+
+        return tuple(fix(a) for a in args), {k: fix(v)
+                                             for k, v in kwargs.items()}
+
+    def _track(self, session: _Session, refs) -> list:
+        out = []
+        for ref in refs if isinstance(refs, list) else [refs]:
+            session.refs[ref.id.binary()] = ref
+            out.append(ref.id.binary())
+        return out
+
+    # ---------------- RPC handlers ----------------
+
+    def _do_init(self, req):
+        self._session(req)
+        return {"ok": True}
+
+    def _do_put(self, req):
+        import ray_tpu
+        session = self._session(req)
+        value = cloudpickle.loads(req["value"])
+        ref = ray_tpu.put(value)
+        return {"id": self._track(session, ref)[0]}
+
+    def _do_get(self, req):
+        import ray_tpu
+        session = self._session(req)
+        refs = [session.refs[i] for i in req["ids"]]
+        try:
+            values = ray_tpu.get(refs, timeout=req.get("timeout"))
+            return {"values": cloudpickle.dumps(values)}
+        except BaseException as e:  # noqa: BLE001 - ship to client
+            return {"error": cloudpickle.dumps(e)}
+
+    def _do_wait(self, req):
+        import ray_tpu
+        session = self._session(req)
+        refs = [session.refs[i] for i in req["ids"]]
+        ready, rest = ray_tpu.wait(refs, num_returns=req["num_returns"],
+                                   timeout=req.get("timeout"),
+                                   fetch_local=req.get("fetch_local", True))
+        return {"ready": [r.id.binary() for r in ready],
+                "not_ready": [r.id.binary() for r in rest]}
+
+    def _do_task(self, req):
+        import ray_tpu
+        session = self._session(req)
+        fn_hash = req["fn_hash"]
+        if fn_hash not in session.fns:
+            session.fns[fn_hash] = cloudpickle.loads(req["fn"])
+        fn = session.fns[fn_hash]
+        args, kwargs = self._decode_args(session, req["args"])
+        opts = cloudpickle.loads(req["opts"])
+        remote_fn = ray_tpu.remote(**opts)(fn) if opts else \
+            ray_tpu.remote(fn)
+        refs = remote_fn.remote(*args, **kwargs)
+        single = not isinstance(refs, list)
+        ids = self._track(session, refs)
+        return {"ids": ids, "single": single}
+
+    def _do_createactor(self, req):
+        import ray_tpu
+        session = self._session(req)
+        fn_hash = req["fn_hash"]
+        if fn_hash not in session.fns:
+            session.fns[fn_hash] = cloudpickle.loads(req["fn"])
+        cls = session.fns[fn_hash]
+        args, kwargs = self._decode_args(session, req["args"])
+        opts = cloudpickle.loads(req["opts"])
+        handle = (ray_tpu.remote(**opts)(cls) if opts
+                  else ray_tpu.remote(cls)).remote(*args, **kwargs)
+        session.actors[handle._actor_id.binary()] = handle
+        return {"actor_id": handle._actor_id.binary(),
+                "class_name": handle._class_name}
+
+    @staticmethod
+    def _foreign_handle(actor_id: bytes):
+        """Handle for an actor this session didn't create (named/detached
+        actors fetched via get_actor on the client)."""
+        from ray_tpu.api import ActorHandle
+        from ray_tpu._private.ids import ActorID
+        return ActorHandle(ActorID(actor_id), "remote", None)
+
+    def _do_actorcall(self, req):
+        session = self._session(req)
+        handle = session.actors.get(req["actor_id"])
+        if handle is None:
+            handle = session.actors[req["actor_id"]] = \
+                self._foreign_handle(req["actor_id"])
+        args, kwargs = self._decode_args(session, req["args"])
+        method = getattr(handle, req["method"])
+        num_returns = req.get("num_returns", 1)
+        if num_returns != 1:
+            method = method.options(num_returns=num_returns)
+        refs = method.remote(*args, **kwargs)
+        single = not isinstance(refs, list)
+        ids = self._track(session, refs)
+        return {"ids": ids, "single": single}
+
+    def _do_kill(self, req):
+        import ray_tpu
+        session = self._session(req)
+        handle = session.actors.get(req["actor_id"]) \
+            or self._foreign_handle(req["actor_id"])
+        ray_tpu.kill(handle, no_restart=req.get("no_restart", True))
+        return {"ok": True}
+
+    def _do_cancel(self, req):
+        import ray_tpu
+        session = self._session(req)
+        ref = session.refs.get(req["id"])
+        if ref is not None:
+            ray_tpu.cancel(ref, force=req.get("force", False))
+        return {"ok": True}
+
+    def _do_gcscall(self, req):
+        from ray_tpu import api
+        w = api._worker
+        reply = w.io.run(w.gcs.call(req["service"], req["method"],
+                                    cloudpickle.loads(req["request"])),
+                         timeout=60)
+        return {"reply": cloudpickle.dumps(reply)}
+
+    _WORKER_PASSTHROUGH = {
+        "create_placement_group", "wait_placement_group_ready",
+        "get_placement_group_info", "remove_placement_group",
+        "list_placement_groups",
+    }
+
+    def _do_workercall(self, req):
+        """Whitelisted driver-worker method passthrough (placement groups
+        etc.)."""
+        from ray_tpu import api
+        method = req["method"]
+        if method not in self._WORKER_PASSTHROUGH:
+            raise ValueError(f"method {method!r} not proxied")
+        args, kwargs = cloudpickle.loads(req["args"])
+        result = getattr(api._worker, method)(*args, **kwargs)
+        return {"result": cloudpickle.dumps(result)}
+
+    def _do_release(self, req):
+        session = self._session(req)
+        for i in req.get("ids", []):
+            session.refs.pop(i, None)
+        return {"ok": True}
+
+    def _do_disconnect(self, req):
+        self.sessions.pop(req.get("session", "default"), None)
+        return {"ok": True}
+
+
+def serve_forever(gcs_address: str, host: str = "0.0.0.0",
+                  port: int = 10001) -> None:
+    """Run a client server attached to `gcs_address` until interrupted.
+    The single entry point used by both the CLI and `python -m`."""
+    import asyncio
+
+    import ray_tpu
+    ray_tpu.init(address=gcs_address)
+
+    async def run():
+        server = ClientServer(host)
+        bound = await server.start(port)
+        print(f"client server listening on {host}:{bound} — connect "
+              f"with ray_tpu.init('ray_tpu://<host>:{bound}')", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True, help="GCS address")
+    parser.add_argument("--port", type=int, default=10001)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args()
+    logging.basicConfig(level="INFO")
+    serve_forever(args.address, args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""ClientWorker: the client-side half of thin-client mode.
+
+Reference parity: python/ray/util/client/worker.py — implements the same
+surface the public API layer drives (put/get/wait/submit_task/
+create_actor/submit_actor_task/kill/cancel + a GCS passthrough), every
+call one RPC to the client server.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+from typing import Any
+
+import cloudpickle
+
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+
+class _GcsShim:
+    """Looks like the driver's GCS client; proxies through the server."""
+
+    def __init__(self, client: "ClientWorker"):
+        self._client = client
+
+    async def call(self, service: str, method: str, request=None,
+                   timeout=None):
+        reply = await self._client._rpc.call(
+            "RayClient", "GcsCall",
+            {"session": self._client._session,
+             "service": service, "method": method,
+             "request": cloudpickle.dumps(request or {})},
+            timeout=timeout or 60)
+        return cloudpickle.loads(reply["reply"])
+
+
+class ClientWorker:
+    """Drop-in for CoreWorker behind the public API, speaking RPC."""
+
+    mode = "client"
+
+    def __init__(self, address: str):
+        self.address = address
+        self.gcs_address = address  # state API etc. route via the shim
+        self._session = uuid.uuid4().hex
+        self.io = EventLoopThread("raytpu-client-io")
+        self._rpc = RpcClient(address)
+        self.gcs = _GcsShim(self)
+        self.objects: dict = {}  # api-compat (observability introspection)
+        self._release_buffer: list = []
+        self.io.run(self._rpc.call(
+            "RayClient", "Init", {"session": self._session}, timeout=30))
+
+    # ---------------- helpers ----------------
+
+    def _call(self, method: str, req: dict, timeout=None):
+        req["session"] = self._session
+        # Piggyback pending ref releases (cheap, amortized).
+        if self._release_buffer and method not in ("Release", "Disconnect"):
+            ids, self._release_buffer = self._release_buffer, []
+            self.io.run(self._rpc.call(
+                "RayClient", "Release",
+                {"session": self._session, "ids": ids}, timeout=30))
+        return self.io.run(
+            self._rpc.call("RayClient", method, req, timeout=timeout))
+
+    @staticmethod
+    def _encode_args(args, kwargs) -> bytes:
+        from ray_tpu.api import ActorHandle
+
+        def enc(v):
+            if isinstance(v, ObjectRef):
+                return {"__client_ref__": v.id.binary()}
+            if isinstance(v, ActorHandle):
+                return {"__client_actor__": v._actor_id.binary()}
+            if isinstance(v, dict):
+                return {k: enc(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return type(v)(enc(x) for x in v)
+            return v
+
+        return cloudpickle.dumps(
+            (tuple(enc(a) for a in args),
+             {k: enc(v) for k, v in kwargs.items()}))
+
+    @staticmethod
+    def _fn_blob(fn) -> tuple:
+        blob = cloudpickle.dumps(fn)
+        return blob, hashlib.sha1(blob).hexdigest().encode()
+
+    def _mkref(self, id_binary: bytes) -> ObjectRef:
+        import weakref
+        ref = ObjectRef(ObjectID(id_binary), self.address, _register=False)
+        # Server-side pins release when the CLIENT ref is GC'd: ids batch
+        # into the next RPC (reference: client refs release server state).
+        weakref.finalize(ref, self._queue_release, id_binary)
+        return ref
+
+    def _queue_release(self, id_binary: bytes) -> None:
+        self._release_buffer.append(id_binary)
+
+    # ---------------- API surface ----------------
+
+    def put(self, value) -> ObjectRef:
+        reply = self._call("Put", {"value": cloudpickle.dumps(value)})
+        return self._mkref(reply["id"])
+
+    def get(self, refs, timeout=None):
+        single = isinstance(refs, ObjectRef)
+        ids = [r.id.binary() for r in ([refs] if single else refs)]
+        reply = self._call("Get", {"ids": ids, "timeout": timeout},
+                           timeout=(timeout + 30) if timeout else None)
+        if "error" in reply:
+            raise cloudpickle.loads(reply["error"])
+        values = cloudpickle.loads(reply["values"])
+        return values[0] if single else values
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        by_id = {r.id.binary(): r for r in refs}
+        reply = self._call("Wait", {
+            "ids": [r.id.binary() for r in refs],
+            "num_returns": num_returns, "timeout": timeout,
+            "fetch_local": fetch_local},
+            timeout=(timeout + 30) if timeout else None)
+        return ([by_id[i] for i in reply["ready"]],
+                [by_id[i] for i in reply["not_ready"]])
+
+    def submit_task(self, fn, args, kwargs, opts) -> list:
+        blob, fn_hash = self._fn_blob(fn)
+        clean = {k: v for k, v in (opts or {}).items() if v is not None
+                 and not (k == "placement_group_bundle_index" and v == -1)}
+        reply = self._call("Task", {
+            "fn": blob, "fn_hash": fn_hash,
+            "args": self._encode_args(args, kwargs),
+            "opts": cloudpickle.dumps(clean)})
+        return [self._mkref(i) for i in reply["ids"]]
+
+    def create_actor(self, cls, args, kwargs, opts) -> ActorID:
+        blob, fn_hash = self._fn_blob(cls)
+        clean = {k: v for k, v in (opts or {}).items() if v is not None
+                 and not (k == "placement_group_bundle_index" and v == -1)
+                 and not (k == "get_if_exists" and v is False)}
+        reply = self._call("CreateActor", {
+            "fn": blob, "fn_hash": fn_hash,
+            "args": self._encode_args(args, kwargs),
+            "opts": cloudpickle.dumps(clean)}, timeout=120)
+        return ActorID(reply["actor_id"])
+
+    def submit_actor_task(self, actor_id: ActorID, method: str, args,
+                          kwargs, opts) -> list:
+        reply = self._call("ActorCall", {
+            "actor_id": actor_id.binary(), "method": method,
+            "num_returns": (opts or {}).get("num_returns", 1),
+            "args": self._encode_args(args, kwargs)})
+        return [self._mkref(i) for i in reply["ids"]]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._call("Kill", {"actor_id": actor_id.binary(),
+                            "no_restart": no_restart})
+
+    def cancel_task(self, ref: ObjectRef, force=False, recursive=True):
+        self._call("Cancel", {"id": ref.id.binary(), "force": force})
+
+    def get_named_actor(self, name: str, namespace: str = "default"):
+        reply = self.io.run(self.gcs.call(
+            "Gcs", "get_named_actor", {"name": name,
+                                       "namespace": namespace}))
+        return reply.get("info")
+
+    def _job_int(self):
+        return None  # client sessions span jobs; log echo shows all lines
+
+    def _worker_call(self, method: str, *args, **kwargs):
+        reply = self._call("WorkerCall", {
+            "method": method,
+            "args": cloudpickle.dumps((args, kwargs))}, timeout=120)
+        return cloudpickle.loads(reply["result"])
+
+    # Placement groups proxy to the server driver (whitelisted there).
+    def create_placement_group(self, *a, **kw):
+        return self._worker_call("create_placement_group", *a, **kw)
+
+    def wait_placement_group_ready(self, *a, **kw):
+        return self._worker_call("wait_placement_group_ready", *a, **kw)
+
+    def get_placement_group_info(self, *a, **kw):
+        return self._worker_call("get_placement_group_info", *a, **kw)
+
+    def remove_placement_group(self, *a, **kw):
+        return self._worker_call("remove_placement_group", *a, **kw)
+
+    def list_placement_groups(self, *a, **kw):
+        return self._worker_call("list_placement_groups", *a, **kw)
+
+    def shutdown(self):
+        try:
+            self._call("Disconnect", {}, timeout=5)
+        except Exception:
+            pass
+        try:
+            self.io.run(self._rpc.close())
+        except Exception:
+            pass
+        self.io.stop()
