@@ -131,6 +131,11 @@ class GcsServer:
         self._task_events: list = []  # ring buffer for the timeline
         self._log_lines: list = []    # (seq, record) worker-log ring
         self._log_seq = 0
+        # Generic pub/sub channels (reference: src/ray/pubsub/ long-poll
+        # publisher/subscriber): channel -> ring of (seq, message).
+        self._channels: dict[str, list] = {}
+        self._channel_seq: dict[str, int] = {}
+        self._channel_events: dict[str, asyncio.Event] = {}
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.node_heartbeat: dict[NodeID, float] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
@@ -276,6 +281,49 @@ class GcsServer:
         if overflow > 0:
             del self._task_events[:overflow]
         return {"ok": True}
+
+    async def pub_publish(self, req):
+        """Publish messages to a channel (reference: publisher.h:302)."""
+        channel = req["channel"]
+        ring = self._channels.setdefault(channel, [])
+        seq = self._channel_seq.get(channel, 0)
+        for msg in req.get("messages", []):
+            seq += 1
+            ring.append((seq, msg))
+        self._channel_seq[channel] = seq
+        overflow = len(ring) - 10000
+        if overflow > 0:
+            del ring[:overflow]
+        ev = self._channel_events.pop(channel, None)
+        if ev is not None:
+            ev.set()
+        return {"seq": seq}
+
+    async def pub_poll(self, req):
+        """Long-poll a channel past after_seq (reference: long-poll
+        subscriber channels, subscriber.h:70): holds the request until a
+        publish or timeout."""
+        channel = req["channel"]
+        after = req.get("after_seq", 0)
+        deadline = time.monotonic() + req.get("timeout_s", 10.0)
+        import bisect
+        while True:
+            ring = self._channels.get(channel, [])
+            start = bisect.bisect_right(ring, after, key=lambda e: e[0])
+            if start < len(ring):
+                return {"messages": ring[start:],
+                        "seq": self._channel_seq.get(channel, 0)}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"messages": [],
+                        "seq": self._channel_seq.get(channel, 0)}
+            ev = self._channel_events.get(channel)
+            if ev is None:
+                ev = self._channel_events[channel] = asyncio.Event()
+            try:
+                await asyncio.wait_for(ev.wait(), min(remaining, 1.0))
+            except asyncio.TimeoutError:
+                pass
 
     async def add_log_lines(self, req):
         """Worker-log sink (reference: log lines flow to the driver over

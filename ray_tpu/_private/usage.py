@@ -1,0 +1,50 @@
+"""Usage statistics (reference: python/ray/_private/usage/ — opt-out
+cluster usage reporting).  This deployment is network-isolated, so
+reports are only ever written LOCALLY (session dir usage_stats.json);
+nothing leaves the machine.  Disabled entirely with
+RAY_TPU_USAGE_STATS_ENABLED=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def collect_usage(extra: Dict[str, Any] | None = None) -> Dict[str, Any]:
+    import platform
+    import ray_tpu
+    stats = {
+        "ray_tpu_version": ray_tpu.__version__,
+        "python_version": platform.python_version(),
+        "platform": platform.platform(),
+        "cpu_count": os.cpu_count(),
+        "recorded_at": time.time(),
+    }
+    import sys
+    jax = sys.modules.get("jax")  # never cold-import jax on the init path
+    if jax is not None:
+        stats["jax_version"] = getattr(jax, "__version__", "?")
+    stats.update(extra or {})
+    return stats
+
+
+def record_usage(session_dir: str,
+                 extra: Dict[str, Any] | None = None) -> str | None:
+    """Write the local usage report; returns the path (or None when
+    disabled)."""
+    if not usage_stats_enabled():
+        return None
+    path = os.path.join(session_dir, "usage_stats.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(collect_usage(extra), f, indent=2)
+        return path
+    except OSError:
+        return None

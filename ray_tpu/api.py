@@ -87,6 +87,8 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
                 raise
             _cluster = {"group": group, "gcs": gcs_address,
                         "session_dir": session_dir, "owned": True}
+            from ray_tpu._private import usage as _usage
+            _usage.record_usage(session_dir)
         else:
             gcs_address = address
             # Find a hostd on this machine to use as our home node.

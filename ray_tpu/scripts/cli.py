@@ -149,6 +149,70 @@ def cmd_client_server(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve control subcommands (reference: serve CLI scripts.py —
+    deploy from a config file, status, shutdown)."""
+    import json as jsonlib
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address=args.address)
+    try:
+        if args.serve_cmd == "status":
+            print(jsonlib.dumps(serve.status(), indent=2))
+            return 0
+        if args.serve_cmd == "shutdown":
+            serve.shutdown()
+            print("serve shutdown complete")
+            return 0
+        if args.serve_cmd == "deploy":
+            if not args.config:
+                print("serve deploy requires a config file", file=sys.stderr)
+                return 2
+            # Config schema (reference: serve/schema.py, JSON or YAML):
+            # {"applications": [{"import_path": "module:app",
+            #                    "deployments": [{"name": ...,
+            #                                     "num_replicas": ...}]}]}
+            import importlib
+            import os
+            import sys as _sys
+            _sys.path.insert(0, os.getcwd())
+            with open(args.config) as f:
+                text = f.read()
+            try:
+                cfg = jsonlib.loads(text)
+            except jsonlib.JSONDecodeError:
+                import yaml
+                cfg = yaml.safe_load(text)
+            serve.start()
+            for app_cfg in cfg.get("applications", []):
+                mod_name, _, attr = app_cfg["import_path"].partition(":")
+                app = getattr(importlib.import_module(mod_name), attr)
+                overrides = {d["name"]: d
+                             for d in app_cfg.get("deployments", [])}
+
+                def apply(a):
+                    for sub in list(a.args) + list(a.kwargs.values()):
+                        if type(sub).__name__ == "Application":
+                            apply(sub)
+                    o = overrides.get(a.deployment.name)
+                    if o:
+                        for k in ("num_replicas", "max_concurrent_queries",
+                                  "user_config"):
+                            if k in o:
+                                setattr(a.deployment._config, k, o[k])
+                apply(app)
+                serve.run(app)
+                print(f"deployed application from "
+                      f"{app_cfg['import_path']}")
+            print(jsonlib.dumps(serve.status(), indent=2))
+            return 0
+        return 2
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_metrics(args) -> int:
     from ray_tpu import state
     print(state.prometheus_metrics(args.address), end="")
@@ -201,6 +265,12 @@ def main(argv=None) -> int:
         if name == "timeline":
             q.add_argument("--out", default="ray_tpu_timeline.json")
         q.set_defaults(fn=fn)
+
+    q = sub.add_parser("serve", help="serve control (deploy/status/shutdown)")
+    q.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])
+    q.add_argument("config", nargs="?", help="config file for deploy")
+    q.add_argument("--address", required=True)
+    q.set_defaults(fn=cmd_serve)
 
     q = sub.add_parser("client-server",
                        help="serve thin clients (ray_tpu:// mode)")

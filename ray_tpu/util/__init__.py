@@ -9,3 +9,4 @@ from ray_tpu.util.placement_group import (  # noqa: F401
 )
 from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
 from ray_tpu.util.queue import Queue  # noqa: F401
+from ray_tpu.util.pubsub import Publisher, Subscriber  # noqa: F401

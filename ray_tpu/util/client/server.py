@@ -18,9 +18,11 @@ logger = logging.getLogger("ray_tpu.client_server")
 
 class _Session:
     def __init__(self):
+        import time
         self.refs: Dict[bytes, Any] = {}       # object id -> ObjectRef pin
         self.actors: Dict[bytes, Any] = {}     # actor id -> ActorHandle
         self.fns: Dict[bytes, Any] = {}        # fn hash -> deserialized
+        self.last_seen = time.time()
 
 
 class ClientServer:
@@ -36,8 +38,13 @@ class ClientServer:
         from ray_tpu._private.rpc import RpcServer
         self.server = RpcServer(host)
         self.sessions: Dict[str, _Session] = {}
-        self._pool = ThreadPoolExecutor(max_workers=16,
+        import os
+        self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="client-srv")
+        # Crashed clients never send Disconnect; stale sessions (and the
+        # object pins they hold) expire after this idle window.
+        self._session_ttl = float(
+            os.environ.get("RAY_TPU_CLIENT_SESSION_TTL_S", "600"))
         for name in ("Init", "Put", "Get", "Wait", "Task", "CreateActor",
                      "ActorCall", "Kill", "Cancel", "GcsCall", "Release",
                      "Disconnect", "WorkerCall"):
@@ -57,10 +64,18 @@ class ClientServer:
         return await self.server.start(port)
 
     def _session(self, req) -> _Session:
+        import time
+        now = time.time()
+        for stale_id, sess in list(self.sessions.items()):
+            if now - sess.last_seen > self._session_ttl:
+                logger.info("expiring idle client session %s", stale_id[:8])
+                self.sessions.pop(stale_id, None)
         sid = req.get("session", "default")
         if sid not in self.sessions:
             self.sessions[sid] = _Session()
-        return self.sessions[sid]
+        sess = self.sessions[sid]
+        sess.last_seen = now
+        return sess
 
     def _decode_args(self, session: _Session, blob: bytes):
         """Client args arrive cloudpickled with ObjectRef/ActorHandle
@@ -72,7 +87,8 @@ class ClientServer:
         def fix(v):
             if isinstance(v, dict):
                 if "__client_ref__" in v:
-                    return session.refs[v["__client_ref__"]]
+                    return self._ref_fallback(session, v["__client_ref__"],
+                                              v.get("owner", ""))
                 if "__client_actor__" in v:
                     handle = session.actors.get(v["__client_actor__"])
                     if handle is None:
@@ -110,7 +126,9 @@ class ClientServer:
     def _do_get(self, req):
         import ray_tpu
         session = self._session(req)
-        refs = [session.refs[i] for i in req["ids"]]
+        owners = req.get("owners") or [""] * len(req["ids"])
+        refs = [self._ref_fallback(session, i, o)
+                for i, o in zip(req["ids"], owners)]
         try:
             values = ray_tpu.get(refs, timeout=req.get("timeout"))
             return {"values": cloudpickle.dumps(values)}
@@ -120,7 +138,9 @@ class ClientServer:
     def _do_wait(self, req):
         import ray_tpu
         session = self._session(req)
-        refs = [session.refs[i] for i in req["ids"]]
+        owners = req.get("owners") or [""] * len(req["ids"])
+        refs = [self._ref_fallback(session, i, o)
+                for i, o in zip(req["ids"], owners)]
         ready, rest = ray_tpu.wait(refs, num_returns=req["num_returns"],
                                    timeout=req.get("timeout"),
                                    fetch_local=req.get("fetch_local", True))
@@ -157,6 +177,20 @@ class ClientServer:
         session.actors[handle._actor_id.binary()] = handle
         return {"actor_id": handle._actor_id.binary(),
                 "class_name": handle._class_name}
+
+    @staticmethod
+    def _ref_fallback(session: _Session, id_binary: bytes,
+                      owner: str = ""):
+        """Refs the session didn't create (returned as VALUES from tasks,
+        then echoed back by the client) rebuild from the true owner
+        address the client received."""
+        from ray_tpu.object_ref import ObjectRef
+        from ray_tpu._private.ids import ObjectID
+        ref = session.refs.get(id_binary)
+        if ref is None:
+            ref = session.refs[id_binary] = ObjectRef(
+                ObjectID(id_binary), owner, _register=False)
+        return ref
 
     @staticmethod
     def _foreign_handle(actor_id: bytes):
@@ -201,9 +235,10 @@ class ClientServer:
     def _do_gcscall(self, req):
         from ray_tpu import api
         w = api._worker
+        timeout = req.get("timeout") or 60
         reply = w.io.run(w.gcs.call(req["service"], req["method"],
                                     cloudpickle.loads(req["request"])),
-                         timeout=60)
+                         timeout=timeout)
         return {"reply": cloudpickle.dumps(reply)}
 
     _WORKER_PASSTHROUGH = {

@@ -31,8 +31,9 @@ class _GcsShim:
             "RayClient", "GcsCall",
             {"session": self._client._session,
              "service": service, "method": method,
+             "timeout": timeout,
              "request": cloudpickle.dumps(request or {})},
-            timeout=timeout or 60)
+            timeout=(timeout or 60) + 30)
         return cloudpickle.loads(reply["reply"])
 
 
@@ -68,11 +69,16 @@ class ClientWorker:
 
     @staticmethod
     def _encode_args(args, kwargs) -> bytes:
+        """Known limitation (vs the reference client's deep serializer):
+        refs/handles are translated inside plain containers only — a ref
+        buried in a user object pickles with the client-server address as
+        owner and will not resolve cluster-side."""
         from ray_tpu.api import ActorHandle
 
         def enc(v):
             if isinstance(v, ObjectRef):
-                return {"__client_ref__": v.id.binary()}
+                return {"__client_ref__": v.id.binary(),
+                        "owner": v.owner_address or ""}
             if isinstance(v, ActorHandle):
                 return {"__client_actor__": v._actor_id.binary()}
             if isinstance(v, dict):
@@ -109,9 +115,12 @@ class ClientWorker:
 
     def get(self, refs, timeout=None):
         single = isinstance(refs, ObjectRef)
-        ids = [r.id.binary() for r in ([refs] if single else refs)]
-        reply = self._call("Get", {"ids": ids, "timeout": timeout},
-                           timeout=(timeout + 30) if timeout else None)
+        rlist = [refs] if single else refs
+        reply = self._call("Get", {
+            "ids": [r.id.binary() for r in rlist],
+            "owners": [r.owner_address or "" for r in rlist],
+            "timeout": timeout},
+            timeout=(timeout + 30) if timeout else None)
         if "error" in reply:
             raise cloudpickle.loads(reply["error"])
         values = cloudpickle.loads(reply["values"])
@@ -121,6 +130,7 @@ class ClientWorker:
         by_id = {r.id.binary(): r for r in refs}
         reply = self._call("Wait", {
             "ids": [r.id.binary() for r in refs],
+            "owners": [r.owner_address or "" for r in refs],
             "num_returns": num_returns, "timeout": timeout,
             "fetch_local": fetch_local},
             timeout=(timeout + 30) if timeout else None)

@@ -1,0 +1,77 @@
+"""Pub/sub over the GCS: named channels with long-poll subscribers.
+
+Reference parity: src/ray/pubsub/ (Publisher publisher.h:302 long-poll
+channels, SubscriberChannel subscriber.h:70) and the Python face
+python/ray/_private/gcs_pubsub.py (GcsPublisher/GcsSubscriber).  The
+worker-log stream and cluster-change events are specializations of this
+mechanism; user code can publish/subscribe arbitrary channels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+
+def _worker():
+    from ray_tpu import api
+    if api._worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return api._worker
+
+
+class Publisher:
+    """Publish messages to a named channel."""
+
+    def __init__(self, channel: str):
+        self.channel = channel
+
+    def publish(self, *messages: Any) -> int:
+        w = _worker()
+        reply = w.io.run(w.gcs.call(
+            "Gcs", "pub_publish",
+            {"channel": self.channel, "messages": list(messages)}))
+        return reply["seq"]
+
+
+class Subscriber:
+    """Long-poll subscriber; `poll` blocks until messages or timeout."""
+
+    def __init__(self, channel: str, *, from_seq: int = 0):
+        self.channel = channel
+        self._after = from_seq
+
+    def poll(self, timeout_s: float = 10.0) -> List[Any]:
+        w = _worker()
+        reply = w.io.run(w.gcs.call(
+            "Gcs", "pub_poll",
+            {"channel": self.channel, "after_seq": self._after,
+             "timeout_s": timeout_s}, timeout=timeout_s + 30),
+            timeout=timeout_s + 45)
+        msgs = reply.get("messages", [])
+        if msgs:
+            self._after = msgs[-1][0]
+        else:
+            self._after = max(self._after, reply.get("seq", self._after))
+        return [m for _seq, m in msgs]
+
+    def listen(self, callback: Callable[[Any], None],
+               stop_event: Optional[threading.Event] = None
+               ) -> threading.Thread:
+        """Background delivery thread calling `callback` per message."""
+        stop = stop_event or threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                try:
+                    for msg in self.poll(timeout_s=2.0):
+                        callback(msg)
+                except Exception:
+                    if stop.wait(1.0):
+                        return
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"pubsub-{self.channel}")
+        t.stop_event = stop
+        t.start()
+        return t

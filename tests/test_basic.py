@@ -665,3 +665,40 @@ def test_worker_logs_stream_to_gcs(cluster):
                 seen.add(rec["stream"])
         time.sleep(0.3)
     assert seen == {"stdout", "stderr"}
+
+
+def test_pubsub_channels(cluster):
+    """Named pub/sub channels with long-poll subscribers (reference:
+    src/ray/pubsub + gcs_pubsub.py)."""
+    import threading
+
+    from ray_tpu.util import Publisher, Subscriber
+
+    pub = Publisher("events")
+    sub = Subscriber("events")
+    assert sub.poll(timeout_s=0.2) == []    # empty channel times out
+
+    pub.publish({"kind": "a"}, {"kind": "b"})
+    msgs = sub.poll(timeout_s=5)
+    assert [m["kind"] for m in msgs] == ["a", "b"]
+    assert sub.poll(timeout_s=0.2) == []    # cursor advanced
+
+    # Long-poll actually parks: publish from another thread mid-poll.
+    got = []
+
+    def publish_later():
+        time.sleep(0.5)
+        Publisher("events").publish({"kind": "late"})
+
+    t = threading.Thread(target=publish_later)
+    t.start()
+    t0 = time.monotonic()
+    msgs = sub.poll(timeout_s=10)
+    elapsed = time.monotonic() - t0
+    t.join()
+    assert [m["kind"] for m in msgs] == ["late"]
+    assert 0.3 < elapsed < 5.0  # woke on publish, not timeout
+
+    # A second subscriber from seq 0 replays the ring.
+    sub2 = Subscriber("events")
+    assert len(sub2.poll(timeout_s=2)) == 3

@@ -132,3 +132,32 @@ def test_client_nested_refs_and_num_returns(client_cluster):
     r1, r2 = m.pair.options(num_returns=2).remote()
     assert ray_tpu.get([r1, r2]) == ["a", "b"]
     ray_tpu.kill(m)
+
+
+def test_client_returned_ref_roundtrip(client_cluster):
+    """A ref RETURNED from a task (never created by this session) still
+    resolves through the client."""
+    @ray_tpu.remote
+    def make_ref():
+        import ray_tpu as rt
+        return rt.put(41)
+
+    inner = ray_tpu.get(make_ref.remote())
+    assert ray_tpu.get(inner, timeout=30) == 41
+    ready, _ = ray_tpu.wait([inner], num_returns=1, timeout=30)
+    assert ready
+
+    # Top-level ref args auto-dereference (reference semantics)...
+    @ray_tpu.remote
+    def plus_one(v):
+        return v + 1
+
+    assert ray_tpu.get(plus_one.remote(inner)) == 42
+
+    # ...while refs inside containers pass through unresolved.
+    @ray_tpu.remote
+    def deref(lst):
+        import ray_tpu as rt
+        return rt.get(lst[0]) + 2
+
+    assert ray_tpu.get(deref.remote([inner])) == 43

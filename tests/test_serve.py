@@ -297,3 +297,60 @@ def test_http_proxy_concurrency(cluster):
     # must land far below that.
     assert elapsed < 6.0, f"requests serialized: {elapsed:.1f}s"
     serve.delete("slowhttp")
+
+
+def test_serve_cli_deploy_from_config(tmp_path, monkeypatch):
+    """`serve deploy <config>` imports an application, applies per-
+    deployment overrides, and reports status (reference: serve CLI +
+    schema.py config deploy)."""
+    import io
+    import json
+    import subprocess
+    import sys
+    from contextlib import redirect_stdout
+
+    from ray_tpu.cluster_utils import Cluster
+
+    app_mod = tmp_path / "my_serve_app.py"
+    app_mod.write_text(
+        "import ray_tpu\n"
+        "from ray_tpu import serve\n\n"
+        "@serve.deployment(name='hello')\n"
+        "def hello(x):\n"
+        "    return {'hi': x}\n\n"
+        "app = hello.bind()\n")
+    config = tmp_path / "serve_config.json"
+    config.write_text(json.dumps({
+        "applications": [{
+            "import_path": "my_serve_app:app",
+            "deployments": [{"name": "hello", "num_replicas": 2}],
+        }]}))
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4})
+    try:
+        import os
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "serve",
+             "deploy", str(config), "--address", cluster.address],
+            capture_output=True, text=True, timeout=180,
+            cwd=str(tmp_path), env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert '"hello"' in proc.stdout
+        assert '"num_replicas": 2' in proc.stdout
+    finally:
+        cluster.shutdown()
+
+
+def test_usage_stats_written(tmp_path):
+    from ray_tpu._private import usage
+
+    stats = usage.collect_usage({"probe": 1})
+    assert stats["probe"] == 1 and "ray_tpu_version" in stats
+    path = usage.record_usage(str(tmp_path))
+    assert path and tmp_path.joinpath("usage_stats.json").exists()
