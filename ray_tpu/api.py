@@ -167,12 +167,18 @@ def _start_log_echo(worker):
         after = 0
         while not stop.is_set():
             _time.sleep(0.5)
+            coro = worker.gcs.call(
+                "Gcs", "get_log_lines",
+                {"after_seq": after, "job_id": job}, timeout=10)
             try:
-                reply = worker.io.run(worker.gcs.call(
-                    "Gcs", "get_log_lines",
-                    {"after_seq": after, "job_id": job}, timeout=10),
-                    timeout=15)
+                reply = worker.io.run(coro, timeout=15)
             except Exception:
+                try:
+                    # Only safe when the coroutine never started (loop
+                    # gone); a scheduled one raises ValueError — ignore.
+                    coro.close()
+                except Exception:
+                    pass
                 continue
             # Advance past EVERYTHING the GCS scanned (global seq), not
             # just this job's lines, or quiet jobs rescan the whole ring.

@@ -9,6 +9,7 @@ ingest.
 from ray_tpu.data.dataset import (  # noqa: F401
     DataIterator,
     Dataset,
+    DatasetPipeline,
     GroupedData,
 )
 from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401

@@ -359,6 +359,19 @@ class Dataset:
             shards[i % n].append(r)
         return [Dataset(ExecPlan(s)) for s in shards]
 
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        """Epoch pipelining (reference: dataset_pipeline.py Dataset.repeat
+        -> DatasetPipeline.iter_epochs): each epoch re-executes this
+        dataset's lazy plan (fresh shuffles and transforms), blocks flow
+        with the executor's backpressure."""
+        return DatasetPipeline(self, times=times)
+
+    def window(self, *, blocks_per_window: int = 4) -> "DatasetPipeline":
+        """Windowed pipelining (reference: Dataset.window): the plan's
+        input blocks split into windows processed independently, bounding
+        in-flight materialization."""
+        return DatasetPipeline(self, blocks_per_window=blocks_per_window)
+
     def streaming_split(self, n: int, *, equal: bool = False
                         ) -> List["DataIterator"]:
         """n independent streaming iterators, one per consumer (Train
@@ -665,3 +678,52 @@ class DataIterator:
 
     def __reduce__(self):
         return (DataIterator, (self._refs,))
+
+
+class DatasetPipeline:
+    """Epoch/window pipelining over a lazy Dataset (reference:
+    data/dataset_pipeline.py).  repeat(n): iter_epochs yields n Datasets,
+    each a FRESH execution of the plan (so per-epoch random_shuffle
+    reshuffles); window(k): the input blocks process k at a time."""
+
+    def __init__(self, dataset: "Dataset", times: Optional[int] = None,
+                 blocks_per_window: Optional[int] = None):
+        self._dataset = dataset
+        self._times = times
+        self._blocks_per_window = blocks_per_window
+
+    def iter_epochs(self) -> Iterator["Dataset"]:
+        if self._blocks_per_window is not None:
+            raise ValueError("windowed pipelines iterate batches/windows")
+        count = 0
+        while self._times is None or count < self._times:
+            # Fresh plan execution per epoch: no cached materialization.
+            yield Dataset(ExecPlan(list(self._dataset._plan.input_refs),
+                                   list(self._dataset._plan.stages)))
+            count += 1
+
+    def iter_windows(self) -> Iterator["Dataset"]:
+        if self._blocks_per_window is None:
+            raise ValueError("epoch pipelines iterate epochs")
+        # window() applies at its position in the chain (reference
+        # semantics): stages BEFORE it (e.g. repartition) run first, so
+        # the window size is in OUTPUT blocks; stages added to the
+        # per-window datasets afterwards stream window by window.
+        refs = (self._dataset._execute() if self._dataset._plan.stages
+                else list(self._dataset._plan.input_refs))
+        k = max(1, self._blocks_per_window)
+        for lo in range(0, len(refs), k):
+            yield Dataset(ExecPlan(refs[lo:lo + k]))
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        """Stream batches across all epochs/windows."""
+        parts = (self.iter_windows() if self._blocks_per_window is not None
+                 else self.iter_epochs())
+        for ds in parts:
+            yield from ds.iter_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[Any]:
+        parts = (self.iter_windows() if self._blocks_per_window is not None
+                 else self.iter_epochs())
+        for ds in parts:
+            yield from ds.iter_rows()

@@ -21,6 +21,7 @@ from ray_tpu.rllib.a2c import A2C, A2CConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerThread  # noqa: F401
 from ray_tpu.rllib.learner import JaxLearner, ppo_loss  # noqa: F401
+from ray_tpu.rllib.offline import BC, BCConfig, JsonReader, JsonWriter  # noqa: F401
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
 from ray_tpu.rllib.replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,
@@ -33,7 +34,8 @@ from ray_tpu.rllib.vtrace import vtrace  # noqa: F401
 from ray_tpu.rllib.worker_set import WorkerSet  # noqa: F401
 
 __all__ = [
-    "A2C", "A2CConfig", "DQN", "DQNConfig",
+    "A2C", "A2CConfig", "BC", "BCConfig", "DQN", "DQNConfig",
+    "JsonReader", "JsonWriter",
     "PrioritizedReplayBuffer", "ReplayBuffer",
     "Algorithm", "AlgorithmConfig", "CartPoleVector", "Env", "VectorEnv",
     "IMPALA", "IMPALAConfig", "JaxLearner", "JaxPolicy", "LearnerThread",

@@ -1,0 +1,180 @@
+"""Offline RL: experience IO + learning from logged data.
+
+Reference parity: rllib/offline/ — JsonWriter/JsonReader (experiences
+logged as JSON-lines of SampleBatches, read back for training, optionally
+through Ray Data: dataset_reader.py) and the BC/MARWIL family
+(rllib/algorithms/bc — supervised policy learning on logged actions).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _encode_array(arr: np.ndarray) -> dict:
+    return {"__npy__": base64.b64encode(
+        np.ascontiguousarray(arr).tobytes()).decode(),
+        "dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def _decode_array(obj: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(obj["__npy__"]),
+        dtype=np.dtype(obj["dtype"])).reshape(obj["shape"]).copy()
+
+
+class JsonWriter:
+    """Append SampleBatches as JSON lines (reference:
+    rllib/offline/json_writer.py)."""
+
+    def __init__(self, path: str, max_file_size: int = 64 << 20):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._max = max_file_size
+        self._index = 0
+        self._file = None
+
+    def _rotate(self):
+        if self._file is not None:
+            self._file.close()
+        name = os.path.join(self.path, f"output-{self._index:05d}.json")
+        self._index += 1
+        self._file = open(name, "a")
+
+    def write(self, batch: SampleBatch) -> None:
+        if self._file is None or self._file.tell() > self._max:
+            self._rotate()
+        record = {k: _encode_array(np.asarray(v)) for k, v in batch.items()}
+        self._file.write(json.dumps(record) + "\n")
+        self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader:
+    """Iterate SampleBatches back from a JsonWriter directory (reference:
+    rllib/offline/json_reader.py)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".json"))
+        if not self._files:
+            raise ValueError(f"no .json experience files under {path!r}")
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        for fname in self._files:
+            with open(fname) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    record = json.loads(line)
+                    yield SampleBatch({k: _decode_array(v)
+                                       for k, v in record.items()})
+
+    def read_all(self) -> SampleBatch:
+        return SampleBatch.concat_samples(list(self))
+
+    def to_dataset(self):
+        """Experiences as a ray_tpu Dataset (reference:
+        offline/dataset_reader.py — offline data flows through Data)."""
+        from ray_tpu import data as rdata
+        rows: List[Dict[str, Any]] = []
+        for batch in self:
+            n = batch.count
+            for i in range(n):
+                rows.append({k: np.asarray(v[i]).tolist()
+                             for k, v in batch.items()})
+        return rdata.from_items(rows)
+
+
+class BCConfig:
+    """Behavior cloning config (reference: rllib/algorithms/bc)."""
+
+    def __init__(self):
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_epochs = 1
+        self.model_hidden = (64, 64)
+        self.seed = 0
+
+
+class BC:
+    """Behavior cloning: supervised max-likelihood on logged actions —
+    the offline-RL baseline (reference: bc.py; MARWIL with beta=0)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 config: Optional[BCConfig] = None):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.models import make_model
+
+        self.config = config or BCConfig()
+        cfg = self.config
+        init_params, self.apply = make_model(obs_dim, num_actions,
+                                             cfg.model_hidden)
+        self.params = init_params(jax.random.key(cfg.seed))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(cfg.seed)
+        apply = self.apply
+
+        def loss(params, obs, actions):
+            import jax.numpy as jnp
+            logits, _ = apply(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+            return nll.mean()
+
+        def step(params, opt_state, obs, actions):
+            l, grads = jax.value_and_grad(loss)(params, obs, actions)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, l
+
+        import jax as _jax
+        self._step = _jax.jit(step)
+
+    def train_on(self, batch: SampleBatch) -> Dict[str, float]:
+        """num_epochs of minibatch SGD over the logged experiences."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        obs = np.asarray(batch[SampleBatch.OBS], np.float32)
+        actions = np.asarray(batch[SampleBatch.ACTIONS])
+        if obs.ndim > 2:  # time-major fragments flatten to rows
+            obs = obs.reshape(-1, obs.shape[-1])
+            actions = actions.reshape(-1)
+        n = len(obs)
+        last = 0.0
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n, cfg.train_batch_size):
+                idx = perm[lo:lo + cfg.train_batch_size]
+                self.params, self.opt_state, last = self._step(
+                    self.params, self.opt_state,
+                    jnp.asarray(obs[idx]), jnp.asarray(actions[idx]))
+        return {"bc_loss": float(last), "samples": n}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+        logits, _ = self.apply(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.params)

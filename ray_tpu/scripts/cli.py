@@ -160,7 +160,10 @@ def cmd_serve(args) -> int:
     ray_tpu.init(address=args.address)
     try:
         if args.serve_cmd == "status":
-            print(jsonlib.dumps(serve.status(), indent=2))
+            try:
+                print(jsonlib.dumps(serve.status(), indent=2))
+            except ValueError:
+                print("serve is not running on this cluster")
             return 0
         if args.serve_cmd == "shutdown":
             serve.shutdown()
@@ -185,6 +188,10 @@ def cmd_serve(args) -> int:
             except jsonlib.JSONDecodeError:
                 import yaml
                 cfg = yaml.safe_load(text)
+            if not isinstance(cfg, dict):
+                print(f"invalid serve config {args.config!r}",
+                      file=sys.stderr)
+                return 2
             serve.start()
             for app_cfg in cfg.get("applications", []):
                 mod_name, _, attr = app_cfg["import_path"].partition(":")

@@ -53,6 +53,23 @@ class ClientWorker:
         self._release_buffer: list = []
         self.io.run(self._rpc.call(
             "RayClient", "Init", {"session": self._session}, timeout=30))
+        # Keepalive: idle-but-connected clients must not hit the server's
+        # session TTL (reference: client heartbeat); a cheap Init refresh
+        # every 60s keeps last_seen current.
+        import threading
+        self._stop_keepalive = threading.Event()
+
+        def _keepalive():
+            while not self._stop_keepalive.wait(60.0):
+                try:
+                    self.io.run(self._rpc.call(
+                        "RayClient", "Init",
+                        {"session": self._session}, timeout=30))
+                except Exception:
+                    pass
+
+        threading.Thread(target=_keepalive, daemon=True,
+                         name="raytpu-client-keepalive").start()
 
     # ---------------- helpers ----------------
 
@@ -205,6 +222,7 @@ class ClientWorker:
         return self._worker_call("list_placement_groups", *a, **kw)
 
     def shutdown(self):
+        self._stop_keepalive.set()
         try:
             self._call("Disconnect", {}, timeout=5)
         except Exception:

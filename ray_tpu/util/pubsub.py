@@ -62,13 +62,21 @@ class Subscriber:
         stop = stop_event or threading.Event()
 
         def loop():
+            import logging
+            log = logging.getLogger("ray_tpu.pubsub")
             while not stop.is_set():
                 try:
-                    for msg in self.poll(timeout_s=2.0):
-                        callback(msg)
+                    msgs = self.poll(timeout_s=2.0)
                 except Exception:
                     if stop.wait(1.0):
                         return
+                    continue
+                for msg in msgs:
+                    try:
+                        callback(msg)
+                    except Exception:  # one bad message must not drop
+                        log.exception("pubsub callback failed "
+                                      "(channel %s)", self.channel)
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"pubsub-{self.channel}")

@@ -295,3 +295,31 @@ def test_preprocessors(cluster):
          "label": np.array(["x", "y"])})
     assert batch["features"].shape == (2, 2)
     assert batch["features"][1, 0] == 1.0
+
+
+def test_dataset_pipeline_repeat_and_window(cluster):
+    """repeat(n).iter_epochs re-executes the plan per epoch (fresh
+    shuffles); window(k) bounds per-window blocks (reference:
+    dataset_pipeline.py)."""
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(64).repartition(8).random_shuffle()
+    pipe = ds.repeat(3)
+    orders = []
+    for epoch_ds in pipe.iter_epochs():
+        orders.append(tuple(r["id"] for r in epoch_ds.take_all()))
+    assert len(orders) == 3
+    assert all(sorted(o) == list(range(64)) for o in orders)
+    # Fresh executions -> epochs shuffle independently.
+    assert len(set(orders)) > 1
+
+    windows = list(rdata.range(64).repartition(8)
+                   .window(blocks_per_window=2).iter_windows())
+    assert len(windows) == 4
+    total = sum(w.count() for w in windows)
+    assert total == 64
+
+    # Batch streaming across epochs.
+    n = sum(len(b["id"]) for b in
+            rdata.range(10).repeat(2).iter_batches(batch_size=4))
+    assert n == 20
