@@ -209,7 +209,28 @@ class EventLoopThread:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self):
-        self.loop.call_soon_threadsafe(self.loop.stop)
+        async def _drain_and_stop():
+            # Cancel housekeeping tasks (lease reapers, flushers) and let
+            # the cancellations finish, so the loop drains clean instead
+            # of warning 'Task was destroyed but it is pending' at exit.
+            # Bounded: a task stuck in an executor call must not keep the
+            # loop alive forever.
+            me = asyncio.current_task()
+            tasks = [t for t in asyncio.all_tasks(self.loop) if t is not me]
+            for t in tasks:
+                t.cancel()
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*tasks, return_exceptions=True), 3.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                pass
+            self.loop.stop()
+
+        try:
+            self.loop.call_soon_threadsafe(
+                lambda: asyncio.ensure_future(_drain_and_stop()))
+        except RuntimeError:
+            pass
         self._thread.join(timeout=5)
         if not self.loop.is_running():
             self.loop.close()

@@ -172,13 +172,14 @@ def _start_log_echo(worker):
                 {"after_seq": after, "job_id": job}, timeout=10)
             try:
                 reply = worker.io.run(coro, timeout=15)
+            except RuntimeError:
+                # Loop gone before scheduling: the coroutine never ran —
+                # closing is safe and silences the never-awaited warning.
+                coro.close()
+                continue
             except Exception:
-                try:
-                    # Only safe when the coroutine never started (loop
-                    # gone); a scheduled one raises ValueError — ignore.
-                    coro.close()
-                except Exception:
-                    pass
+                # Scheduled but failed/timed out: the loop owns the
+                # coroutine — closing from this thread would race it.
                 continue
             # Advance past EVERYTHING the GCS scanned (global seq), not
             # just this job's lines, or quiet jobs rescan the whole ring.

@@ -707,20 +707,34 @@ class DatasetPipeline:
             raise ValueError("epoch pipelines iterate epochs")
         # window() applies at its position in the chain (reference
         # semantics): stages BEFORE it (e.g. repartition) run first, so
-        # the window size is in OUTPUT blocks; stages added to the
-        # per-window datasets afterwards stream window by window.
+        # the window size is in OUTPUT blocks.  Consequence: upstream
+        # stages materialize in full — for bounded memory put window()
+        # directly after the source and map over the windows.
         refs = (self._dataset._execute() if self._dataset._plan.stages
                 else list(self._dataset._plan.input_refs))
         k = max(1, self._blocks_per_window)
         for lo in range(0, len(refs), k):
             yield Dataset(ExecPlan(refs[lo:lo + k]))
 
-    def iter_batches(self, **kwargs) -> Iterator[Any]:
-        """Stream batches across all epochs/windows."""
-        parts = (self.iter_windows() if self._blocks_per_window is not None
-                 else self.iter_epochs())
-        for ds in parts:
-            yield from ds.iter_batches(**kwargs)
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 4, **_) -> Iterator[Any]:
+        """Stream batches.  Windowed pipelines batch across window
+        boundaries (steady shapes for fixed-global-batch training);
+        epochs batch independently (an epoch is a semantic boundary)."""
+        if self._blocks_per_window is not None:
+            refs = []
+            for ds in self.iter_windows():
+                refs.extend(ds._plan.input_refs)
+            yield from _batches_from_refs(refs, batch_size, batch_format,
+                                          drop_last, prefetch_blocks)
+            return
+        for ds in self.iter_epochs():
+            yield from ds.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format,
+                                       drop_last=drop_last,
+                                       prefetch_blocks=prefetch_blocks)
 
     def iter_rows(self) -> Iterator[Any]:
         parts = (self.iter_windows() if self._blocks_per_window is not None

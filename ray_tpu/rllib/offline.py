@@ -145,8 +145,7 @@ class BC:
             params = optax.apply_updates(params, updates)
             return params, opt_state, l
 
-        import jax as _jax
-        self._step = _jax.jit(step)
+        self._step = jax.jit(step)
 
     def train_on(self, batch: SampleBatch) -> Dict[str, float]:
         """num_epochs of minibatch SGD over the logged experiences."""
@@ -170,7 +169,6 @@ class BC:
         return {"bc_loss": float(last), "samples": n}
 
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
-        import jax
         import jax.numpy as jnp
         logits, _ = self.apply(self.params, jnp.asarray(obs, jnp.float32))
         return np.asarray(jnp.argmax(logits, axis=-1))

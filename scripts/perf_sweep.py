@@ -269,6 +269,48 @@ def exp_fwdtrunk():
 
 EXPS["fwdtrunk"] = exp_fwdtrunk
 
+def exp_fusedqkv():
+    """QKV as ONE [d, 3*d] matmul instead of three einsums."""
+    import jax
+    import ray_tpu.models.gpt as G
+    orig_block = G._block
+
+    def fused_block(x, p, config, mesh):
+        c = config
+        h = G._layernorm(x, p["ln1_scale"], p["ln1_bias"])
+        wqkv = jnp.concatenate(
+            [p["wq"].reshape(c.d_model, -1),
+             p["wk"].reshape(c.d_model, -1),
+             p["wv"].reshape(c.d_model, -1)], axis=-1).astype(h.dtype)
+        qkv = jnp.einsum("bld,de->ble", h, wqkv)
+        d3 = c.n_heads * c.head_dim
+        q = qkv[..., :d3].reshape(*qkv.shape[:2], c.n_heads, c.head_dim)
+        k = qkv[..., d3:2*d3].reshape(*qkv.shape[:2], c.n_heads, c.head_dim)
+        v = qkv[..., 2*d3:].reshape(*qkv.shape[:2], c.n_heads, c.head_dim)
+        attn = G.flash_attention(q, k, v, causal=True)
+        attn_out = jnp.einsum("blhk,hkd->bld", attn,
+                              p["wo"].astype(h.dtype))
+        x = x + attn_out
+        h2 = G._layernorm(x, p["ln2_scale"], p["ln2_bias"])
+        hidden = jax.nn.gelu(
+            jnp.einsum("bld,df->blf", h2, p["w_up"].astype(h2.dtype)))
+        mlp_out = jnp.einsum("blf,fd->bld", hidden,
+                             p["w_down"].astype(h2.dtype))
+        x = x + mlp_out
+        return x, jnp.zeros((), jnp.float32)
+
+    G._block = fused_block
+    try:
+        cfg, state, tokens, train_step = base()
+        step = jax.jit(train_step, donate_argnums=0)
+        tps, ms = time_step(step, state, tokens)
+        print(f"fusedqkv b8: {tps:,.0f} tok/s  {ms*1e3:.1f} ms/step")
+    finally:
+        G._block = orig_block
+
+
+EXPS["fusedqkv"] = exp_fusedqkv
+
 
 
 if __name__ == "__main__":
