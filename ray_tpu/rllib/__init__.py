@@ -13,6 +13,7 @@ from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.env import (  # noqa: F401
     CartPoleVector,
     Env,
+    PendulumVector,
     VectorEnv,
     make_vector_env,
     register_env,
@@ -39,6 +40,7 @@ __all__ = [
     "PrioritizedReplayBuffer", "ReplayBuffer",
     "Algorithm", "AlgorithmConfig", "CartPoleVector", "Env", "VectorEnv",
     "IMPALA", "IMPALAConfig", "JaxLearner", "JaxPolicy", "LearnerThread",
-    "PPO", "PPOConfig", "RolloutWorker", "SampleBatch", "WorkerSet",
+    "PPO", "PPOConfig", "PendulumVector", "RolloutWorker", "SampleBatch",
+    "WorkerSet",
     "compute_gae", "make_vector_env", "ppo_loss", "register_env", "vtrace",
 ]

@@ -97,6 +97,8 @@ class Algorithm:
         probe = make_vector_env(config.env, 1, seed=config.seed)
         self.obs_dim = probe.observation_dim
         self.num_actions = probe.num_actions
+        self.action_dim = getattr(probe, "action_dim", 0)
+        self.continuous = self.num_actions == 0 and self.action_dim > 0
         self.iteration = 0
         self.total_env_steps = 0
         self._episode_returns: collections.deque = collections.deque(

@@ -22,11 +22,15 @@ class Env:
     """Minimal gymnasium-style environment protocol.
 
     reset(seed) -> (obs, info); step(a) -> (obs, reward, terminated,
-    truncated, info).
+    truncated, info).  Discrete envs declare num_actions; continuous envs
+    declare action_dim (+ action_low/high) and set num_actions = 0.
     """
 
     observation_dim: int
-    num_actions: int
+    num_actions: int = 0          # discrete action count (0 = continuous)
+    action_dim: int = 0           # continuous action dimension
+    action_low: float = -1.0
+    action_high: float = 1.0
 
     def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, dict]:
         raise NotImplementedError
@@ -153,8 +157,73 @@ class CartPoleVector(VectorEnv):
         return (self._state.astype(np.float32), rewards, terminated, truncated)
 
 
+class PendulumVector(VectorEnv):
+    """Vectorized Pendulum-v1 (classic continuous control: swing-up with
+    bounded torque; standard published dynamics/reward).  Episodes
+    truncate at 200 steps; reward = -(theta^2 + 0.1*thetadot^2 +
+    0.001*torque^2)."""
+
+    observation_dim = 3
+    num_actions = 0
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        super().__init__(num_envs)
+        self._rng = np.random.default_rng(seed)
+        self._theta = np.zeros(num_envs)
+        self._thetadot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._thetadot], axis=1).astype(np.float32)
+
+    def reset_all(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._theta = self._rng.uniform(-np.pi, np.pi, self.num_envs)
+        self._thetadot = self._rng.uniform(-1.0, 1.0, self.num_envs)
+        self._steps[:] = 0
+        self._ep_return[:] = 0.0
+        self._ep_len[:] = 0
+        return self._obs()
+
+    def step_batch(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th, thdot = self._theta, self._thetadot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        costs = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        newthdot = thdot + (3 * self.G / (2 * self.L) * np.sin(th)
+                            + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        newthdot = np.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = th + newthdot * self.DT
+        self._thetadot = newthdot
+        self._steps += 1
+        truncated = self._steps >= self.MAX_STEPS
+        terminated = np.zeros(self.num_envs, bool)
+        if truncated.any():
+            n = int(truncated.sum())
+            self._theta[truncated] = self._rng.uniform(-np.pi, np.pi, n)
+            self._thetadot[truncated] = self._rng.uniform(-1.0, 1.0, n)
+            self._steps[truncated] = 0
+        return (self._obs(), (-costs).astype(np.float32), terminated,
+                truncated)
+
+
 _ENV_REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
     "CartPole-v1": CartPoleVector,
+    "Pendulum-v1": PendulumVector,
 }
 
 

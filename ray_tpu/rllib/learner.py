@@ -20,7 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.models import make_model
+from ray_tpu.rllib.models import (
+    gaussian_logp,
+    make_continuous_model,
+    make_model,
+)
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
@@ -34,9 +38,14 @@ class JaxLearner:
     def __init__(self, obs_dim: int, num_actions: int, *,
                  loss_fn: Callable, config: Dict[str, Any],
                  hidden=(64, 64), seed: int = 0,
-                 mesh: Optional[Any] = None):
+                 mesh: Optional[Any] = None, action_dim: int = 0):
         self.config = config
-        init_params, self.apply = make_model(obs_dim, num_actions, hidden)
+        if num_actions == 0 and action_dim > 0:
+            init_params, self.apply = make_continuous_model(
+                obs_dim, action_dim, hidden)
+        else:
+            init_params, self.apply = make_model(obs_dim, num_actions,
+                                                 hidden)
         self.params = init_params(jax.random.key(seed))
         lr = config.get("lr", 3e-4)
         sched = lr
@@ -124,28 +133,40 @@ def policy_terms(apply, params, mb):
     return values, logp, adv, entropy
 
 
-def ppo_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
-    """Clipped-surrogate PPO loss.  Reference behavior:
-    rllib/algorithms/ppo/ppo_torch_policy.py (loss)."""
+def _ppo_surrogate(mb, cfg, values, logp, entropy):
+    """Shared clipped-surrogate + clamped-vf assembly used by the discrete
+    and Gaussian PPO losses (reference semantics: ppo_torch_policy.py —
+    SQUARED vf error clamped at vf_clip_param, zero-gradding outliers)."""
     clip = cfg.get("clip_param", 0.2)
     vf_clip = cfg.get("vf_clip_param", 100.0)
     vf_coeff = cfg.get("vf_loss_coeff", 0.5)
     ent_coeff = cfg.get("entropy_coeff", 0.0)
 
-    values, logp, adv, entropy = policy_terms(apply, params, mb)
-
+    adv = mb[SampleBatch.ADVANTAGES]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
     ratio = jnp.exp(logp - mb[SampleBatch.ACTION_LOGP])
     surr = jnp.minimum(ratio * adv,
                        jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
     policy_loss = -surr.mean()
-
-    targets = mb[SampleBatch.VALUE_TARGETS]
-    # Reference semantics (ppo_torch_policy.py loss): the SQUARED error is
-    # clamped at vf_clip_param, zero-gradding value outliers.
-    vf_err = jnp.minimum((values - targets) ** 2, vf_clip)
-    vf_loss = vf_err.mean()
-
+    vf_loss = jnp.minimum(
+        (values - mb[SampleBatch.VALUE_TARGETS]) ** 2, vf_clip).mean()
     total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
-    kl = (mb[SampleBatch.ACTION_LOGP] - logp).mean()
     return total, {"total_loss": total, "policy_loss": policy_loss,
-                   "vf_loss": vf_loss, "entropy": entropy, "kl": kl}
+                   "vf_loss": vf_loss, "entropy": entropy,
+                   "kl": (mb[SampleBatch.ACTION_LOGP] - logp).mean()}
+
+
+def ppo_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
+    """Clipped-surrogate PPO loss (categorical actions)."""
+    values, logp, _adv, entropy = policy_terms(apply, params, mb)
+    return _ppo_surrogate(mb, cfg, values, logp, entropy)
+
+
+def ppo_loss_continuous(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
+    """Clipped-surrogate PPO for diagonal-Gaussian policies (reference:
+    ppo loss over DiagGaussian action dists)."""
+    mean, log_std, values = apply(params, mb[SampleBatch.OBS])
+    logp = gaussian_logp(mean, log_std, mb[SampleBatch.ACTIONS])
+    # Diagonal-Gaussian entropy: 0.5*log(2*pi*e) + log_std per dim.
+    entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
+    return _ppo_surrogate(mb, cfg, values, logp, entropy)

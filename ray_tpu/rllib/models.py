@@ -45,3 +45,48 @@ def make_model(obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64))
         return model.init(rng, dummy)
 
     return init_params, model.apply
+
+
+class GaussianActorCritic(nn.Module):
+    """Diagonal-Gaussian policy for continuous control: tanh MLP trunk ->
+    action mean, a state-independent learned log_std, and a separate value
+    trunk (reference: rllib fcnet w/ free_log_std for continuous spaces)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray):
+        ortho = nn.initializers.orthogonal
+        x = obs
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h, kernel_init=ortho(np.sqrt(2)))(x))
+        mean = nn.Dense(self.action_dim, kernel_init=ortho(0.01))(x)
+        log_std = self.param("log_std", nn.initializers.zeros,
+                             (self.action_dim,))
+
+        v = obs
+        for h in self.hidden:
+            v = nn.tanh(nn.Dense(h, kernel_init=ortho(np.sqrt(2)))(v))
+        value = nn.Dense(1, kernel_init=ortho(1.0))(v)
+        return mean, log_std, jnp.squeeze(value, axis=-1)
+
+
+def make_continuous_model(obs_dim: int, action_dim: int,
+                          hidden: Sequence[int] = (64, 64)):
+    """(init_params(rng), apply(params, obs) -> (mean, log_std, value))."""
+    model = GaussianActorCritic(action_dim=action_dim, hidden=tuple(hidden))
+
+    def init_params(rng: jax.Array):
+        dummy = jnp.zeros((1, obs_dim), jnp.float32)
+        return model.init(rng, dummy)
+
+    return init_params, model.apply
+
+
+def gaussian_logp(mean, log_std, actions):
+    """Diagonal-Gaussian log prob, summed over action dims."""
+    var = jnp.exp(2 * log_std)
+    return jnp.sum(
+        -0.5 * ((actions - mean) ** 2 / var)
+        - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)

@@ -15,7 +15,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.rllib.models import make_model
+from ray_tpu.rllib.models import (
+    gaussian_logp,
+    make_continuous_model,
+    make_model,
+)
 
 
 class JaxPolicy:
@@ -29,24 +33,47 @@ class JaxPolicy:
 
     def __init__(self, obs_dim: int, num_actions: int,
                  hidden: Sequence[int] = (64, 64), seed: int = 0,
-                 force_cpu: bool = True):
+                 force_cpu: bool = True, action_dim: int = 0,
+                 action_low: float = -1.0, action_high: float = 1.0):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
+        self.continuous = num_actions == 0 and action_dim > 0
+        self.action_dim = action_dim
         self._device = None
         if force_cpu and jax.default_backend() != "cpu":
             self._device = jax.local_devices(backend="cpu")[0]
-        init_params, self.apply = make_model(obs_dim, num_actions, hidden)
+        if self.continuous:
+            init_params, self.apply = make_continuous_model(
+                obs_dim, action_dim, hidden)
 
-        def _sample(params, obs, rng):
-            logits, value = self.apply(params, obs)
-            action = jax.random.categorical(rng, logits)
-            logp = jax.nn.log_softmax(logits)[
-                jnp.arange(action.shape[0]), action]
-            return action, logp, value, logits
+            def _sample(params, obs, rng):
+                mean, log_std, value = self.apply(params, obs)
+                noise = jax.random.normal(rng, mean.shape)
+                action = mean + jnp.exp(log_std) * noise
+                logp = gaussian_logp(mean, log_std, action)
+                # Return the UNCLIPPED sample: the stored action and its
+                # logp must describe the same point or the PPO ratio is
+                # biased at the bounds; the env clips at step time.
+                return action, logp, value, mean
 
-        def _greedy(params, obs):
-            logits, value = self.apply(params, obs)
-            return jnp.argmax(logits, axis=-1), value, logits
+            def _greedy(params, obs):
+                mean, _log_std, value = self.apply(params, obs)
+                return (jnp.clip(mean, action_low, action_high),
+                        value, mean)
+        else:
+            init_params, self.apply = make_model(obs_dim, num_actions,
+                                                 hidden)
+
+            def _sample(params, obs, rng):
+                logits, value = self.apply(params, obs)
+                action = jax.random.categorical(rng, logits)
+                logp = jax.nn.log_softmax(logits)[
+                    jnp.arange(action.shape[0]), action]
+                return action, logp, value, logits
+
+            def _greedy(params, obs):
+                logits, value = self.apply(params, obs)
+                return jnp.argmax(logits, axis=-1), value, logits
 
         with self._ctx():
             self.params = init_params(jax.random.key(seed))

@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.learner import JaxLearner, ppo_loss
+from ray_tpu.rllib.learner import JaxLearner, ppo_loss, ppo_loss_continuous
 from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
@@ -49,7 +49,8 @@ class PPO(Algorithm):
         without re-running worker construction or double weight syncs)."""
         cfg = self.config
         return JaxLearner(
-            self.obs_dim, self.num_actions, loss_fn=ppo_loss,
+            self.obs_dim, self.num_actions, action_dim=self.action_dim,
+            loss_fn=(ppo_loss_continuous if self.continuous else ppo_loss),
             config={
                 "lr": cfg.lr, "grad_clip": cfg.grad_clip,
                 "num_sgd_iter": cfg.num_sgd_iter,

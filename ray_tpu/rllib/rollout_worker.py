@@ -60,8 +60,22 @@ class RolloutWorker:
         self.fragment_length = rollout_fragment_length
         self.gamma, self.lam = gamma, lam
         self.postprocess = postprocess
-        self.policy = JaxPolicy(self.env.observation_dim,
-                                self.env.num_actions, hidden, seed=seed)
+        action_dim = getattr(self.env, "action_dim", 0)
+        num_actions = getattr(self.env, "num_actions", 0)
+        self.continuous = num_actions == 0 and action_dim > 0
+        if num_actions == 0 and action_dim == 0:
+            raise ValueError(
+                f"env {env!r} must declare num_actions (discrete) or "
+                f"action_dim (continuous)")
+        if epsilon_schedule is not None and self.continuous:
+            raise ValueError(
+                "epsilon-greedy exploration requires a discrete env")
+        self.policy = JaxPolicy(
+            self.env.observation_dim, self.env.num_actions, hidden,
+            seed=seed,
+            action_dim=getattr(self.env, "action_dim", 0),
+            action_low=getattr(self.env, "action_low", -1.0),
+            action_high=getattr(self.env, "action_high", 1.0))
         self.obs = self.env.reset_all(seed)
         self._total_steps = 0
         # Epsilon-greedy exploration for value-based algorithms
@@ -88,13 +102,18 @@ class RolloutWorker:
         """
         T, B = self.fragment_length, self.num_envs
         obs_buf = np.empty((T, B, self.env.observation_dim), np.float32)
-        act_buf = np.empty((T, B), np.int32)
+        if self.continuous:
+            adim = self.env.action_dim
+            act_buf = np.empty((T, B, adim), np.float32)
+            logits_buf = np.empty((T, B, adim), np.float32)  # means
+        else:
+            act_buf = np.empty((T, B), np.int32)
+            logits_buf = np.empty((T, B, self.env.num_actions), np.float32)
         rew_buf = np.empty((T, B), np.float32)
         term_buf = np.empty((T, B), np.bool_)
         trunc_buf = np.empty((T, B), np.bool_)
         logp_buf = np.empty((T, B), np.float32)
         vf_buf = np.empty((T, B), np.float32)
-        logits_buf = np.empty((T, B, self.env.num_actions), np.float32)
 
         obs = self.obs
         for t in range(T):
