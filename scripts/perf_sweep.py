@@ -311,6 +311,12 @@ def exp_fusedqkv():
 
 EXPS["fusedqkv"] = exp_fusedqkv
 
+def exp_batch24():
+    exp_batch(24)
+
+
+EXPS["batch24"] = exp_batch24
+
 
 
 if __name__ == "__main__":
