@@ -54,3 +54,44 @@ def test_chunked_cross_node_transfer():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_lineage_reconstruction_after_node_death():
+    """Chaos: the node holding a task's (store-resident) result dies; the
+    owner re-executes the producing task from lineage and get() succeeds
+    (reference: object_recovery_manager.h:41 + NodeKillerActor chaos
+    tests)."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1,
+                                      "object_store_memory": 96 << 20})
+    try:
+        victim = cluster.add_node(num_cpus=2,
+                                  object_store_memory=96 << 20)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=2, max_retries=3)
+        def produce(tag):
+            import os
+            return np.full(1 << 20, 7, np.uint8), os.getpid()
+
+        # num_cpus=2 only fits the victim node: the result lives in ITS
+        # store (1MB > inline limit).
+        ref = produce.remote("x")
+        ray_tpu.wait([ref], num_returns=1, timeout=60, fetch_local=False)
+
+        cluster.remove_node(victim)  # hard kill: store contents gone
+
+        # A fresh 2-CPU node lets the reconstructed task schedule.
+        cluster.add_node(num_cpus=2, object_store_memory=96 << 20)
+        cluster.wait_for_nodes()
+
+        arr, pid = ray_tpu.get(ref, timeout=120)
+        assert arr.shape == (1 << 20,) and int(arr[0]) == 7
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
