@@ -42,6 +42,11 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
          log_to_driver: bool = True, _system_config=None):
     """Connect to (or bootstrap) a cluster.  Reference: worker.py ray.init:1108."""
     global _worker, _cluster
+    if address is None:
+        # Reference parity: RAY_ADDRESS lets submitted job drivers join the
+        # cluster that launched them (job_manager.py sets it on entrypoints).
+        import os as _os0
+        address = _os0.environ.get("RAY_TPU_ADDRESS") or None
     with _global_lock:
         if _worker is not None:
             if ignore_reinit_error:

@@ -1,0 +1,14 @@
+"""Dashboard + job submission layer.
+
+Reference parity: dashboard/ (head + http_server_head.py REST API) and
+dashboard/modules/job/ (job_manager.py JobManager:490 / JobSupervisor:136,
+REST job_head.py, SDK sdk.py, CLI cli.py).  The TPU build keeps the same
+split: a head process serving REST + a static UI over the state API, and a
+job manager that runs each submitted entrypoint under a detached supervisor
+actor on the cluster.
+"""
+
+from ray_tpu.dashboard.job_manager import JobManager, JobStatus
+from ray_tpu.dashboard.sdk import JobSubmissionClient
+
+__all__ = ["JobManager", "JobStatus", "JobSubmissionClient"]

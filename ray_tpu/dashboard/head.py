@@ -1,0 +1,280 @@
+"""Dashboard head: REST API + web UI over the state API and job manager.
+
+Reference parity: dashboard/head.py + http_server_head.py (aiohttp REST
+routes over the state aggregator) and dashboard/modules/job/job_head.py
+(the /api/jobs/ REST surface the job SDK/CLI talks to).  The reference
+ships a React client; here a single embedded page polls the same JSON
+endpoints — the API surface, not the pixels, is the parity target.
+
+Run: python -m ray_tpu.dashboard.head --address GCS_ADDR --port 8265
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import json
+import logging
+from typing import Optional
+
+logger = logging.getLogger("ray_tpu.dashboard")
+
+DEFAULT_PORT = 8265
+
+
+def _json(data, status: int = 200):
+    from aiohttp import web
+    return web.Response(text=json.dumps(data, default=str),
+                        content_type="application/json", status=status)
+
+
+class DashboardHead:
+    """Serves /api/* (cluster state + jobs) and the UI page.
+
+    Blocking state-API calls run in a thread executor so the aiohttp loop
+    stays responsive (same split as the client server's handler pool).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        from concurrent.futures import ThreadPoolExecutor
+        from ray_tpu.dashboard.job_manager import JobManager
+        self.host = host
+        self.port = port
+        self.bound_port: Optional[int] = None
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="dash")
+        self._jobs = JobManager()
+        self._runner = None
+
+    async def _call(self, fn, *args, **kwargs):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._pool, functools.partial(fn, *args, **kwargs))
+
+    # ---- state endpoints ----
+
+    async def _h_state(self, fn, request):
+        try:
+            return _json({"result": await self._call(fn)})
+        except Exception as e:
+            return _json({"error": repr(e)}, status=500)
+
+    async def _h_overview(self, request):
+        from ray_tpu import state
+        try:
+            summary = await self._call(state.summarize_cluster)
+            jobs = await self._call(self._jobs.list_jobs)
+            return _json({"result": {"cluster": summary, "jobs": jobs}})
+        except Exception as e:
+            return _json({"error": repr(e)}, status=500)
+
+    # ---- job endpoints (reference: job_head.py REST surface) ----
+
+    async def _h_jobs_list(self, request):
+        try:
+            return _json({"result": await self._call(self._jobs.list_jobs)})
+        except Exception as e:
+            return _json({"error": repr(e)}, status=500)
+
+    async def _h_jobs_submit(self, request):
+        try:
+            body = await request.json()
+            entrypoint = body["entrypoint"]
+        except Exception as e:
+            return _json({"error": f"bad request: {e!r}"}, status=400)
+        try:
+            sub_id = await self._call(
+                self._jobs.submit_job, entrypoint,
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+                submission_id=body.get("submission_id"))
+            return _json({"result": {"submission_id": sub_id}})
+        except ValueError as e:
+            return _json({"error": str(e)}, status=400)
+        except Exception as e:
+            return _json({"error": repr(e)}, status=500)
+
+    async def _h_job_status(self, request):
+        sub_id = request.match_info["sub_id"]
+        try:
+            rec = await self._call(self._jobs.get_job_status, sub_id)
+        except Exception as e:
+            return _json({"error": repr(e)}, status=500)
+        if rec is None:
+            return _json({"error": f"no job {sub_id}"}, status=404)
+        return _json({"result": rec})
+
+    async def _h_job_logs(self, request):
+        from aiohttp import web
+        sub_id = request.match_info["sub_id"]
+        try:
+            text = await self._call(self._jobs.get_job_logs, sub_id)
+        except KeyError:
+            return _json({"error": f"no job {sub_id}"}, status=404)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def _h_job_stop(self, request):
+        sub_id = request.match_info["sub_id"]
+        try:
+            stopped = await self._call(self._jobs.stop_job, sub_id)
+            return _json({"result": {"stopped": stopped}})
+        except KeyError:
+            return _json({"error": f"no job {sub_id}"}, status=404)
+
+    async def _h_job_delete(self, request):
+        sub_id = request.match_info["sub_id"]
+        try:
+            deleted = await self._call(self._jobs.delete_job, sub_id)
+            return _json({"result": {"deleted": deleted}})
+        except RuntimeError as e:
+            return _json({"error": str(e)}, status=400)
+        except Exception as e:
+            return _json({"error": repr(e)}, status=500)
+
+    async def _h_index(self, request):
+        from aiohttp import web
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    async def _h_metrics(self, request):
+        from aiohttp import web
+        from ray_tpu import state
+        try:
+            text = await self._call(state.prometheus_metrics)
+        except Exception as e:
+            return _json({"error": repr(e)}, status=500)
+        return web.Response(text=text, content_type="text/plain")
+
+    # ---- lifecycle ----
+
+    async def start(self) -> int:
+        from aiohttp import web
+        from ray_tpu import state
+        app = web.Application()
+        st = [
+            ("nodes", state.list_nodes), ("actors", state.list_actors),
+            ("placement_groups", state.list_placement_groups),
+            ("workers", state.list_workers), ("objects", state.list_objects),
+            ("tasks", state.list_tasks), ("timeline", state.timeline),
+            ("cluster_metrics", state.cluster_metrics),
+        ]
+        for name, fn in st:
+            app.router.add_get(f"/api/{name}",
+                               functools.partial(self._h_state, fn))
+        app.router.add_get("/api/overview", self._h_overview)
+        app.router.add_get("/api/jobs", self._h_jobs_list)
+        app.router.add_post("/api/jobs", self._h_jobs_submit)
+        app.router.add_get("/api/jobs/{sub_id}", self._h_job_status)
+        app.router.add_get("/api/jobs/{sub_id}/logs", self._h_job_logs)
+        app.router.add_post("/api/jobs/{sub_id}/stop", self._h_job_stop)
+        app.router.add_delete("/api/jobs/{sub_id}", self._h_job_delete)
+        app.router.add_get("/metrics", self._h_metrics)
+        app.router.add_get("/", self._h_index)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.bound_port = self._runner.addresses[0][1]
+        return self.bound_port
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1a1a2e}
+ header{background:#1a1a2e;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:baseline}
+ header h1{font-size:16px;margin:0}
+ header span{font-size:12px;opacity:.7}
+ main{padding:16px 20px;max-width:1200px}
+ .cards{display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px}
+ .card{background:#fff;border:1px solid #e3e5ea;border-radius:8px;padding:10px 16px;min-width:110px}
+ .card b{display:block;font-size:22px}
+ .card small{color:#667}
+ h2{font-size:14px;margin:18px 0 6px}
+ table{border-collapse:collapse;width:100%;background:#fff;border:1px solid #e3e5ea;border-radius:8px;font-size:12px}
+ th,td{text-align:left;padding:5px 10px;border-bottom:1px solid #eef0f3;font-variant-numeric:tabular-nums}
+ th{background:#fafbfc;color:#556}
+ .ok{color:#0a7d33}.bad{color:#c0392b}
+</style></head><body>
+<header><h1>ray_tpu dashboard</h1><span id="ts"></span></header>
+<main>
+ <div class="cards" id="cards"></div>
+ <h2>Nodes</h2><table id="nodes"></table>
+ <h2>Jobs</h2><table id="jobs"></table>
+ <h2>Actors</h2><table id="actors"></table>
+ <h2>Placement groups</h2><table id="pgs"></table>
+</main>
+<script>
+async function j(u){const r=await fetch(u);const d=await r.json();
+  if(d.error)throw new Error(d.error);return d.result}
+function esc(v){return String(v).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+// values are user-controlled (entrypoints, actor names) — escape them;
+// cells that need markup (status dots) pass {html:...} explicitly.
+function cell(v){return (v&&v.html!==undefined)?v.html:esc(v)}
+function tab(el,cols,rows){el.innerHTML='<tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')
+  +'</tr>'+rows.map(r=>'<tr>'+r.map(v=>'<td>'+cell(v)+'</td>').join('')+'</tr>').join('')}
+function card(label,val){return '<div class="card"><b>'+esc(val)+'</b><small>'+esc(label)+'</small></div>'}
+async function tick(){
+ try{
+  const [nodes,actors,pgs,jobs]=await Promise.all([
+    j('/api/nodes'),j('/api/actors'),j('/api/placement_groups'),j('/api/jobs')]);
+  document.getElementById('cards').innerHTML=
+    card('nodes',nodes.filter(n=>n.alive).length+'/'+nodes.length)
+    +card('actors',actors.filter(a=>a.state=='ALIVE').length)
+    +card('placement groups',pgs.length)
+    +card('jobs running',jobs.filter(x=>x.status=='RUNNING').length)
+    +card('jobs total',jobs.length);
+  tab(document.getElementById('nodes'),['node','address','alive','head','resources'],
+    nodes.map(n=>[n.node_id.slice(0,12),n.address,
+      n.alive?{html:'<span class=ok>yes</span>'}:{html:'<span class=bad>no</span>'},
+      n.is_head?'yes':'',JSON.stringify(n.resources_available)]));
+  tab(document.getElementById('jobs'),['id','status','entrypoint','message'],
+    jobs.map(x=>[x.submission_id,x.status,(x.entrypoint||'').slice(0,80),x.message||'']));
+  tab(document.getElementById('actors'),['actor','class','state','name','node'],
+    actors.slice(0,200).map(a=>[a.actor_id.slice(0,12),a.class_name,a.state,
+      a.name||'',(a.node_id||'').slice(0,12)]));
+  tab(document.getElementById('pgs'),['pg','state','strategy','bundles'],
+    pgs.map(p=>[p.placement_group_id.slice(0,12),p.state,p.strategy,
+      JSON.stringify(p.bundles)]));
+  document.getElementById('ts').textContent='updated '+new Date().toLocaleTimeString();
+ }catch(e){document.getElementById('ts').textContent='error: '+e.message}
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    import ray_tpu
+
+    parser = argparse.ArgumentParser(prog="ray_tpu-dashboard")
+    parser.add_argument("--address", required=True, help="GCS address")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    ray_tpu.init(address=args.address, log_to_driver=False)
+    head = DashboardHead(host=args.host, port=args.port)
+    loop = asyncio.new_event_loop()
+    port = loop.run_until_complete(head.start())
+    print(f"dashboard listening on {args.host}:{port}", flush=True)
+    try:
+        loop.run_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        loop.run_until_complete(head.stop())
+        ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,353 @@
+"""Job submission: run shell entrypoints as supervised cluster jobs.
+
+Reference parity: dashboard/modules/job/job_manager.py (JobManager:490
+submit_job/stop_job/get_job_status, JobSupervisor:136 — a detached actor
+that runs the entrypoint as a subprocess, streams its logs, and records a
+terminal JobStatus) and common.py (JobStatus lifecycle PENDING -> RUNNING
+-> SUCCEEDED/FAILED/STOPPED).
+
+Differences from the reference, driven by the TPU runtime's shape:
+- Job records and final logs live in GCS KV (ns "job_sub" / "job_logs")
+  instead of head-node files, so any driver/REST head can read them even
+  when the supervisor ran on another host.
+- The supervisor self-exits after persisting terminal state; readers fall
+  back from the actor call to the KV record when it is gone.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_KV_NS = "job_sub"
+_LOG_NS = "job_logs"
+_LOG_CAP = 4 << 20          # keep the tail of very chatty jobs
+_SUPERVISOR_PREFIX = "_job_supervisor:"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+def _kv_call(method: str, req: dict):
+    from ray_tpu import api
+    w = api._worker
+    return w.io.run(w.gcs.call("Kv", method, req))
+
+
+def _kv_put(ns: str, key: str, value: bytes) -> None:
+    _kv_call("kv_put", {"ns": ns, "key": key, "value": value,
+                        "overwrite": True})
+
+
+def _kv_get(ns: str, key: str) -> Optional[bytes]:
+    reply = _kv_call("kv_get", {"ns": ns, "key": key})
+    return reply.get("value")
+
+
+def _put_record(rec: Dict[str, Any]) -> None:
+    import cloudpickle
+    _kv_put(_KV_NS, rec["submission_id"], cloudpickle.dumps(rec))
+
+
+def _get_record(submission_id: str) -> Optional[Dict[str, Any]]:
+    import pickle
+    blob = _kv_get(_KV_NS, submission_id)
+    return pickle.loads(blob) if blob is not None else None
+
+
+class JobSupervisor:
+    """Detached actor hosting one job's entrypoint subprocess.
+
+    Runs with the job's runtime_env (so working_dir/env_vars apply to the
+    subprocess through plain inheritance), mirrors the reference's
+    JobSupervisor.run (job_manager.py:214): spawn with a process group,
+    drain output, write terminal status.
+    """
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 metadata: Dict[str, str], gcs_address: str):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.metadata = metadata
+        self.gcs_address = gcs_address
+        self.proc = None
+        self.lines: List[bytes] = []
+        self.nbytes = 0
+        self.stop_requested = False
+        self.done = False
+
+    def start(self) -> str:
+        import subprocess
+        import threading
+
+        env = dict(os.environ)
+        # The entrypoint's ray_tpu.init() joins this cluster (reference
+        # sets RAY_ADDRESS for the job driver the same way).
+        env["RAY_TPU_ADDRESS"] = self.gcs_address
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = self.submission_id
+        self.proc = subprocess.Popen(
+            self.entrypoint, shell=True, cwd=os.getcwd(), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        rec = _get_record(self.submission_id)
+        rec["status"] = JobStatus.RUNNING
+        rec["start_time"] = time.time()
+        _put_record(rec)
+        threading.Thread(target=self._drain, daemon=True,
+                         name="job-drain").start()
+        return JobStatus.RUNNING
+
+    def _drain(self) -> None:
+        for line in self.proc.stdout:
+            self.lines.append(line)
+            self.nbytes += len(line)
+            while self.nbytes > _LOG_CAP and len(self.lines) > 1:
+                self.nbytes -= len(self.lines.pop(0))
+        rc = self.proc.wait()
+        if self.stop_requested:
+            status, message = JobStatus.STOPPED, "stopped by user"
+        elif rc == 0:
+            status, message = JobStatus.SUCCEEDED, None
+        else:
+            status, message = JobStatus.FAILED, f"exit code {rc}"
+        # Logs must be durable BEFORE the terminal status: a client that
+        # sees SUCCEEDED immediately reads the KV log blob.
+        persisted = False
+        for _ in range(5):
+            try:
+                _kv_put(_LOG_NS, self.submission_id, b"".join(self.lines))
+                rec = _get_record(self.submission_id)
+                rec["status"] = status
+                rec["message"] = message
+                rec["end_time"] = time.time()
+                _put_record(rec)
+                persisted = True
+                break
+            except Exception:
+                time.sleep(1.0)
+        self.done = True
+        if persisted:
+            # Self-clean the detached actor once state is durable; readers
+            # fall back to KV (reference: JobSupervisor ray.actor.exit_actor).
+            # If persistence failed (GCS unreachable) stay alive so status/
+            # logs remain servable via actor calls and stop_job still works.
+            import threading
+            threading.Timer(1.0, os._exit, args=(0,)).start()
+
+    def logs(self) -> bytes:
+        return b"".join(self.lines)
+
+    def running(self) -> bool:
+        return not self.done
+
+    def stop(self) -> bool:
+        import signal
+        if self.proc is not None and self.proc.poll() is None:
+            # Flag only when actually interrupting a live process — a stop
+            # racing normal exit must not relabel a finished job STOPPED.
+            self.stop_requested = True
+            # Kill the whole process group: entrypoints are shell commands.
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            import threading
+
+            def escalate():
+                if self.proc.poll() is None:
+                    try:
+                        os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            threading.Timer(3.0, escalate).start()
+            return True
+        return False
+
+
+class JobManager:
+    """Driver-side job orchestration over GCS KV + supervisor actors."""
+
+    def __init__(self):
+        from ray_tpu import api
+        if api._worker is None:
+            raise RuntimeError("ray_tpu.init() first")
+        self._gcs_address = api._worker.gcs_address
+
+    # -- submission --
+
+    def submit_job(self, entrypoint: str, *,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        import cloudpickle
+
+        import ray_tpu
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        rec = {
+            "submission_id": submission_id,
+            "entrypoint": entrypoint,
+            "status": JobStatus.PENDING,
+            "message": None,
+            "metadata": metadata or {},
+            "runtime_env": {k: v for k, v in (runtime_env or {}).items()
+                            if k == "env_vars"},
+            "submit_time": time.time(),
+            "start_time": None,
+            "end_time": None,
+        }
+        # Atomic claim of the submission id: kv_put(overwrite=False)
+        # reports whether the key already existed.
+        existed = _kv_call("kv_put", {
+            "ns": _KV_NS, "key": submission_id,
+            "value": cloudpickle.dumps(rec), "overwrite": False})["existed"]
+        if existed:
+            raise ValueError(f"job {submission_id!r} already exists")
+        opts = dict(name=_SUPERVISOR_PREFIX + submission_id,
+                    lifetime="detached", num_cpus=0, max_restarts=0)
+        if runtime_env:
+            opts["runtime_env"] = runtime_env
+        try:
+            sup = ray_tpu.remote(JobSupervisor).options(**opts).remote(
+                submission_id, entrypoint, metadata or {}, self._gcs_address)
+            ray_tpu.get(sup.start.remote(), timeout=120)
+        except Exception as e:
+            # The supervisor may exist despite the failed start() (e.g. a
+            # timeout after actor creation) — kill it so the terminal FAILED
+            # record can't be overwritten by a phantom run later.
+            sup2 = self._supervisor(submission_id)
+            if sup2 is not None:
+                try:
+                    ray_tpu.kill(sup2)
+                except Exception:
+                    pass
+            rec["status"] = JobStatus.FAILED
+            rec["message"] = f"failed to start supervisor: {e!r}"
+            rec["end_time"] = time.time()
+            _put_record(rec)
+            raise
+        return submission_id
+
+    # -- introspection --
+
+    def _supervisor(self, submission_id: str):
+        import ray_tpu
+        try:
+            return ray_tpu.get_actor(_SUPERVISOR_PREFIX + submission_id)
+        except Exception:
+            return None
+
+    def get_job_status(self, submission_id: str) -> Optional[Dict[str, Any]]:
+        rec = _get_record(submission_id)
+        if rec is not None:
+            rec = self._maybe_reconcile(rec)
+        return rec
+
+    def _maybe_reconcile(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        # PENDING gets a grace window: during submit_job the record exists
+        # before the supervisor actor is nameable.
+        if (rec["status"] == JobStatus.RUNNING
+                or (rec["status"] == JobStatus.PENDING
+                    and time.time() - (rec.get("submit_time") or 0) > 300)):
+            return self._reconcile(rec)
+        return rec
+
+    def _reconcile(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """A non-terminal record whose supervisor is gone (node died, GCS
+        write raced the self-exit) would otherwise stay RUNNING forever —
+        mark it FAILED (reference: JobManager._recover_running_jobs)."""
+        import ray_tpu
+        from ray_tpu.exceptions import ActorError
+        sup = self._supervisor(rec["submission_id"])
+        alive = False
+        if sup is not None:
+            try:
+                ray_tpu.get(sup.running.remote(), timeout=30)
+                alive = True
+            except ActorError:
+                alive = False
+            except Exception:
+                alive = True   # transient RPC trouble: don't condemn the job
+        if not alive:
+            # Supervisor death normally follows a successful terminal
+            # persist (the self-exit path) — re-read and only condemn a
+            # record that is STILL non-terminal, else we'd overwrite a
+            # fresh SUCCEEDED with FAILED.
+            latest = _get_record(rec["submission_id"]) or rec
+            if latest["status"] in JobStatus.TERMINAL:
+                return latest
+            rec = latest
+            rec["status"] = JobStatus.FAILED
+            rec["message"] = "job supervisor died"
+            rec["end_time"] = time.time()
+            try:
+                _put_record(rec)
+            except Exception:
+                pass
+        return rec
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        import pickle
+        reply = _kv_call("kv_keys", {"ns": _KV_NS, "prefix": ""})
+        jobs = []
+        for key in reply["keys"]:
+            blob = _kv_get(_KV_NS, key.decode()
+                           if isinstance(key, bytes) else key)
+            if blob is not None:
+                jobs.append(self._maybe_reconcile(pickle.loads(blob)))
+        jobs.sort(key=lambda r: r.get("submit_time") or 0)
+        return jobs
+
+    def get_job_logs(self, submission_id: str) -> str:
+        rec = _get_record(submission_id)
+        if rec is None:
+            raise KeyError(submission_id)
+        if rec["status"] in JobStatus.TERMINAL:
+            blob = _kv_get(_LOG_NS, submission_id)
+            return (blob or b"").decode("utf-8", "replace")
+        sup = self._supervisor(submission_id)
+        if sup is None:
+            return ""
+        import ray_tpu
+        try:
+            return ray_tpu.get(sup.logs.remote(), timeout=30).decode(
+                "utf-8", "replace")
+        except Exception:
+            blob = _kv_get(_LOG_NS, submission_id)
+            return (blob or b"").decode("utf-8", "replace")
+
+    # -- control --
+
+    def stop_job(self, submission_id: str) -> bool:
+        rec = _get_record(submission_id)
+        if rec is None:
+            raise KeyError(submission_id)
+        if rec["status"] in JobStatus.TERMINAL:
+            return False
+        sup = self._supervisor(submission_id)
+        if sup is None:
+            return False
+        import ray_tpu
+        try:
+            return ray_tpu.get(sup.stop.remote(), timeout=30)
+        except Exception:
+            return False
+
+    def delete_job(self, submission_id: str) -> bool:
+        rec = _get_record(submission_id)
+        if rec is None:
+            return False
+        if rec["status"] not in JobStatus.TERMINAL:
+            raise RuntimeError("cannot delete a non-terminal job; stop it "
+                               "first")
+        _kv_call("kv_del", {"ns": _KV_NS, "key": submission_id})
+        _kv_call("kv_del", {"ns": _LOG_NS, "key": submission_id})
+        return True

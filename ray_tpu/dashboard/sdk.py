@@ -1,0 +1,93 @@
+"""Job submission SDK: HTTP client for the dashboard REST API.
+
+Reference parity: dashboard/modules/job/sdk.py (JobSubmissionClient —
+submit_job/stop_job/get_job_status/get_job_logs over the job REST
+surface) and its CLI wrapper dashboard/modules/job/cli.py.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class JobSubmissionError(RuntimeError):
+    pass
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """address: the dashboard HTTP endpoint, e.g. http://127.0.0.1:8265"""
+        if not address.startswith("http://") and \
+                not address.startswith("https://"):
+            address = "http://" + address
+        self.address = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 raw: bool = False):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.address + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except Exception:
+                pass
+            raise JobSubmissionError(f"{e.code}: {detail}") from None
+        if raw:
+            return payload.decode("utf-8", "replace")
+        out = json.loads(payload)
+        if "error" in out:
+            raise JobSubmissionError(out["error"])
+        return out["result"]
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        body = {"entrypoint": entrypoint}
+        if runtime_env:
+            body["runtime_env"] = runtime_env
+        if metadata:
+            body["metadata"] = metadata
+        if submission_id:
+            body["submission_id"] = submission_id
+        return self._request("POST", "/api/jobs", body)["submission_id"]
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._request("GET", "/api/jobs")
+
+    def get_job_status(self, submission_id: str) -> Dict[str, Any]:
+        return self._request("GET", f"/api/jobs/{submission_id}")
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}/logs",
+                             raw=True)
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request(
+            "POST", f"/api/jobs/{submission_id}/stop")["stopped"]
+
+    def delete_job(self, submission_id: str) -> bool:
+        return self._request("DELETE", f"/api/jobs/{submission_id}")["deleted"]
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 300.0,
+                            poll_s: float = 0.5) -> Dict[str, Any]:
+        from ray_tpu.dashboard.job_manager import JobStatus
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            rec = self.get_job_status(submission_id)
+            if rec["status"] in JobStatus.TERMINAL:
+                return rec
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"job {submission_id} not finished after {timeout}s")

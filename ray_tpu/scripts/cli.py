@@ -149,6 +149,55 @@ def cmd_client_server(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    """Run the dashboard head (REST + web UI).  Reference: dashboard.py."""
+    from ray_tpu.dashboard.head import main as dash_main
+    return dash_main(["--address", args.address, "--host", args.host,
+                      "--port", str(args.port)])
+
+
+def cmd_job(args) -> int:
+    """Job submission CLI over the dashboard REST API (reference:
+    dashboard/modules/job/cli.py — `ray job submit/list/status/logs/stop`)."""
+    from ray_tpu.dashboard.sdk import JobSubmissionClient
+    client = JobSubmissionClient(args.dashboard_address)
+    if args.job_cmd == "submit":
+        runtime_env = {}
+        if args.working_dir:
+            runtime_env["working_dir"] = args.working_dir
+        import shlex
+        sub_id = client.submit_job(
+            entrypoint=shlex.join(args.entrypoint),
+            runtime_env=runtime_env or None,
+            submission_id=args.submission_id)
+        print(f"submitted: {sub_id}")
+        if not args.no_wait:
+            rec = client.wait_until_finished(sub_id, timeout=args.timeout)
+            print(f"status: {rec['status']}"
+                  + (f" ({rec['message']})" if rec.get("message") else ""))
+            print(client.get_job_logs(sub_id), end="")
+            return 0 if rec["status"] == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "list":
+        rows = [{"submission_id": r["submission_id"], "status": r["status"],
+                 "entrypoint": r["entrypoint"][:60]}
+                for r in client.list_jobs()]
+        print(_fmt_table(rows, ["submission_id", "status", "entrypoint"]))
+        return 0
+    if args.job_cmd == "status":
+        print(json.dumps(client.get_job_status(args.submission_id),
+                         indent=2, default=str))
+        return 0
+    if args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+        return 0
+    if args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.submission_id)
+              else "not running")
+        return 0
+    return 2
+
+
 def cmd_serve(args) -> int:
     """Serve control subcommands (reference: serve CLI scripts.py —
     deploy from a config file, status, shutdown)."""
@@ -285,6 +334,30 @@ def main(argv=None) -> int:
     q.add_argument("--port", type=int, default=10001)
     q.add_argument("--host", default="0.0.0.0")
     q.set_defaults(fn=cmd_client_server)
+
+    q = sub.add_parser("dashboard", help="run the dashboard head "
+                                         "(REST API + web UI)")
+    q.add_argument("--address", required=True)
+    q.add_argument("--host", default="127.0.0.1")
+    q.add_argument("--port", type=int, default=8265)
+    q.set_defaults(fn=cmd_dashboard)
+
+    q = sub.add_parser("job", help="submit and manage jobs")
+    jsub = q.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--dashboard-address", required=True)
+    js.add_argument("--working-dir")
+    js.add_argument("--submission-id")
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=600.0)
+    js.add_argument("entrypoint", nargs="+")
+    js.set_defaults(fn=cmd_job)
+    for jname in ("list", "status", "logs", "stop"):
+        js = jsub.add_parser(jname)
+        js.add_argument("--dashboard-address", required=True)
+        if jname != "list":
+            js.add_argument("submission_id")
+        js.set_defaults(fn=cmd_job)
 
     q = sub.add_parser("list", help="list live cluster entities")
     q.add_argument("kind", choices=["nodes", "actors", "workers",
