@@ -89,6 +89,13 @@ class JobSupervisor:
         import subprocess
         import threading
 
+        # A stop_job issued while we were still PENDING persisted STOPPED;
+        # honor it instead of launching the entrypoint.
+        rec = _get_record(self.submission_id)
+        if rec is not None and rec["status"] == JobStatus.STOPPED:
+            self.done = True
+            return JobStatus.STOPPED
+
         env = dict(os.environ)
         # The entrypoint's ray_tpu.init() joins this cluster (reference
         # sets RAY_ADDRESS for the job driver the same way).
@@ -99,6 +106,15 @@ class JobSupervisor:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             start_new_session=True)
         rec = _get_record(self.submission_id)
+        if rec is not None and rec["status"] == JobStatus.STOPPED:
+            # stop raced the spawn: tear the process group down again.
+            self.stop()
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:
+                pass
+            self.done = True
+            return JobStatus.STOPPED
         rec["status"] = JobStatus.RUNNING
         rec["start_time"] = time.time()
         _put_record(rec)
@@ -181,6 +197,11 @@ class JobManager:
         if api._worker is None:
             raise RuntimeError("ray_tpu.init() first")
         self._gcs_address = api._worker.gcs_address
+        # submission_id -> monotonic time of the last supervisor liveness
+        # probe; polling endpoints (the UI hits list_jobs every 2s) must
+        # not ping every running job's actor on every call.
+        self._probe_at: Dict[str, float] = {}
+        self._probe_interval_s = 5.0
 
     # -- submission --
 
@@ -221,10 +242,15 @@ class JobManager:
             ray_tpu.get(sup.start.remote(), timeout=120)
         except Exception as e:
             # The supervisor may exist despite the failed start() (e.g. a
-            # timeout after actor creation) — kill it so the terminal FAILED
-            # record can't be overwritten by a phantom run later.
+            # timeout after actor creation) — stop any already-spawned
+            # entrypoint process group, then kill the actor so the terminal
+            # FAILED record can't be overwritten by a phantom run later.
             sup2 = self._supervisor(submission_id)
             if sup2 is not None:
+                try:
+                    ray_tpu.get(sup2.stop.remote(), timeout=15)
+                except Exception:
+                    pass
                 try:
                     ray_tpu.kill(sup2)
                 except Exception:
@@ -257,6 +283,11 @@ class JobManager:
         if (rec["status"] == JobStatus.RUNNING
                 or (rec["status"] == JobStatus.PENDING
                     and time.time() - (rec.get("submit_time") or 0) > 300)):
+            now = time.monotonic()
+            last = self._probe_at.get(rec["submission_id"], 0.0)
+            if now - last < self._probe_interval_s:
+                return rec
+            self._probe_at[rec["submission_id"]] = now
             return self._reconcile(rec)
         return rec
 
@@ -334,6 +365,15 @@ class JobManager:
             return False
         sup = self._supervisor(submission_id)
         if sup is None:
+            if rec["status"] == JobStatus.PENDING:
+                # Supervisor not nameable yet — persist the stop intent;
+                # JobSupervisor.start honors a STOPPED record by never
+                # launching (and tears down if the spawn raced us).
+                rec["status"] = JobStatus.STOPPED
+                rec["message"] = "stopped before start"
+                rec["end_time"] = time.time()
+                _put_record(rec)
+                return True
             return False
         import ray_tpu
         try:

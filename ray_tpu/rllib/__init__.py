@@ -30,6 +30,8 @@ from ray_tpu.rllib.replay_buffer import (  # noqa: F401
 )
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rllib.td3 import TD3, TD3Config  # noqa: F401
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae  # noqa: F401
 from ray_tpu.rllib.vtrace import vtrace  # noqa: F401
 from ray_tpu.rllib.worker_set import WorkerSet  # noqa: F401
@@ -40,7 +42,7 @@ __all__ = [
     "PrioritizedReplayBuffer", "ReplayBuffer",
     "Algorithm", "AlgorithmConfig", "CartPoleVector", "Env", "VectorEnv",
     "IMPALA", "IMPALAConfig", "JaxLearner", "JaxPolicy", "LearnerThread",
-    "PPO", "PPOConfig", "PendulumVector", "RolloutWorker", "SampleBatch",
-    "WorkerSet",
+    "PPO", "PPOConfig", "PendulumVector", "RolloutWorker", "SAC",
+    "SACConfig", "SampleBatch", "TD3", "TD3Config", "WorkerSet",
     "compute_gae", "make_vector_env", "ppo_loss", "register_env", "vtrace",
 ]

@@ -90,3 +90,84 @@ def gaussian_logp(mean, log_std, actions):
     return jnp.sum(
         -0.5 * ((actions - mean) ** 2 / var)
         - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+
+class SquashedGaussianActor(nn.Module):
+    """SAC actor: relu trunk -> state-dependent (mean, log_std); actions
+    are tanh-squashed samples (reference: rllib/algorithms/sac policy
+    model with SquashedGaussian action distribution)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+    log_std_min: float = -20.0
+    log_std_max: float = 2.0
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        mean = nn.Dense(self.action_dim)(x)
+        log_std = nn.Dense(self.action_dim)(x)
+        log_std = jnp.clip(log_std, self.log_std_min, self.log_std_max)
+        return mean, log_std
+
+
+class DeterministicActor(nn.Module):
+    """TD3/DDPG actor: relu trunk -> tanh action in [-1, 1] (env scaling
+    applied by the caller)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.tanh(nn.Dense(self.action_dim)(x))
+
+
+class QNetwork(nn.Module):
+    """Continuous-action state-action value: Q(s, a) -> scalar."""
+
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray, action: jnp.ndarray):
+        x = jnp.concatenate([obs, action], axis=-1)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return jnp.squeeze(nn.Dense(1)(x), axis=-1)
+
+
+def make_squashed_actor(obs_dim: int, action_dim: int,
+                        hidden: Sequence[int] = (256, 256)):
+    """(init(rng), apply(params, obs) -> (mean, log_std))."""
+    model = SquashedGaussianActor(action_dim=action_dim,
+                                  hidden=tuple(hidden))
+
+    def init_params(rng):
+        return model.init(rng, jnp.zeros((1, obs_dim), jnp.float32))
+    return init_params, model.apply
+
+
+def make_deterministic_actor(obs_dim: int, action_dim: int,
+                             hidden: Sequence[int] = (256, 256)):
+    """(init(rng), apply(params, obs) -> action in [-1, 1])."""
+    model = DeterministicActor(action_dim=action_dim, hidden=tuple(hidden))
+
+    def init_params(rng):
+        return model.init(rng, jnp.zeros((1, obs_dim), jnp.float32))
+    return init_params, model.apply
+
+
+def make_q_network(obs_dim: int, action_dim: int,
+                   hidden: Sequence[int] = (256, 256)):
+    """(init(rng), apply(params, obs, action) -> q [B])."""
+    model = QNetwork(hidden=tuple(hidden))
+
+    def init_params(rng):
+        return model.init(rng, jnp.zeros((1, obs_dim), jnp.float32),
+                          jnp.zeros((1, action_dim), jnp.float32))
+    return init_params, model.apply

@@ -111,3 +111,117 @@ class JaxPolicy:
     def set_weights(self, weights: Any) -> None:
         with self._ctx():
             self.params = jax.device_put(weights)
+
+
+class _ContinuousRolloutPolicy:
+    """Shared shell for off-policy continuous rollout policies: CPU-pinned
+    jitted inference over an actor network, env-scale action output.
+    compute_actions matches JaxPolicy's interface; logp/value slots are
+    zeros (off-policy learners never consume them)."""
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 action_low: float, action_high: float,
+                 force_cpu: bool = True):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.continuous = True
+        self._device = None
+        if force_cpu and jax.default_backend() != "cpu":
+            self._device = jax.local_devices(backend="cpu")[0]
+        self._scale = (np.asarray(action_high) - np.asarray(action_low)) / 2.0
+        self._center = (np.asarray(action_high) + np.asarray(action_low)) / 2.0
+
+    def _ctx(self):
+        if self._device is None:
+            return contextlib.nullcontext()
+        return jax.default_device(self._device)
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        with self._ctx():
+            self.params = jax.device_put(weights)
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros(len(obs), np.float32)
+
+
+class SquashedGaussianRolloutPolicy(_ContinuousRolloutPolicy):
+    """SAC behavior policy: a ~ tanh(mean + std*eps) scaled to env bounds
+    (reference: rllib/algorithms/sac — SquashedGaussian distribution;
+    exploration is the stochastic policy itself)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(256, 256),
+                 seed: int = 0, action_low: float = -1.0,
+                 action_high: float = 1.0, force_cpu: bool = True):
+        super().__init__(obs_dim, action_dim, action_low, action_high,
+                         force_cpu)
+        from ray_tpu.rllib.models import make_squashed_actor
+        init_params, self.apply = make_squashed_actor(
+            obs_dim, action_dim, hidden)
+        scale, center = self._scale, self._center
+
+        def _sample(params, obs, rng):
+            mean, log_std = self.apply(params, obs)
+            u = mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+            return jnp.tanh(u) * scale + center, mean
+
+        def _greedy(params, obs):
+            mean, _ = self.apply(params, obs)
+            return jnp.tanh(mean) * scale + center, mean
+
+        with self._ctx():
+            self.params = init_params(jax.random.key(seed))
+            self._rng = jax.random.key(seed + 1)
+            self._sample = jax.jit(_sample)
+            self._greedy = jax.jit(_greedy)
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        with self._ctx():
+            obs = jnp.asarray(obs, jnp.float32)
+            if explore:
+                self._rng, sub = jax.random.split(self._rng)
+                a, mean = self._sample(self.params, obs, sub)
+            else:
+                a, mean = self._greedy(self.params, obs)
+            z = np.zeros(len(obs), np.float32)
+            return np.asarray(a), z, z, np.asarray(mean)
+
+
+class DeterministicNoiseRolloutPolicy(_ContinuousRolloutPolicy):
+    """TD3 behavior policy: a = clip(actor(s) + N(0, sigma*scale), bounds)
+    (reference: rllib/algorithms/td3 — GaussianNoise exploration over a
+    deterministic policy)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(256, 256),
+                 seed: int = 0, action_low: float = -1.0,
+                 action_high: float = 1.0, force_cpu: bool = True,
+                 noise_scale: float = 0.1):
+        super().__init__(obs_dim, action_dim, action_low, action_high,
+                         force_cpu)
+        from ray_tpu.rllib.models import make_deterministic_actor
+        init_params, self.apply = make_deterministic_actor(
+            obs_dim, action_dim, hidden)
+        scale, center = self._scale, self._center
+        low, high = action_low, action_high
+
+        def _act(params, obs, rng, sigma):
+            a = self.apply(params, obs) * scale + center
+            noise = sigma * scale * jax.random.normal(rng, a.shape)
+            return jnp.clip(a + noise, low, high), a
+
+        with self._ctx():
+            self.params = init_params(jax.random.key(seed))
+            self._rng = jax.random.key(seed + 1)
+            self._act = jax.jit(_act)
+        self.noise_scale = noise_scale
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        with self._ctx():
+            obs = jnp.asarray(obs, jnp.float32)
+            self._rng, sub = jax.random.split(self._rng)
+            sigma = self.noise_scale if explore else 0.0
+            a, mean = self._act(self.params, obs, sub, sigma)
+            z = np.zeros(len(obs), np.float32)
+            return np.asarray(a), z, z, np.asarray(mean)

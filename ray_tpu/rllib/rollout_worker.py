@@ -48,7 +48,10 @@ class RolloutWorker:
                  gamma: float = 0.99, lam: float = 0.95,
                  hidden=(64, 64), seed: int = 0,
                  postprocess: bool = True,
-                 epsilon_schedule=None):
+                 epsilon_schedule=None,
+                 policy_kind: str = "actor_critic",
+                 exploration_noise: float = 0.1,
+                 random_warmup_steps: int = 0):
         # In a remote worker process, force the whole jax platform to CPU
         # before the first jax use: rollout actors must not even initialize
         # the TPU runtime (one chip, many actor processes).  In the driver
@@ -70,12 +73,31 @@ class RolloutWorker:
         if epsilon_schedule is not None and self.continuous:
             raise ValueError(
                 "epsilon-greedy exploration requires a discrete env")
-        self.policy = JaxPolicy(
-            self.env.observation_dim, self.env.num_actions, hidden,
-            seed=seed,
-            action_dim=getattr(self.env, "action_dim", 0),
-            action_low=getattr(self.env, "action_low", -1.0),
-            action_high=getattr(self.env, "action_high", 1.0))
+        action_low = getattr(self.env, "action_low", -1.0)
+        action_high = getattr(self.env, "action_high", 1.0)
+        if policy_kind == "actor_critic":
+            self.policy = JaxPolicy(
+                self.env.observation_dim, self.env.num_actions, hidden,
+                seed=seed, action_dim=action_dim,
+                action_low=action_low, action_high=action_high)
+        elif policy_kind == "squashed_gaussian":      # SAC behavior policy
+            from ray_tpu.rllib.policy import SquashedGaussianRolloutPolicy
+            self.policy = SquashedGaussianRolloutPolicy(
+                self.env.observation_dim, action_dim, hidden, seed=seed,
+                action_low=action_low, action_high=action_high)
+        elif policy_kind == "deterministic_noise":    # TD3 behavior policy
+            from ray_tpu.rllib.policy import DeterministicNoiseRolloutPolicy
+            self.policy = DeterministicNoiseRolloutPolicy(
+                self.env.observation_dim, action_dim, hidden, seed=seed,
+                action_low=action_low, action_high=action_high,
+                noise_scale=exploration_noise)
+        else:
+            raise ValueError(f"unknown policy_kind {policy_kind!r}")
+        # Uniform-random action warmup before the policy takes over
+        # (reference: SAC/TD3 configs' num_steps_sampled_before_learning /
+        # random_timesteps exploration option).
+        self._random_warmup = int(random_warmup_steps)
+        self._action_low, self._action_high = action_low, action_high
         self.obs = self.env.reset_all(seed)
         self._total_steps = 0
         # Epsilon-greedy exploration for value-based algorithms
@@ -129,6 +151,11 @@ class RolloutWorker:
                 random_actions = self._np_rng.integers(
                     0, self.env.num_actions, size=B)
                 actions = np.where(explore_mask, random_actions, actions)
+            if self.continuous and self._total_steps + t * B < \
+                    self._random_warmup:
+                actions = self._np_rng.uniform(
+                    self._action_low, self._action_high,
+                    size=(B, self.env.action_dim)).astype(np.float32)
             obs_buf[t] = obs
             act_buf[t] = actions
             logp_buf[t] = logp

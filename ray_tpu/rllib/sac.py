@@ -1,0 +1,245 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference parity: rllib/algorithms/sac/ (sac.py config surface: twin Q
+networks, tanh-squashed Gaussian policy, automatic entropy-temperature
+tuning against a target entropy, polyak target updates; training_step is
+the generic store-rollouts -> replay-sample -> update loop shared with
+DQN).  TPU-first shape: the whole SAC update — critic TD step on
+min(Q1',Q2') soft targets, actor reparameterized step, alpha step, and
+the polyak averaging — is ONE jitted XLA program over a train-state
+pytree; nothing crosses the host boundary between the three optimizers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import _to_transitions
+from ray_tpu.rllib.models import make_q_network, make_squashed_actor
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.worker_set import WorkerSet
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.tau = 0.005                   # polyak coefficient
+        self.initial_alpha = 1.0
+        self.target_entropy = None         # default: -action_dim
+        self.replay_buffer_capacity = 100_000
+        self.learning_starts = 1_500
+        self.random_warmup_steps = 1_000   # uniform actions at the start
+        self.train_batch_size = 256
+        self.updates_per_step = 32
+        self.model_hidden = (256, 256)
+
+
+class _SACState(NamedTuple):
+    actor: Any
+    q1: Any
+    q2: Any
+    q1_t: Any
+    q2_t: Any
+    log_alpha: jnp.ndarray
+    actor_opt: Any
+    critic_opt: Any
+    alpha_opt: Any
+    rng: jax.Array
+
+
+def _squashed_sample(apply, params, obs, rng, scale, center):
+    """Reparameterized tanh-Gaussian sample in env scale + its log-prob
+    (with the tanh + affine change-of-variables correction)."""
+    mean, log_std = apply(params, obs)
+    u = mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+    # logp of u under N(mean, std)
+    logp_u = jnp.sum(
+        -0.5 * ((u - mean) ** 2) * jnp.exp(-2 * log_std)
+        - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+    t = jnp.tanh(u)
+    # d(tanh)/du = 1 - tanh^2; numerically-stable log1p form.
+    log_det = jnp.sum(jnp.log(scale * (1 - t ** 2) + 1e-6), axis=-1)
+    return t * scale + center, logp_u - log_det
+
+
+class _SACLearner:
+    def __init__(self, obs_dim: int, action_dim: int, cfg: SACConfig,
+                 action_low, action_high, seed: int):
+        hidden = cfg.model_hidden
+        init_actor, actor_apply = make_squashed_actor(obs_dim, action_dim,
+                                                      hidden)
+        init_q, q_apply = make_q_network(obs_dim, action_dim, hidden)
+        k = jax.random.split(jax.random.key(seed), 4)
+        actor = init_actor(k[0])
+        q1, q2 = init_q(k[1]), init_q(k[2])
+        scale = jnp.asarray((np.asarray(action_high)
+                             - np.asarray(action_low)) / 2.0, jnp.float32)
+        center = jnp.asarray((np.asarray(action_high)
+                              + np.asarray(action_low)) / 2.0, jnp.float32)
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(action_dim))
+        actor_tx = optax.adam(cfg.actor_lr)
+        critic_tx = optax.adam(cfg.critic_lr)
+        alpha_tx = optax.adam(cfg.alpha_lr)
+        log_alpha = jnp.asarray(np.log(cfg.initial_alpha), jnp.float32)
+        self.state = _SACState(
+            actor=actor, q1=q1, q2=q2, q1_t=q1, q2_t=q2,
+            log_alpha=log_alpha,
+            actor_opt=actor_tx.init(actor),
+            critic_opt=critic_tx.init((q1, q2)),
+            alpha_opt=alpha_tx.init(log_alpha),
+            rng=jax.random.key(seed + 7))
+        gamma, tau = cfg.gamma, cfg.tau
+        self.num_updates = 0
+
+        def step(state: _SACState, batch):
+            rng, k_next, k_pi = jax.random.split(state.rng, 3)
+            alpha = jnp.exp(state.log_alpha)
+
+            # -- critic: soft TD target from the target twins --
+            next_a, next_logp = _squashed_sample(
+                actor_apply, state.actor, batch["next_obs"], k_next,
+                scale, center)
+            q_next = jnp.minimum(
+                q_apply(state.q1_t, batch["next_obs"], next_a),
+                q_apply(state.q2_t, batch["next_obs"], next_a))
+            target = batch["rewards"] + gamma * (
+                1.0 - batch["dones"].astype(jnp.float32)) * (
+                q_next - alpha * next_logp)
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(qs):
+                p1, p2 = qs
+                e1 = q_apply(p1, batch["obs"], batch["actions"]) - target
+                e2 = q_apply(p2, batch["obs"], batch["actions"]) - target
+                return (e1 ** 2 + e2 ** 2).mean()
+
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                (state.q1, state.q2))
+            c_updates, critic_opt = critic_tx.update(
+                c_grads, state.critic_opt, (state.q1, state.q2))
+            q1, q2 = optax.apply_updates((state.q1, state.q2), c_updates)
+
+            # -- actor: maximize E[min Q - alpha logp] (reparameterized) --
+            def actor_loss(ap):
+                a_pi, logp_pi = _squashed_sample(
+                    actor_apply, ap, batch["obs"], k_pi, scale, center)
+                q_pi = jnp.minimum(q_apply(q1, batch["obs"], a_pi),
+                                   q_apply(q2, batch["obs"], a_pi))
+                return (alpha * logp_pi - q_pi).mean(), logp_pi
+
+            (a_loss, logp_pi), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state.actor)
+            a_updates, actor_opt = actor_tx.update(
+                a_grads, state.actor_opt, state.actor)
+            actor = optax.apply_updates(state.actor, a_updates)
+
+            # -- temperature: drive policy entropy toward the target --
+            def alpha_loss(la):
+                return -(la * jax.lax.stop_gradient(
+                    logp_pi + target_entropy)).mean()
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+                state.log_alpha)
+            al_update, alpha_opt = alpha_tx.update(
+                al_grad, state.alpha_opt, state.log_alpha)
+            log_alpha = optax.apply_updates(state.log_alpha, al_update)
+
+            # -- polyak target update --
+            polyak = lambda t, s: jax.tree.map(
+                lambda a, b: (1 - tau) * a + tau * b, t, s)
+            new_state = _SACState(
+                actor=actor, q1=q1, q2=q2,
+                q1_t=polyak(state.q1_t, q1), q2_t=polyak(state.q2_t, q2),
+                log_alpha=log_alpha, actor_opt=actor_opt,
+                critic_opt=critic_opt, alpha_opt=alpha_opt, rng=rng)
+            metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "alpha_loss": al_loss, "alpha": jnp.exp(log_alpha),
+                       "entropy": -logp_pi.mean()}
+            return new_state, metrics
+
+        self._step = jax.jit(step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.state, metrics = self._step(self.state, jb)
+        self.num_updates += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.state.actor)
+
+    def get_state(self):
+        s = jax.device_get(self.state._replace(rng=None))
+        return {"sac_state": s._asdict(), "num_updates": self.num_updates}
+
+    def set_state(self, state):
+        d = dict(state["sac_state"])
+        d["rng"] = self.state.rng
+        self.state = _SACState(**jax.device_put(d))
+        self.num_updates = state.get("num_updates", 0)
+
+
+class SAC(Algorithm):
+    def setup(self) -> None:
+        cfg = self.config
+        if not self.continuous:
+            raise ValueError("SAC requires a continuous-action env")
+        self.workers = WorkerSet(
+            num_workers=cfg.num_rollout_workers,
+            num_cpus_per_worker=cfg.num_cpus_per_worker,
+            worker_kwargs=dict(
+                env=cfg.env, num_envs=cfg.num_envs_per_worker,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                gamma=cfg.gamma, hidden=cfg.model_hidden, seed=cfg.seed,
+                postprocess=False, policy_kind="squashed_gaussian",
+                random_warmup_steps=cfg.random_warmup_steps))
+        probe = self.workers.local_worker.env
+        self.learner = _SACLearner(
+            self.obs_dim, self.action_dim, cfg,
+            probe.action_low, probe.action_high, cfg.seed)
+        from ray_tpu.rllib.replay_buffer import ReplayBuffer
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity,
+                                   seed=cfg.seed)
+        self.workers.sync_weights(self.learner.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        """Reference: sac.py training_step (via DQN's generic loop) —
+        sample -> store -> N gradient updates -> weight broadcast."""
+        cfg = self.config
+        batches, metrics_list = self.workers.sample_sync()
+        episodes = self._record_metrics(metrics_list)
+        for b in batches:
+            self.buffer.add(_to_transitions(b))
+
+        learner_metrics: Dict[str, float] = {}
+        updates = 0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_step):
+                learner_metrics = self.learner.update(
+                    self.buffer.sample(cfg.train_batch_size))
+                updates += 1
+            self.workers.sync_weights(self.learner.get_weights())
+
+        return {"episodes_this_iter": episodes,
+                "buffer_size": len(self.buffer),
+                "learner_updates_total": self.learner.num_updates,
+                "updates_this_iter": updates,
+                **{f"learner/{k}": v for k, v in learner_metrics.items()}}
+
+    def save_to_dict(self) -> Dict[str, Any]:
+        return {"learner_state": self.learner.get_state(),
+                "config": self.config.to_dict()}
+
+    def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        self.learner.set_state(state["learner_state"])
+        self.workers.sync_weights(self.learner.get_weights())

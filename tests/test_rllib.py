@@ -404,3 +404,79 @@ def test_ppo_continuous_pendulum(cluster):
         assert batch[SampleBatch.ACTIONS].shape[-1] == 1
     finally:
         algo.stop()
+
+
+def test_sac_learns_pendulum(cluster):
+    """Continuous off-policy: SAC (twin soft Q + squashed-Gaussian actor +
+    entropy autotuning) solves Pendulum swing-up well past the random
+    floor (reference: rllib/algorithms/sac)."""
+    from ray_tpu.rllib import SACConfig
+    cfg = (SACConfig()
+           .environment("Pendulum-v1")
+           .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                     rollout_fragment_length=32)
+           .training(updates_per_step=256)
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        best = -np.inf
+        for _ in range(70):
+            r = algo.train()
+            best = max(best, r["episode_reward_mean"])
+            if best > -450:
+                break
+        assert best > -450, f"SAC made no progress: {best}"
+        # alpha is autotuned downward from 1.0 as the policy sharpens
+        assert r["learner/alpha"] < 0.9
+        # checkpoint roundtrip keeps the learned actor
+        ckpt = algo.save()
+        algo.restore(ckpt)
+        r2 = algo.train()
+        assert r2["episode_reward_mean"] > -600
+    finally:
+        algo.stop()
+
+
+def test_td3_learns_pendulum(cluster):
+    """Continuous off-policy: TD3 (twin Q + delayed deterministic policy +
+    target smoothing) improves Pendulum well past the random floor
+    (reference: rllib/algorithms/td3)."""
+    from ray_tpu.rllib import TD3Config
+    cfg = (TD3Config()
+           .environment("Pendulum-v1")
+           .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                     rollout_fragment_length=32)
+           .training(updates_per_step=256)
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        best = -np.inf
+        for _ in range(70):
+            r = algo.train()
+            best = max(best, r["episode_reward_mean"])
+            if best > -500:
+                break
+        assert best > -500, f"TD3 made no progress: {best}"
+    finally:
+        algo.stop()
+
+
+def test_sac_remote_rollout_plumbing(cluster):
+    """SAC's squashed-Gaussian behavior policy works on REMOTE rollout
+    actors (policy_kind plumbed through worker_kwargs; weight broadcast
+    format matches the actor network)."""
+    from ray_tpu.rllib import SACConfig
+    cfg = (SACConfig()
+           .environment("Pendulum-v1")
+           .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                     rollout_fragment_length=16)
+           .training(learning_starts=64, updates_per_step=2)
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        r1 = algo.train()
+        r2 = algo.train()
+        assert r2["buffer_size"] > r1["buffer_size"] > 0
+        assert r2["learner_updates_total"] > 0
+    finally:
+        algo.stop()
