@@ -15,6 +15,9 @@ _LOCK = threading.Lock()
 
 _LIBS = {
     "tpustore": ["objstore.cc"],
+    # Transfer plane links the store's C API into the same .so; its
+    # handles attach to the same /dev/shm segment independently.
+    "tpuxfer": ["objstore.cc", "objtransfer.cc"],
 }
 
 

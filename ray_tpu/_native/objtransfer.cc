@@ -1,0 +1,349 @@
+// Native node-to-node object transfer data plane.
+//
+// Reference parity: src/ray/object_manager/ — ObjectManager's chunked
+// push/pull moves object payloads between Plasma stores over gRPC
+// (object_buffer_pool.cc chunk views, push_manager.h throttling).  The
+// TPU build's control RPCs stay on the Python daemons, but the BULK DATA
+// path is this C++ plane: a raw-TCP server that writes straight out of
+// the shared-memory store's mmap, and a client that receives straight
+// into a freshly-allocated (unsealed) local store object — zero
+// user-space copies on either end, no Python in the loop.
+//
+// Wire protocol (one object per connection):
+//   request:  u32 magic "TPX1" | u8 id[28]
+//   response: i32 status | u64 data_size | u64 meta_size | data | meta
+//
+// Compiled into libtpuxfer.so together with objstore.cc (the tpus_*
+// symbols below resolve within the same shared object).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <atomic>
+
+extern "C" {
+// objstore.cc C API (same .so).
+int tpus_attach(const char* path, void** out);
+void tpus_close(void* h);
+unsigned char* tpus_base(void* h);
+int tpus_obj_create(void* h, const uint8_t* id, uint64_t data_size,
+                    uint64_t meta_size, uint64_t* data_off);
+int tpus_obj_seal(void* h, const uint8_t* id);
+int tpus_obj_abort(void* h, const uint8_t* id);
+int tpus_obj_get(void* h, const uint8_t* id, int64_t timeout_ms,
+                 uint64_t* data_off, uint64_t* data_size,
+                 uint64_t* meta_size);
+int tpus_obj_release(void* h, const uint8_t* id);
+}
+
+namespace {
+
+constexpr uint32_t kMagic = 0x31585054;  // "TPX1" little-endian
+constexpr uint32_t kIdSize = 28;
+constexpr uint64_t kMaxObject = 1ULL << 40;
+constexpr int kIoTimeoutSec = 300;
+
+enum {
+  TPOT_OK = 0,
+  TPOT_EXISTS = -1,
+  TPOT_NOT_FOUND = -2,
+  TPOT_OOM = -3,
+  TPOT_SYS = -6,
+  TPOT_PROTO = -7,
+};
+
+int read_full(int fd, void* buf, uint64_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r == 0) return -1;
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += r;
+    n -= uint64_t(r);
+  }
+  return 0;
+}
+
+int write_full(int fd, const void* buf, uint64_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += r;
+    n -= uint64_t(r);
+  }
+  return 0;
+}
+
+void set_io_timeouts(int fd) {
+  struct timeval tv;
+  tv.tv_sec = kIoTimeoutSec;
+  tv.tv_usec = 0;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+struct Server {
+  void* store;
+  int listen_fd;
+  pthread_t accept_thread;
+  std::atomic<bool> stopping{false};
+  // Detached connection threads use `store`; stop must wait for them or
+  // they'd touch a closed handle (use-after-munmap).
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  pthread_cond_t cv = PTHREAD_COND_INITIALIZER;
+  int active = 0;
+};
+
+void conn_done(Server* srv) {
+  pthread_mutex_lock(&srv->mu);
+  if (--srv->active == 0) pthread_cond_broadcast(&srv->cv);
+  pthread_mutex_unlock(&srv->mu);
+}
+
+struct ConnArg {
+  Server* srv;
+  int fd;
+};
+
+void* conn_main(void* argv) {
+  ConnArg* arg = static_cast<ConnArg*>(argv);
+  int fd = arg->fd;
+  Server* srv = arg->srv;
+  delete arg;
+  set_io_timeouts(fd);
+
+  uint32_t magic = 0;
+  uint8_t id[kIdSize];
+  if (read_full(fd, &magic, 4) != 0 || magic != kMagic ||
+      read_full(fd, id, kIdSize) != 0) {
+    close(fd);
+    conn_done(srv);
+    return nullptr;
+  }
+  uint64_t off = 0, dsize = 0, msize = 0;
+  // timeout 0: a not-yet-sealed or absent object is the caller's problem
+  // (it falls back to the RPC pull path, which also handles spill
+  // restores); the data plane never blocks holding a connection.
+  int rc = tpus_obj_get(srv->store, id, 0, &off, &dsize, &msize);
+  if (rc != 0) {
+    int32_t status = TPOT_NOT_FOUND;
+    uint64_t zero = 0;
+    write_full(fd, &status, 4);
+    write_full(fd, &zero, 8);
+    write_full(fd, &zero, 8);
+    close(fd);
+    conn_done(srv);
+    return nullptr;
+  }
+  int32_t status = TPOT_OK;
+  const uint8_t* base = tpus_base(srv->store);
+  bool ok = write_full(fd, &status, 4) == 0 &&
+            write_full(fd, &dsize, 8) == 0 &&
+            write_full(fd, &msize, 8) == 0 &&
+            write_full(fd, base + off, dsize) == 0 &&
+            write_full(fd, base + off + dsize, msize) == 0;
+  (void)ok;
+  tpus_obj_release(srv->store, id);
+  close(fd);
+  conn_done(srv);
+  return nullptr;
+}
+
+void* accept_main(void* argv) {
+  Server* srv = static_cast<Server*>(argv);
+  for (;;) {
+    int fd = accept(srv->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (srv->stopping.load()) break;
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      if (errno == EMFILE || errno == ENFILE || errno == ENOBUFS ||
+          errno == ENOMEM || errno == EAGAIN) {
+        // Transient resource exhaustion must not kill the listener —
+        // pullers would block against a dead port until daemon restart.
+        usleep(50 * 1000);
+        continue;
+      }
+      break;
+    }
+    if (srv->stopping.load()) {
+      close(fd);
+      break;
+    }
+    ConnArg* arg = new ConnArg{srv, fd};
+    pthread_mutex_lock(&srv->mu);
+    srv->active++;
+    pthread_mutex_unlock(&srv->mu);
+    pthread_t t;
+    if (pthread_create(&t, nullptr, conn_main, arg) == 0) {
+      pthread_detach(t);
+    } else {
+      close(fd);
+      delete arg;
+      conn_done(srv);
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start serving the store at `store_path` on `port` (0 = ephemeral).
+// Returns TPOT_OK with *out_port / *out_srv set.
+int tpot_server_start(const char* store_path, int port, int* out_port,
+                      void** out_srv) {
+  void* store = nullptr;
+  if (tpus_attach(store_path, &store) != 0) return TPOT_SYS;
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    tpus_close(store);
+    return TPOT_SYS;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 128) != 0) {
+    close(fd);
+    tpus_close(store);
+    return TPOT_SYS;
+  }
+  socklen_t alen = sizeof(addr);
+  if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &alen) != 0) {
+    close(fd);
+    tpus_close(store);
+    return TPOT_SYS;
+  }
+  Server* srv = new Server();
+  srv->store = store;
+  srv->listen_fd = fd;
+  if (pthread_create(&srv->accept_thread, nullptr, accept_main, srv) != 0) {
+    close(fd);
+    tpus_close(store);
+    delete srv;
+    return TPOT_SYS;
+  }
+  *out_port = ntohs(addr.sin_port);
+  *out_srv = srv;
+  return TPOT_OK;
+}
+
+void tpot_server_stop(void* srvv) {
+  Server* srv = static_cast<Server*>(srvv);
+  srv->stopping.store(true);
+  shutdown(srv->listen_fd, SHUT_RDWR);
+  close(srv->listen_fd);
+  pthread_join(srv->accept_thread, nullptr);
+  // Give in-flight connections a short grace to finish; a hung peer must
+  // not turn daemon shutdown into a 300s wait.  If any remain, leak the
+  // handle/mapping instead of closing under them (the caller is tearing
+  // the process down; the robust store survives regardless).
+  struct timespec deadline;
+  clock_gettime(CLOCK_REALTIME, &deadline);
+  deadline.tv_sec += 5;
+  pthread_mutex_lock(&srv->mu);
+  int rc = 0;
+  while (srv->active > 0 && rc != ETIMEDOUT) {
+    rc = pthread_cond_timedwait(&srv->cv, &srv->mu, &deadline);
+  }
+  bool drained = srv->active == 0;
+  pthread_mutex_unlock(&srv->mu);
+  if (drained) {
+    tpus_close(srv->store);
+    delete srv;
+  }
+}
+
+// Attach a fetch client to the LOCAL store (one per process).
+int tpot_attach(const char* store_path, void** out) {
+  return tpus_attach(store_path, out);
+}
+
+void tpot_detach(void* h) { tpus_close(h); }
+
+// Fetch object `id` from host:port directly into the local store (sealed
+// on success).  TPOT_EXISTS means another puller beat us — treat as
+// success and read the store.
+int tpot_fetch(void* h, const char* host, int port, const uint8_t* id) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return TPOT_SYS;
+  set_io_timeouts(fd);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return TPOT_SYS;
+  }
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(fd);
+    return TPOT_SYS;
+  }
+  uint32_t magic = kMagic;
+  if (write_full(fd, &magic, 4) != 0 || write_full(fd, id, kIdSize) != 0) {
+    close(fd);
+    return TPOT_SYS;
+  }
+  int32_t status = 0;
+  uint64_t dsize = 0, msize = 0;
+  if (read_full(fd, &status, 4) != 0 || read_full(fd, &dsize, 8) != 0 ||
+      read_full(fd, &msize, 8) != 0) {
+    close(fd);
+    return TPOT_SYS;
+  }
+  if (status != TPOT_OK) {
+    close(fd);
+    return status;
+  }
+  if (dsize > kMaxObject || msize > kMaxObject) {
+    close(fd);
+    return TPOT_PROTO;
+  }
+  uint64_t off = 0;
+  int rc = tpus_obj_create(h, id, dsize, msize, &off);
+  if (rc != 0) {
+    close(fd);
+    return rc;  // TPOT_EXISTS / TPOT_OOM map 1:1 to tpus codes
+  }
+  uint8_t* base = tpus_base(h) + off;
+  if (read_full(fd, base, dsize) != 0 ||
+      read_full(fd, base + dsize, msize) != 0) {
+    tpus_obj_abort(h, id);
+    close(fd);
+    return TPOT_SYS;
+  }
+  close(fd);
+  if (tpus_obj_seal(h, id) != 0) {
+    tpus_obj_abort(h, id);
+    return TPOT_SYS;
+  }
+  return TPOT_OK;
+}
+
+}  // extern "C"

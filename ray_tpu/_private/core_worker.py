@@ -127,6 +127,7 @@ class CoreWorker:
         self.gcs = RpcClient(gcs_address)
         self.pool = ClientPool()
         self.store = ObjectStore.attach(store_path) if store_path else None
+        self.store_path = store_path
         self.fn_manager = FunctionManager(self._kv_call)
         self.job_id = job_id
         self.objects: dict[ObjectID, _ObjectState] = {}
@@ -550,6 +551,36 @@ class CoreWorker:
             return reply["data"], reply["metadata"]
         size = reply["data_size"]
         metadata = reply["metadata"]
+        # Large payloads ride the native data plane when the remote store
+        # serves one (objtransfer.cc): bytes land shm-to-shm with no
+        # Python copies.  Any failure falls back to the chunk RPCs below
+        # (which also cover spilled objects).
+        port = reply.get("transfer_port")
+        if port and self.store is not None and self.store_path:
+            import socket as _socket
+
+            from ray_tpu._private import object_transfer
+            host = addr.rsplit(":", 1)[0]
+
+            def resolve_and_fetch():
+                # DNS may block — keep it off the event loop too.
+                ip = _socket.gethostbyname(host)
+                return object_transfer.fetch(self.store_path, ip, port, oid)
+
+            try:
+                ok = await asyncio.get_running_loop().run_in_executor(
+                    None, resolve_and_fetch)
+            except Exception as e:
+                logger.debug("native pull of %s from %s failed: %s",
+                             oid, addr, e)
+                ok = False
+            if ok:
+                buf = self.store.get(oid)
+                if buf is not None:
+                    try:
+                        return bytes(buf.data), buf.metadata
+                    finally:
+                        buf.release()
         out = bytearray(size)
         sem = asyncio.Semaphore(self.PULL_MAX_INFLIGHT)
         failed = []

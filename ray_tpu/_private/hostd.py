@@ -470,9 +470,12 @@ class NodeDaemon:
                     "spilled": True}
         try:
             if max_inline is not None and len(buf.data) > max_inline:
+                xfer = getattr(self, "transfer_server", None)
                 return {"found": True, "too_large": True,
                         "data_size": len(buf.data),
-                        "metadata": buf.metadata}
+                        "metadata": buf.metadata,
+                        "transfer_port":
+                            xfer.port if xfer is not None else None}
             return {"found": True, "data": bytes(buf.data),
                     "metadata": buf.metadata}
         finally:
@@ -483,17 +486,22 @@ class NodeDaemon:
         object_manager chunked transfer: ObjectBufferPool chunk layout)."""
         from ray_tpu._private.ids import ObjectID
         oid = ObjectID(req["id"])
+        xfer = getattr(self, "transfer_server", None)
+        xfer_port = xfer.port if xfer is not None else None
         buf = self.store.get(oid, timeout_ms=0)
         if buf is not None:
             try:
                 return {"found": True, "data_size": len(buf.data),
-                        "metadata": buf.metadata, "spilled": False}
+                        "metadata": buf.metadata, "spilled": False,
+                        "transfer_port": xfer_port}
             finally:
                 buf.release()
         spilled = self._spilled_meta(req["id"])
         if spilled is None:
             return {"found": False}
         data_size, meta = spilled
+        # Spilled payloads live on disk, not in the shm segment — the
+        # native plane can't serve them; the puller stays on chunk RPCs.
         return {"found": True, "data_size": data_size, "metadata": meta,
                 "spilled": True}
 
@@ -929,6 +937,15 @@ class NodeDaemon:
         self.server.register("NodeManager", "Metrics", self.get_metrics)
         self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
         port = await self.server.start(port)
+        # Native bulk-data plane: serves this store's sealed objects over
+        # raw TCP (objtransfer.cc); pullers learn the port from the
+        # PullObjectMeta probe.
+        try:
+            from ray_tpu._private.object_transfer import TransferServer
+            self.transfer_server = TransferServer(self.store_path)
+        except Exception as e:
+            logger.warning("native transfer plane unavailable: %s", e)
+            self.transfer_server = None
         await self.gcs.call("Gcs", "register_node", {"info": self.node_info()},
                             timeout=10)
         self._tasks = [asyncio.ensure_future(self._heartbeat_loop()),
@@ -966,6 +983,8 @@ class NodeDaemon:
         await self.server.stop()
         await self.pool.close_all()
         await self.gcs.close()
+        if getattr(self, "transfer_server", None) is not None:
+            self.transfer_server.close()
         self.store.close()
 
 

@@ -1,0 +1,109 @@
+"""Native object-transfer data plane: ctypes bindings for objtransfer.cc.
+
+Reference parity: src/ray/object_manager/ (chunked push/pull between
+Plasma stores).  The control decisions (which node, spill restore,
+fallbacks) stay in the Python daemons; payload bytes move shm-to-shm over
+a raw TCP connection with no Python in the data path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Dict, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+_OK = 0
+_EXISTS = -1
+_NOT_FOUND = -2
+_OOM = -3
+_SYS = -6
+_PROTO = -7
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            from ray_tpu import _native
+            lib = ctypes.CDLL(_native.lib_path("tpuxfer"))
+            lib.tpot_server_start.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_void_p)]
+            lib.tpot_server_stop.argtypes = [ctypes.c_void_p]
+            lib.tpot_server_stop.restype = None
+            lib.tpot_attach.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_void_p)]
+            lib.tpot_detach.argtypes = [ctypes.c_void_p]
+            lib.tpot_detach.restype = None
+            lib.tpot_fetch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_char_p]
+            _lib = lib
+    return _lib
+
+
+class TransferServer:
+    """Serves the local store's sealed objects over TCP (one per hostd)."""
+
+    def __init__(self, store_path: str, port: int = 0):
+        lib = _load()
+        out_port = ctypes.c_int()
+        srv = ctypes.c_void_p()
+        rc = lib.tpot_server_start(store_path.encode(), port,
+                                   ctypes.byref(out_port), ctypes.byref(srv))
+        if rc != _OK:
+            raise RuntimeError(f"transfer server start failed (rc={rc})")
+        self.port = out_port.value
+        self._srv = srv
+        self._closed = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            _load().tpot_server_stop(self._srv)
+
+
+# One fetch handle per (process, store path); attaching per fetch would
+# burn a client slot + mmap each time.
+_clients: Dict[str, ctypes.c_void_p] = {}
+_clients_lock = threading.Lock()
+
+
+def _client(store_path: str) -> ctypes.c_void_p:
+    with _clients_lock:
+        h = _clients.get(store_path)
+        if h is None:
+            lib = _load()
+            h = ctypes.c_void_p()
+            rc = lib.tpot_attach(store_path.encode(), ctypes.byref(h))
+            if rc != _OK:
+                raise RuntimeError(
+                    f"transfer client attach failed (rc={rc})")
+            _clients[store_path] = h
+        return h
+
+
+def fetch(store_path: str, host: str, port: int, oid: ObjectID) -> bool:
+    """Pull `oid` from host:port into the local store (sealed).
+
+    Returns True when the object is now locally available (fetched, or
+    already present), False when the remote does not have it.  Raises on
+    transport/allocation failures.  BLOCKING — call from an executor
+    thread, never the event loop.
+    """
+    rc = _load().tpot_fetch(_client(store_path), host.encode(), port,
+                            oid.binary())
+    if rc in (_OK, _EXISTS):
+        return True
+    if rc == _NOT_FOUND:
+        return False
+    if rc == _OOM:
+        from ray_tpu.exceptions import ObjectStoreFullError
+        raise ObjectStoreFullError(f"no room to receive {oid}")
+    raise RuntimeError(f"native fetch of {oid} from {host}:{port} "
+                       f"failed (rc={rc})")

@@ -93,7 +93,7 @@ class JobSupervisor:
         # honor it instead of launching the entrypoint.
         rec = _get_record(self.submission_id)
         if rec is not None and rec["status"] == JobStatus.STOPPED:
-            self.done = True
+            self._finish_without_run()
             return JobStatus.STOPPED
 
         env = dict(os.environ)
@@ -113,7 +113,7 @@ class JobSupervisor:
                 self.proc.wait(timeout=10)
             except Exception:
                 pass
-            self.done = True
+            self._finish_without_run()
             return JobStatus.STOPPED
         rec["status"] = JobStatus.RUNNING
         rec["start_time"] = time.time()
@@ -121,6 +121,14 @@ class JobSupervisor:
         threading.Thread(target=self._drain, daemon=True,
                          name="job-drain").start()
         return JobStatus.RUNNING
+
+    def _finish_without_run(self) -> None:
+        """Terminal without ever running the entrypoint (stopped before
+        start): mark done and self-clean the detached actor — the usual
+        self-exit lives at the end of _drain, which never runs here."""
+        import threading
+        self.done = True
+        threading.Timer(1.0, os._exit, args=(0,)).start()
 
     def _drain(self) -> None:
         for line in self.proc.stdout:
