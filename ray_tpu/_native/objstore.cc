@@ -45,7 +45,7 @@ constexpr uint64_t kMagic = 0x545055535452304bULL;  // "TPUSTR0K"
 constexpr uint32_t kIdSize = 28;
 constexpr uint64_t kAlign = 64;  // payload alignment: cacheline, XLA-friendly
 constexpr uint64_t kBlockHeader = 64;
-constexpr uint32_t kMaxClients = 64;
+constexpr uint32_t kMaxClients = 128;  // worker procs + transfer clients
 constexpr uint32_t kRefsPerClient = 4096;  // open-addressed, so keep <70% full
 
 // ---- error codes (mirrored in ray_tpu/_private/object_store.py) ----

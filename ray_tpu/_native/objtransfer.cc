@@ -51,6 +51,10 @@ constexpr uint32_t kMagic = 0x31585054;  // "TPX1" little-endian
 constexpr uint32_t kIdSize = 28;
 constexpr uint64_t kMaxObject = 1ULL << 40;
 constexpr int kIoTimeoutSec = 300;
+// Serving-side concurrency cap (reference: push_manager.h throttles
+// in-flight pushes).  Excess connections are shed; the puller falls back
+// to the chunk-RPC path.
+constexpr int kMaxConns = 64;
 
 enum {
   TPOT_OK = 0,
@@ -189,6 +193,12 @@ void* accept_main(void* argv) {
     }
     ConnArg* arg = new ConnArg{srv, fd};
     pthread_mutex_lock(&srv->mu);
+    if (srv->active >= kMaxConns) {
+      pthread_mutex_unlock(&srv->mu);
+      close(fd);
+      delete arg;
+      continue;
+    }
     srv->active++;
     pthread_mutex_unlock(&srv->mu);
     pthread_t t;
@@ -329,7 +339,17 @@ int tpot_fetch(void* h, const char* host, int port, const uint8_t* id) {
   int rc = tpus_obj_create(h, id, dsize, msize, &off);
   if (rc != 0) {
     close(fd);
-    return rc;  // TPOT_EXISTS / TPOT_OOM map 1:1 to tpus codes
+    if (rc == TPOT_EXISTS) {
+      // A concurrent puller owns the allocation; EXISTS only means
+      // "locally available" once that copy seals — wait for it.
+      uint64_t o, d, m;
+      if (tpus_obj_get(h, id, 60 * 1000, &o, &d, &m) == 0) {
+        tpus_obj_release(h, id);
+        return TPOT_EXISTS;
+      }
+      return TPOT_SYS;
+    }
+    return rc;  // TPOT_OOM etc. map 1:1 to tpus codes
   }
   uint8_t* base = tpus_base(h) + off;
   if (read_full(fd, base, dsize) != 0 ||

@@ -585,17 +585,19 @@ class CoreWorker:
         sem = asyncio.Semaphore(self.PULL_MAX_INFLIGHT)
         failed = []
 
+        from ray_tpu import protocol
+
         async def fetch(offset: int):
             length = min(self.PULL_CHUNK_BYTES, size - offset)
             async with sem:
                 chunk = await client.call(
                     "NodeManager", "PullObjectChunk",
-                    {"id": oid.binary(), "offset": offset,
-                     "length": length})
-            if not chunk.get("found"):
+                    protocol.pb.PullObjectChunkRequest(
+                        id=oid.binary(), offset=offset, length=length))
+            if not chunk.found:
                 failed.append(offset)
                 return
-            out[offset:offset + length] = chunk["data"]
+            out[offset:offset + length] = chunk.data
 
         results = await asyncio.gather(
             *[fetch(off) for off in range(0, size, self.PULL_CHUNK_BYTES)],

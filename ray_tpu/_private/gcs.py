@@ -259,15 +259,31 @@ class GcsServer:
         return {"ok": True}
 
     async def heartbeat(self, req):
-        nid = req["node_id"]
+        """Typed (protocol.pb.HeartbeatRequest) or legacy dict."""
+        from ray_tpu import protocol
+        typed = protocol.is_message(req)
+        if typed:
+            nid = NodeID(req.node_id)
+            available = dict(req.available.amounts)
+        else:
+            nid = req["node_id"]
+            available = req["available"]
+
+        def reply(*, reregister=False, shutdown=False):
+            if typed:
+                return protocol.pb.HeartbeatReply(
+                    shutdown=shutdown, reregister=reregister)
+            return {"ok": not reregister, "reregister": reregister,
+                    "shutdown": shutdown}
+
         info = self.nodes.get(nid)
         if info is None or not info.alive:
-            return {"ok": False, "reregister": True}
+            return reply(reregister=True)
         self.node_heartbeat[nid] = time.monotonic()
-        if info.resources_available != req["available"]:
-            info.resources_available = req["available"]
+        if info.resources_available != available:
+            info.resources_available = available
             self._bump()
-        return {"ok": True, "shutdown": self._shutdown.is_set()}
+        return reply(shutdown=self._shutdown.is_set())
 
     async def get_nodes(self, req):
         return {"nodes": list(self.nodes.values()),

@@ -483,55 +483,89 @@ class NodeDaemon:
 
     async def pull_object_meta(self, req):
         """Size/metadata probe for the chunked pull path (reference:
-        object_manager chunked transfer: ObjectBufferPool chunk layout)."""
+        object_manager chunked transfer: ObjectBufferPool chunk layout).
+        Accepts the typed contract (protocol.pb.PullObjectMetaRequest) or
+        the legacy dict, replying in kind."""
+        from ray_tpu import protocol
         from ray_tpu._private.ids import ObjectID
-        oid = ObjectID(req["id"])
+        typed = protocol.is_message(req)
+        id_binary = req.id if typed else req["id"]
+        oid = ObjectID(id_binary)
         xfer = getattr(self, "transfer_server", None)
         xfer_port = xfer.port if xfer is not None else None
+
+        def reply(found, data_size=0, metadata=b"", spilled=False,
+                  port=None):
+            if typed:
+                return protocol.pb.PullObjectMetaReply(
+                    found=found, data_size=data_size, metadata=metadata,
+                    spilled=spilled, transfer_port=port or 0)
+            return {"found": found, "data_size": data_size,
+                    "metadata": metadata, "spilled": spilled,
+                    "transfer_port": port}
+
         buf = self.store.get(oid, timeout_ms=0)
         if buf is not None:
             try:
-                return {"found": True, "data_size": len(buf.data),
-                        "metadata": buf.metadata, "spilled": False,
-                        "transfer_port": xfer_port}
+                return reply(True, len(buf.data), buf.metadata, False,
+                             xfer_port)
             finally:
                 buf.release()
-        spilled = self._spilled_meta(req["id"])
+        spilled = self._spilled_meta(id_binary)
         if spilled is None:
-            return {"found": False}
+            return reply(False)
         data_size, meta = spilled
         # Spilled payloads live on disk, not in the shm segment — the
         # native plane can't serve them; the puller stays on chunk RPCs.
-        return {"found": True, "data_size": data_size, "metadata": meta,
-                "spilled": True}
+        return reply(True, data_size, meta, True)
 
     async def pull_object_chunk(self, req):
         """One chunk of an object's payload (reference: push_manager.h
         chunked pushes with in-flight throttling — here the PULLER
-        throttles)."""
+        throttles).  Typed (PullObjectChunkRequest) or legacy dict."""
+        from ray_tpu import protocol
         from ray_tpu._private.ids import ObjectID
-        offset, length = req["offset"], req["length"]
-        buf = self.store.get(ObjectID(req["id"]), timeout_ms=0)
+        typed = protocol.is_message(req)
+        if typed:
+            id_binary, offset, length = req.id, req.offset, req.length
+        else:
+            id_binary, offset, length = req["id"], req["offset"], \
+                req["length"]
+
+        def reply(found, data=b""):
+            if typed:
+                return protocol.pb.PullObjectChunkReply(found=found,
+                                                        data=data)
+            return {"found": found, "data": data}
+
+        buf = self.store.get(ObjectID(id_binary), timeout_ms=0)
         if buf is not None:
             try:
-                return {"found": True,
-                        "data": bytes(buf.data[offset:offset + length])}
+                return reply(True, bytes(buf.data[offset:offset + length]))
             finally:
                 buf.release()
-        chunk = self._read_spilled_range(req["id"], offset, length)
+        chunk = self._read_spilled_range(id_binary, offset, length)
         if chunk is None:
-            return {"found": False}
-        return {"found": True, "data": chunk}
+            return reply(False)
+        return reply(True, chunk)
 
     async def push_object(self, req):
+        from ray_tpu import protocol
         from ray_tpu._private.ids import ObjectID
-        oid = ObjectID(req["id"])
+        typed = protocol.is_message(req)
+        if typed:
+            id_binary, data, metadata = req.id, req.data, req.metadata
+        else:
+            id_binary, data, metadata = req["id"], req["data"], \
+                req.get("metadata", b"")
+        oid = ObjectID(id_binary)
         if not self.store.contains(oid):
             try:
-                self.store.put_bytes(oid, req["data"], req.get("metadata", b""))
+                self.store.put_bytes(oid, data, metadata)
             except Exception as e:  # duplicate create race is fine
                 logger.debug("push_object: %s", e)
-        return {"ok": True}
+        return protocol.pb.PushObjectReply(ok=True) if typed \
+            else {"ok": True}
 
     async def free_object(self, req):
         from ray_tpu._private.ids import ObjectID
@@ -861,18 +895,20 @@ class NodeDaemon:
         )
 
     async def _heartbeat_loop(self):
+        from ray_tpu import protocol
         misses = 0
         while not self._shutdown.is_set():
             try:
-                reply = await self.gcs.call(
-                    "Gcs", "heartbeat",
-                    {"node_id": self.node_id,
-                     "available": dict(self.resources_available)},
-                    timeout=2)
+                hb = protocol.pb.HeartbeatRequest(
+                    node_id=self.node_id.binary())
+                for k, v in self.resources_available.items():
+                    hb.available.amounts[k] = v
+                reply = await self.gcs.call("Gcs", "heartbeat", hb,
+                                            timeout=2)
                 misses = 0
-                if reply.get("shutdown"):
+                if reply.shutdown:
                     self._shutdown.set()
-                if reply.get("reregister"):
+                if reply.reregister:
                     await self.gcs.call("Gcs", "register_node",
                                         {"info": self.node_info()})
             except Exception:
@@ -984,7 +1020,10 @@ class NodeDaemon:
         await self.pool.close_all()
         await self.gcs.close()
         if getattr(self, "transfer_server", None) is not None:
-            self.transfer_server.close()
+            # close() blocks in native code (join + drain, up to ~5s) —
+            # keep it off the event loop.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.transfer_server.close)
         self.store.close()
 
 

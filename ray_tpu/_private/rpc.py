@@ -30,17 +30,25 @@ _OPTIONS = [
 
 _RAW = b"\x01"  # payload is raw bytes
 _PKL = b"\x00"  # payload is pickled
+_PB = b"\x03"   # payload is a typed proto message (ray_tpu.protocol)
 
 
 def _dumps(obj: Any) -> bytes:
     if type(obj) is bytes:
         return _RAW + obj
+    if hasattr(obj, "DESCRIPTOR") and hasattr(obj, "SerializeToString"):
+        from ray_tpu import protocol
+        return _PB + protocol.encode(obj)
     return _PKL + pickle.dumps(obj, protocol=5)
 
 
 def _loads(data: bytes) -> Any:
-    if data[:1] == _RAW:
+    tag = data[:1]
+    if tag == _RAW:
         return data[1:]
+    if tag == _PB:
+        from ray_tpu import protocol
+        return protocol.decode(data[1:])
     return pickle.loads(data[1:])
 
 

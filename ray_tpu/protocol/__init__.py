@@ -1,0 +1,41 @@
+"""Typed proto contracts for the RPC layer.
+
+Reference parity: src/ray/protobuf/ (node_manager.proto,
+gcs_service.proto, common.proto) — the contracts a non-Python peer needs,
+generated to Python via scripts/gen_proto.sh and checked in.
+
+The transport (rpc.py) carries these with a proto payload marker: wire
+bytes are `\\x03 | u8 name_len | message name | SerializeToString()`.
+The registry below maps short names back to classes on receive.
+"""
+
+from ray_tpu.protocol import raytpu_pb2 as pb
+
+REGISTRY = {
+    cls.DESCRIPTOR.name: cls
+    for cls in (
+        pb.ResourcesP,
+        pb.PullObjectMetaRequest, pb.PullObjectMetaReply,
+        pb.PullObjectChunkRequest, pb.PullObjectChunkReply,
+        pb.PushObjectRequest, pb.PushObjectReply,
+        pb.HeartbeatRequest, pb.HeartbeatReply,
+    )
+}
+
+
+def encode(msg) -> bytes:
+    name = type(msg).DESCRIPTOR.name.encode()
+    return bytes([len(name)]) + name + msg.SerializeToString()
+
+
+def decode(data: bytes):
+    n = data[0]
+    name = data[1:1 + n].decode()
+    cls = REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(f"unknown proto message {name!r}")
+    return cls.FromString(data[1 + n:])
+
+
+def is_message(obj) -> bool:
+    return hasattr(obj, "DESCRIPTOR") and hasattr(obj, "SerializeToString")

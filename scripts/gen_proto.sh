@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate ray_tpu/protocol/raytpu_pb2.py from raytpu.proto.
+# The generated file is checked in (no protoc needed at runtime).
+set -e
+cd "$(dirname "$0")/.."
+protoc --python_out=ray_tpu/protocol --proto_path=ray_tpu/protocol \
+    ray_tpu/protocol/raytpu.proto
+echo "generated ray_tpu/protocol/raytpu_pb2.py"

@@ -1,0 +1,93 @@
+"""Typed proto contract tests (reference: src/ray/protobuf/ — typed RPC
+contracts; here routed over the string-routed transport with a proto
+payload marker)."""
+
+import asyncio
+
+import pytest
+
+from ray_tpu import protocol
+from ray_tpu.protocol import pb
+
+
+def test_encode_decode_roundtrip():
+    m = pb.PullObjectMetaReply(found=True, data_size=123,
+                               metadata=b"\x00meta", spilled=False,
+                               transfer_port=40001)
+    out = protocol.decode(protocol.encode(m))
+    assert out.found and out.data_size == 123
+    assert out.metadata == b"\x00meta"
+    assert out.transfer_port == 40001
+
+
+def test_decode_unknown_message_rejected():
+    blob = bytes([7]) + b"Unknown" + b"xxxx"
+    with pytest.raises(ValueError):
+        protocol.decode(blob)
+
+
+def test_rpc_carries_proto_messages_without_pickle():
+    """A proto request/reply rides the transport under the \\x03 marker —
+    the wire payload is protobuf, not pickle."""
+    from ray_tpu._private import rpc as rpc_mod
+    from ray_tpu._private.rpc import RpcClient, RpcServer
+
+    # The marker encoding must keep proto distinct from raw/pickle.
+    wire = rpc_mod._dumps(pb.HeartbeatRequest(node_id=b"n" * 28))
+    assert wire[:1] == rpc_mod._PB
+    assert b"pickle" not in wire
+
+    async def main():
+        server = RpcServer("127.0.0.1")
+        seen = {}
+
+        async def handler(req):
+            assert isinstance(req, pb.HeartbeatRequest)
+            seen["node"] = req.node_id
+            return pb.HeartbeatReply(shutdown=False, reregister=True)
+
+        server.register("Gcs", "HeartbeatP", handler)
+        port = await server.start(0)
+        client = RpcClient(f"127.0.0.1:{port}")
+        try:
+            reply = await client.call(
+                "Gcs", "HeartbeatP",
+                pb.HeartbeatRequest(node_id=b"n" * 28), timeout=10)
+            assert isinstance(reply, pb.HeartbeatReply)
+            assert reply.reregister and not reply.shutdown
+            assert seen["node"] == b"n" * 28
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_object_plane_rides_proto(tmp_path):
+    """The hostd object-plane methods accept and emit typed messages
+    end-to-end through a live cluster (PullObjectMeta probe)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import api
+
+    ray_tpu.init(num_cpus=2, object_store_memory=64 << 20)
+    try:
+        ref = ray_tpu.put(np.arange(1 << 20, dtype=np.uint8))
+        w = api._worker
+        st = w.objects[ref.id]
+        (loc,) = tuple(st.locations)
+        nodes = w.io.run(w._node_table())
+
+        async def probe():
+            client = w.pool.get(nodes[loc])
+            return await client.call(
+                "NodeManager", "PullObjectMeta",
+                pb.PullObjectMetaRequest(id=ref.id.binary()))
+
+        reply = w.io.run(probe())
+        assert isinstance(reply, pb.PullObjectMetaReply)
+        assert reply.found and reply.data_size > 1 << 20
+        assert reply.transfer_port > 0
+    finally:
+        ray_tpu.shutdown()
