@@ -41,7 +41,8 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x545055535452304bULL;  // "TPUSTR0K"
+// Format version 1: header carries max_clients (bump on layout change).
+constexpr uint64_t kMagic = 0x545055535452314bULL;  // "TPUSTR1K"
 constexpr uint32_t kIdSize = 28;
 constexpr uint64_t kAlign = 64;  // payload alignment: cacheline, XLA-friendly
 constexpr uint64_t kBlockHeader = 64;
@@ -108,7 +109,9 @@ struct Header {
   uint64_t total_size;
   uint64_t table_off;
   uint32_t max_objects;
-  uint32_t eviction_off;  // 1 = LRU eviction disabled (spilling owns space)
+  uint32_t eviction_off;  // 1 = LRU eviction disabled
+  uint32_t max_clients;   // client-slot capacity fixed at create time
+  uint32_t pad_;
   uint64_t clients_off;
   uint64_t heap_off;
   uint64_t heap_size;
@@ -367,7 +370,7 @@ bool evict_one(Handle* h) {
 bool reclaim_dead_clients(Handle* h) {
   bool any = false;
   ClientSlot* cs = clients(h);
-  for (uint32_t ci = 0; ci < kMaxClients; ci++) {
+  for (uint32_t ci = 0; ci < h->hdr->max_clients; ci++) {
     ClientSlot* c = &cs[ci];
     if (c->pid == 0) continue;
     if (kill(c->pid, 0) == 0 || errno != ESRCH) continue;  // still alive
@@ -399,7 +402,7 @@ bool reclaim_dead_clients(Handle* h) {
 int32_t register_client(Handle* h) {
   ClientSlot* cs = clients(h);
   int32_t pid = int32_t(getpid());
-  for (uint32_t i = 0; i < kMaxClients; i++) {
+  for (uint32_t i = 0; i < h->hdr->max_clients; i++) {
     if (cs[i].pid == 0 ||
         (kill(cs[i].pid, 0) != 0 && errno == ESRCH)) {
       memset(&cs[i], 0, sizeof(ClientSlot));
@@ -443,6 +446,7 @@ int tpus_create(const char* path, uint64_t heap_size, uint32_t max_objects,
   hdr->total_size = total;
   hdr->table_off = table_off;
   hdr->max_objects = max_objects;
+  hdr->max_clients = kMaxClients;
   hdr->clients_off = clients_off;
   hdr->heap_off = heap_off;
   hdr->heap_size = heap_size;

@@ -341,11 +341,20 @@ int tpot_fetch(void* h, const char* host, int port, const uint8_t* id) {
     close(fd);
     if (rc == TPOT_EXISTS) {
       // A concurrent puller owns the allocation; EXISTS only means
-      // "locally available" once that copy seals — wait for it.
-      uint64_t o, d, m;
-      if (tpus_obj_get(h, id, 60 * 1000, &o, &d, &m) == 0) {
-        tpus_obj_release(h, id);
-        return TPOT_EXISTS;
+      // "locally available" once that copy seals.  Poll rather than wait
+      // on the seal condvar: if the racing puller ABORTS, the slot
+      // disappears and a condvar wait would sit out its full timeout.
+      for (int i = 0; i < 60 * 100; i++) {
+        uint64_t o, d, m;
+        int grc = tpus_obj_get(h, id, 0, &o, &d, &m);
+        if (grc == 0) {
+          tpus_obj_release(h, id);
+          return TPOT_EXISTS;
+        }
+        if (grc != -5 /* TPUS_BAD_STATE: created, unsealed */) {
+          return TPOT_NOT_FOUND;  // racing copy aborted/evicted
+        }
+        usleep(10 * 1000);
       }
       return TPOT_SYS;
     }

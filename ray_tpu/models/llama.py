@@ -1,0 +1,272 @@
+"""Llama model family: RMSNorm + RoPE + SwiGLU + grouped-query attention.
+
+Design follows models/gpt.py (no reference counterpart — Ray hosts models
+rather than shipping them; BASELINE.md's north star names a Llama-2-7B
+fine-tune):
+  * pure functional params-pytree + jittable forward (pjit/GSPMD-ready);
+  * layers stacked on a leading dim, applied with `lax.scan`;
+  * every param leaf carries a logical sharding spec (parallel/sharding.py
+    rules place DP/FSDP/TP; "kv_heads" shards GQA kv projections);
+  * flash attention (Pallas) on one chip, ring attention over a seq axis;
+  * rotary embeddings computed on the fly (no position table);
+  * `jax.checkpoint` remat for the big configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.mesh import mesh_axis_size
+from ray_tpu.parallel.sharding import (
+    tree_shardings, with_logical_constraint)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layers: int = 32
+    d_model: int = 4096
+    n_heads: int = 32
+    n_kv_heads: int = 32          # < n_heads = grouped-query attention
+    d_ff: int = 11008             # SwiGLU hidden
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    scan_unroll: int = 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+CONFIGS = {
+    "llama-tiny": LlamaConfig(vocab_size=512, n_layers=2, d_model=64,
+                              n_heads=4, n_kv_heads=2, d_ff=128,
+                              max_seq_len=128, dtype=jnp.float32),
+    "llama-1b": LlamaConfig(vocab_size=32000, n_layers=22, d_model=2048,
+                            n_heads=32, n_kv_heads=4, d_ff=5632,
+                            max_seq_len=2048),
+    "llama2-7b": LlamaConfig(remat=True),
+    "llama3-8b": LlamaConfig(vocab_size=128256, n_layers=32, d_model=4096,
+                             n_heads=32, n_kv_heads=8, d_ff=14336,
+                             max_seq_len=8192, rope_theta=500000.0,
+                             remat=True),
+}
+
+
+def param_specs(config: LlamaConfig) -> dict:
+    blocks = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "kv"),
+        "wk": ("layers", "embed", "kv_heads", "kv"),
+        "wv": ("layers", "embed", "kv_heads", "kv"),
+        "wo": ("layers", "heads", "kv", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    return {
+        "tok_embed": ("vocab", None),
+        "blocks": blocks,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    c = config
+    n, d, h, kh, dh, f = (c.n_layers, c.d_model, c.n_heads, c.n_kv_heads,
+                          c.head_dim, c.d_ff)
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)
+
+    blocks = {
+        "attn_norm": jnp.ones((n, d)),
+        "wq": dense(next(keys), (n, d, h, dh), d),
+        "wk": dense(next(keys), (n, d, kh, dh), d),
+        "wv": dense(next(keys), (n, d, kh, dh), d),
+        "wo": dense(next(keys), (n, h, dh, d), h * dh) / np.sqrt(2 * n),
+        "mlp_norm": jnp.ones((n, d)),
+        "w_gate": dense(next(keys), (n, d, f), d),
+        "w_up": dense(next(keys), (n, d, f), d),
+        "w_down": dense(next(keys), (n, f, d), f) / np.sqrt(2 * n),
+    }
+    return {
+        "tok_embed": jax.random.normal(next(keys), (c.vocab_size, d)) * 0.02,
+        "blocks": blocks,
+        "final_norm": jnp.ones((d,)),
+        "lm_head": dense(next(keys), (d, c.vocab_size), d),
+    }
+
+
+def shard_params(params: dict, mesh, config: LlamaConfig, rules=None) -> dict:
+    return jax.device_put(params,
+                          tree_shardings(mesh, param_specs(config), rules))
+
+
+def num_params(config: LlamaConfig) -> int:
+    shapes = jax.eval_shape(partial(init_params, config), jax.random.key(0))
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
+
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def _rope(x, theta: float, offset: int = 0):
+    """Rotary position embedding over [B, L, H, K] (rotate-half pairing:
+    the head dim splits into two halves treated as (real, imag))."""
+    b, l, h, k = x.shape
+    half = k // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.arange(offset, offset + l, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]                   # [L, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _block(x, p, config: LlamaConfig, mesh):
+    c = config
+    h = _rmsnorm(x, p["attn_norm"], c.norm_eps)
+    q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(h.dtype))
+    k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(h.dtype))
+    v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(h.dtype))
+    q = _rope(q, c.rope_theta)
+    k = _rope(k, c.rope_theta)
+    if c.q_per_kv > 1:
+        # GQA: each kv head serves q_per_kv query heads.  Materializing
+        # the repeat keeps the attention kernels head-uniform; XLA fuses
+        # the broadcast into the kernel operand load.
+        k = jnp.repeat(k, c.q_per_kv, axis=2)
+        v = jnp.repeat(v, c.q_per_kv, axis=2)
+    q = with_logical_constraint(q, ("batch", "length", "heads", "kv"),
+                                mesh=mesh)
+    if mesh is not None and mesh_axis_size(mesh, "seq") > 1:
+        attn = ring_attention(q, k, v, mesh=mesh, causal=True)
+    else:
+        attn = flash_attention(q, k, v, causal=True)
+    x = x + jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(h.dtype))
+
+    h = _rmsnorm(x, p["mlp_norm"], c.norm_eps)
+    gate = jax.nn.silu(jnp.einsum("bld,df->blf", h,
+                                  p["w_gate"].astype(h.dtype)))
+    up = jnp.einsum("bld,df->blf", h, p["w_up"].astype(h.dtype))
+    hidden = with_logical_constraint(gate * up, ("batch", "length", "mlp"),
+                                     mesh=mesh)
+    x = x + jnp.einsum("blf,fd->bld", hidden, p["w_down"].astype(h.dtype))
+    return with_logical_constraint(x, ("batch", "length", "act_embed"),
+                                   mesh=mesh)
+
+
+def forward_trunk(params: dict, tokens: jax.Array, config: LlamaConfig,
+                  mesh=None) -> jax.Array:
+    """tokens [B, L] -> hidden states [B, L, D] (pre-head, normed)."""
+    c = config
+    x = params["tok_embed"][tokens].astype(c.dtype)
+    x = with_logical_constraint(x, ("batch", "length", "act_embed"),
+                                mesh=mesh)
+    block = partial(_block, config=c, mesh=mesh)
+    if c.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(x, layer_params):
+        return block(x, layer_params), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"],
+                        unroll=min(c.scan_unroll, c.n_layers))
+    return _rmsnorm(x, params["final_norm"], c.norm_eps)
+
+
+def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
+            mesh=None) -> jax.Array:
+    """tokens [B, L] -> logits [B, L, V]."""
+    x = forward_trunk(params, tokens, config, mesh)
+    logits = jnp.einsum("bld,dv->blv", x,
+                        params["lm_head"].astype(config.dtype))
+    return with_logical_constraint(logits, ("batch", "length", "vocab"),
+                                   mesh=mesh)
+
+
+def loss_fn(params: dict, batch: dict, config: LlamaConfig, mesh=None):
+    """Next-token cross-entropy; same shift/mask scheme as gpt.loss_fn
+    (full-length forward, rolled targets, last position masked).  Single
+    chip rides the fused chunked cross-entropy; under a mesh the standard
+    path leaves logits sharding to GSPMD."""
+    from ray_tpu.ops.cross_entropy import fused_cross_entropy
+
+    c = config
+    tokens = batch["tokens"]
+    targets = jnp.roll(tokens, -1, axis=1)
+    valid = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        valid = valid * mask
+
+    multichip = mesh is not None and any(
+        s > 1 for s in mesh.shape.values())
+    if not multichip:
+        x = forward_trunk(params, tokens, c, mesh)
+        b, l, d = x.shape
+        return fused_cross_entropy(
+            x.reshape(b * l, d), params["lm_head"].astype(c.dtype),
+            targets.reshape(-1), valid.reshape(-1))
+
+    logits = forward(params, tokens, c, mesh)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def make_train_step(config: LlamaConfig, optimizer, mesh=None):
+    """(init_state, train_step) — same contract as gpt.make_train_step:
+    under a mesh both params and optimizer state are sharded (ZeRO-3 via
+    GSPMD propagation) and XLA inserts the collectives."""
+    import optax
+
+    def init_state(key):
+        params = init_params(config, key)
+        opt_state = optimizer.init(params)
+        if mesh is not None:
+            from ray_tpu.parallel.sharding import shard_opt_state
+            shardings = tree_shardings(mesh, param_specs(config))
+            opt_state = shard_opt_state(opt_state, params, shardings, mesh)
+            params = shard_params(params, mesh, config)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch, config, mesh)
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    return init_state, train_step

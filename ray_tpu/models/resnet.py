@@ -1,0 +1,159 @@
+"""ResNet image models (flax), TPU-first.
+
+Design notes (no reference counterpart — Ray hosts models; BASELINE.md's
+AIR end-to-end target is "Data preprocessing -> Train -> Serve, ResNet-50
+ImageNet"):
+  * GroupNorm instead of BatchNorm: stateless normalization keeps the
+    train step a pure function of (params, batch) — no batch-stat sync
+    collectives across data-parallel replicas and no mutable state to
+    thread through pjit (the standard TPU recipe for functional training);
+  * NHWC layout (XLA's native conv layout on TPU MXU);
+  * data parallelism via the same logical-rules mesh as the transformers:
+    batch splits over (data, fsdp), params replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)   # resnet18
+    num_classes: int = 10
+    width: int = 64
+    bottleneck: bool = False
+    cifar_stem: bool = True    # 3x3/1 stem (32x32 inputs) vs 7x7/2+pool
+    num_groups: int = 8        # GroupNorm groups
+    dtype: Any = jnp.float32
+
+
+CONFIGS = {
+    "resnet18-cifar": ResNetConfig(),
+    "resnet18": ResNetConfig(cifar_stem=False),
+    "resnet50": ResNetConfig(stage_sizes=(3, 4, 6, 3), bottleneck=True,
+                             cifar_stem=False, num_classes=1000,
+                             dtype=jnp.bfloat16),
+}
+
+
+class _Block(nn.Module):
+    filters: int
+    strides: int
+    bottleneck: bool
+    num_groups: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        conv = lambda f, k, s: nn.Conv(f, (k, k), (s, s), padding="SAME",
+                                       use_bias=False, dtype=self.dtype)
+        norm = lambda: nn.GroupNorm(num_groups=self.num_groups,
+                                    dtype=self.dtype)
+        out_filters = self.filters * (4 if self.bottleneck else 1)
+        residual = x
+        if residual.shape[-1] != out_filters or self.strides != 1:
+            residual = conv(out_filters, 1, self.strides)(x)
+            residual = norm()(residual)
+        if self.bottleneck:
+            y = nn.relu(norm()(conv(self.filters, 1, 1)(x)))
+            y = nn.relu(norm()(conv(self.filters, 3, self.strides)(y)))
+            y = norm()(conv(out_filters, 1, 1)(y))
+        else:
+            y = nn.relu(norm()(conv(self.filters, 3, self.strides)(x)))
+            y = norm()(conv(out_filters, 3, 1)(y))
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        conv = lambda f, k, s: nn.Conv(f, (k, k), (s, s), padding="SAME",
+                                       use_bias=False, dtype=c.dtype)
+        x = x.astype(c.dtype)
+        if c.cifar_stem:
+            x = conv(c.width, 3, 1)(x)
+        else:
+            x = conv(c.width, 7, 2)(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.GroupNorm(num_groups=c.num_groups, dtype=c.dtype)(x))
+        for i, n_blocks in enumerate(c.stage_sizes):
+            for j in range(n_blocks):
+                x = _Block(filters=c.width * 2 ** i,
+                           strides=2 if j == 0 and i > 0 else 1,
+                           bottleneck=c.bottleneck,
+                           num_groups=c.num_groups, dtype=c.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(c.num_classes, dtype=jnp.float32)(x)
+
+
+def make_model(config: ResNetConfig, input_shape=(32, 32, 3)):
+    """(init_params(rng), apply(params, images)) — images NHWC float."""
+    model = ResNet(config=config)
+
+    def init_params(rng):
+        dummy = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+        return model.init(rng, dummy)
+
+    return init_params, model.apply
+
+
+def num_params(config: ResNetConfig, input_shape=(32, 32, 3)) -> int:
+    init, _ = make_model(config, input_shape)
+    shapes = jax.eval_shape(init, jax.random.key(0))
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
+
+
+def make_train_step(config: ResNetConfig, optimizer, mesh=None,
+                    input_shape=(32, 32, 3)):
+    """(init_state, train_step): batch = {"images" [B,H,W,C], "labels" [B]}.
+    Under a mesh, the batch is expected sharded over (data, fsdp) and
+    params replicate; grads ride GSPMD's psum."""
+    import optax
+
+    from ray_tpu.parallel.sharding import with_logical_constraint
+
+    init_p, apply = make_model(config, input_shape)
+
+    def loss_fn(params, batch):
+        images = batch["images"]
+        if mesh is not None:
+            images = with_logical_constraint(
+                images, ("batch", None, None, None), mesh=mesh)
+        logits = apply(params, images)
+        labels = batch["labels"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels)
+                       .astype(jnp.float32))
+        return nll.mean(), acc
+
+    def init_state(key):
+        params = init_p(key)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            params = jax.device_put(
+                params, NamedSharding(mesh, PartitionSpec()))
+        return {"params": params, "opt_state": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def train_step(state, batch):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], batch)
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss, "accuracy": acc})
+
+    return init_state, train_step

@@ -30,6 +30,7 @@ DEFAULT_RULES: Mapping[str, Union[str, Tuple[str, ...], None]] = {
                                  # covers fsdp; XLA all-gathers params JIT)
     "mlp": "tensor",             # ffn hidden: megatron column/row split
     "heads": "tensor",           # attention heads: megatron split
+    "kv_heads": "tensor",        # GQA key/value head groups (llama)
     "kv": None,                  # per-head dim: never sharded
     # Vocab dim carries BOTH the tensor and fsdp shards of the embedding
     # table.  Sharding the table's embed dim over fsdp instead forces the

