@@ -351,8 +351,11 @@ int tpot_fetch(void* h, const char* host, int port, const uint8_t* id) {
           tpus_obj_release(h, id);
           return TPOT_EXISTS;
         }
-        if (grc != -5 /* TPUS_BAD_STATE: created, unsealed */) {
+        if (grc == -2 /* TPUS_NOT_FOUND */) {
           return TPOT_NOT_FOUND;  // racing copy aborted/evicted
+        }
+        if (grc != -5 /* TPUS_BAD_STATE: created, unsealed */) {
+          return TPOT_SYS;  // lock/store failure — not an absence signal
         }
         usleep(10 * 1000);
       }

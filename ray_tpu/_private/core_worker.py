@@ -1678,11 +1678,32 @@ class _KeyScheduler:
             if spec.placement_group is not None:
                 node, bundle = await worker._resolve_bundle(spec)
             else:
+                # Locality hint: count owned object args per holding node
+                # (reference: lease_policy.h LocalityAwareLeasePolicy asks
+                # the locality-data provider for object-bytes-per-node).
+                # Read args off the task actually WAITING, not proto_spec —
+                # tasks sharing a scheduling key differ in their args, and
+                # the first-ever spec's locations must not steer every
+                # later lease (reference keys include depended_object_ids).
+                loc_spec = self.queue[0][0] if self.queue else spec
+                locality: dict[str, int] = {}
+                if spec.scheduling_strategy in (None, "DEFAULT"):
+                    from ray_tpu._private.protocol import RefArg
+                    from ray_tpu._private.ids import ObjectID
+                    ref_args = [a for a in list(loc_spec.args)
+                                + list(loc_spec.kwargs.values())
+                                if isinstance(a, RefArg)]
+                    for a in ref_args:
+                        st = worker.objects.get(ObjectID(a.id_binary))
+                        if st is not None:
+                            for loc in st.locations:
+                                locality[loc] = locality.get(loc, 0) + 1
                 pick = await worker.gcs.call("Gcs", "pick_node", {
                     "resources": spec.resources.to_dict(),
                     "strategy": spec.scheduling_strategy,
                     "exclude": self.exclude,
                     "node_affinity": spec.node_affinity,
+                    "locality": locality or None,
                 })
                 node = pick["node"]
             if node is None:

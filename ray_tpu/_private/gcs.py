@@ -899,6 +899,7 @@ class GcsServer:
             exclude=set(req.get("exclude") or ()),
             affinity=req.get("node_affinity"),
             affinity_soft=req.get("node_affinity_soft", True),
+            locality=req.get("locality"),
         )
         return {"node": node}
 

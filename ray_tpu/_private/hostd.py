@@ -74,6 +74,21 @@ def detect_resources() -> dict:
         n = float(os.environ["RAY_TPU_NUM_TPUS"])
         if n > 0:
             res["TPU"] = n
+    # Schedulable memory: 70% of system RAM (reference: resource_spec.py
+    # caps the memory resource below total so daemons/OS keep headroom).
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    res["memory"] = float(int(line.split()[1]) * 1024 * 0.7)
+                    break
+    except OSError:
+        pass
+    # Accelerator type advertisement (reference: accelerator_type:<T>
+    # node resource; util/accelerators knows NVIDIA only — TPU gens here).
+    acc = os.environ.get("RAY_TPU_ACCELERATOR_TYPE")
+    if acc:
+        res[f"accelerator_type:{acc}"] = 1.0
     return res
 
 

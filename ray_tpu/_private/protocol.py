@@ -45,11 +45,18 @@ class Resources:
         cpu = opts.get("num_cpus")
         tpu = opts.get("num_tpus")
         mem = opts.get("memory")
+        custom = dict(opts.get("resources") or {})
+        # accelerator_type targets nodes advertising that hardware
+        # (reference: ray_option_utils.py accelerator_type:74 — adds a
+        # fractional accelerator_type:<T> resource demand).
+        acc = opts.get("accelerator_type")
+        if acc:
+            custom.setdefault(f"accelerator_type:{acc}", 0.001)
         return cls(
             cpu=default_cpu if cpu is None else float(cpu),
             tpu=0.0 if tpu is None else float(tpu),
             memory=0.0 if mem is None else float(mem),
-            custom=dict(opts.get("resources") or {}),
+            custom=custom,
         )
 
 
@@ -156,6 +163,7 @@ def option_defaults(for_actor: bool = False) -> dict:
     """The @remote option surface (reference: _private/ray_option_utils.py)."""
     common = {
         "num_cpus": None, "num_tpus": None, "memory": None, "resources": None,
+        "accelerator_type": None,
         "runtime_env": None, "scheduling_strategy": None, "name": None,
         "placement_group": None, "placement_group_bundle_index": -1,
         "_node_id": None,

@@ -38,7 +38,8 @@ def _utilization(node: NodeInfo) -> float:
 
 def pick_node(nodes: list[NodeInfo], demand: dict, strategy: str = "DEFAULT",
               exclude: set | None = None, affinity=None,
-              affinity_soft: bool = True) -> NodeInfo | None:
+              affinity_soft: bool = True,
+              locality: dict | None = None) -> NodeInfo | None:
     """Returns the target node, or None only if NO node's total capacity can
     ever satisfy the demand (infeasible).  When everything is momentarily
     busy, a feasible node is still returned — the lease queues at its daemon,
@@ -46,6 +47,7 @@ def pick_node(nodes: list[NodeInfo], demand: dict, strategy: str = "DEFAULT",
     exclude = exclude or set()
     candidates = [n for n in nodes if n.node_id not in exclude
                   and _fits(n.resources_available, demand)]
+    fits_now = bool(candidates)
     if not candidates:
         candidates = [n for n in nodes if n.node_id not in exclude
                       and _fits(n.resources_total, demand)]
@@ -61,6 +63,20 @@ def pick_node(nodes: list[NodeInfo], demand: dict, strategy: str = "DEFAULT",
         # Least utilized first (spread_scheduling_policy.cc round-robins over
         # feasible nodes; least-utilized achieves the same steady state).
         return min(candidates, key=_utilization)
+    if strategy == "RANDOM":
+        # reference: random_scheduling_policy.cc — uniform over feasible.
+        import random
+        return random.choice(candidates)
+    if locality and fits_now:
+        # Locality-aware lease target: run where the task's object args
+        # already live (reference: lease_policy.h LocalityAwareLeasePolicy
+        # — best node by object bytes local).  Only among nodes with free
+        # capacity RIGHT NOW (a saturated holder would queue the lease;
+        # the reference equivalent is raylet spillback), else hybrid.
+        best = max(candidates,
+                   key=lambda n: locality.get(n.node_id.hex(), 0))
+        if locality.get(best.node_id.hex(), 0) > 0:
+            return best
     # Hybrid/DEFAULT: pack onto already-busy nodes while below the threshold
     # so small tasks don't fragment the fleet, else fall back to best
     # (least-utilized) node.

@@ -1,0 +1,43 @@
+"""Shared train-step factory for the functional LM families (gpt, llama).
+
+One implementation of the (init_state, train_step) contract: under a mesh,
+params AND optimizer state are sharded (ZeRO-3 via GSPMD propagation
+through jit(optimizer.init)) and XLA inserts the collectives; train_step
+is jittable with donation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_train_step(config, optimizer, mesh, *, init_params, loss_fn,
+                    param_specs):
+    """`init_params(config, key)`, `loss_fn(params, batch, config, mesh)`,
+    `param_specs(config)` define the family; everything else is shared."""
+    import optax
+
+    def init_state(key):
+        params = init_params(config, key)
+        opt_state = optimizer.init(params)
+        if mesh is not None:
+            from ray_tpu.parallel.sharding import (
+                shard_opt_state, tree_shardings)
+            shardings = tree_shardings(mesh, param_specs(config))
+            opt_state = shard_opt_state(opt_state, params, shardings, mesh)
+            params = jax.device_put(params, shardings)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch, config, mesh)
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    return init_state, train_step

@@ -319,31 +319,11 @@ def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):
 
 
 def make_train_step(config: GPTConfig, optimizer, mesh=None):
-    """Returns (init_state, train_step).  train_step is jittable; under a
-    mesh, init_state shards params AND optimizer state (ZeRO-3: Adam
-    moments inherit each param's sharding via GSPMD propagation through
+    """Returns (init_state, train_step) — the shared functional-LM
+    contract (models/_functional.py): jittable train_step; under a mesh,
+    params AND optimizer state are sharded (ZeRO-3: Adam moments inherit
+    each param's sharding via GSPMD propagation through
     jit(optimizer.init)) and XLA inserts the collectives."""
-    import optax
-
-    def init_state(key):
-        params = init_params(config, key)
-        opt_state = optimizer.init(params)
-        if mesh is not None:
-            from ray_tpu.parallel.sharding import shard_opt_state
-            shardings = tree_shardings(mesh, param_specs(config))
-            opt_state = shard_opt_state(opt_state, params, shardings, mesh)
-            params = shard_params(params, mesh, config)
-        return {"params": params, "opt_state": opt_state,
-                "step": jnp.zeros((), jnp.int32)}
-
-    def train_step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], batch, config, mesh)
-        updates, opt_state = optimizer.update(grads, state["opt_state"],
-                                              state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        return ({"params": params, "opt_state": opt_state,
-                 "step": state["step"] + 1},
-                {"loss": loss})
-
-    return init_state, train_step
+    from ray_tpu.models._functional import make_train_step as _shared
+    return _shared(config, optimizer, mesh, init_params=init_params,
+                   loss_fn=loss_fn, param_specs=param_specs)

@@ -1,0 +1,138 @@
+"""multiprocessing.Pool drop-in over cluster tasks.
+
+Reference parity: python/ray/util/multiprocessing/ (Pool shim — the
+standard-library Pool API executed as Ray tasks so existing Pool code
+scales past one machine).  Each submission is one task; `chunksize`
+batches items per task as in the stdlib.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        results = ray_tpu.get(self._refs, timeout=timeout)
+        if self._single:
+            return results[0][0]   # one chunk of one item
+        return list(itertools.chain.from_iterable(results))
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            ray_tpu.get(self._refs, timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """stdlib-compatible surface: apply/apply_async/map/map_async/starmap/
+    imap/imap_unordered/close/terminate/join, plus context-manager use."""
+
+    def __init__(self, processes: Optional[int] = None, *,
+                 ray_remote_args: Optional[dict] = None):
+        if ray_tpu.api._worker is None:
+            ray_tpu.init()
+        self._size = processes or int(
+            ray_tpu.cluster_resources().get("CPU", 1))
+        args = dict(ray_remote_args or {})
+        args.setdefault("num_cpus", 1)
+
+        @ray_tpu.remote(**args)
+        def _run_chunk(fn, chunk, star):
+            return [fn(*item) if star else fn(item) for item in chunk]
+
+        self._run_chunk = _run_chunk
+        self._closed = False
+
+    # -- helpers --
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._size * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    # -- stdlib surface --
+
+    def apply(self, fn: Callable, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args=(), kwds=None) -> AsyncResult:
+        self._check_open()
+        kwds = kwds or {}
+        ref = self._run_chunk.remote(
+            lambda *a: fn(*a, **kwds), [tuple(args)], True)
+        return AsyncResult([ref], single=True)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check_open()
+        refs = [self._run_chunk.remote(fn, chunk, False)
+                for chunk in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs, single=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_open()
+        refs = [self._run_chunk.remote(fn, chunk, True)
+                for chunk in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs, single=False).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        self._check_open()
+        for chunk_ref in [self._run_chunk.remote(fn, c, False)
+                          for c in self._chunks(iterable, chunksize)]:
+            yield from ray_tpu.get(chunk_ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        self._check_open()
+        pending = [self._run_chunk.remote(fn, c, False)
+                   for c in self._chunks(iterable, chunksize)]
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(done[0])
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

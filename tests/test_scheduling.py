@@ -1,0 +1,92 @@
+"""Scheduling-policy and option-surface tests (reference:
+raylet/scheduling/policy/* + scheduling_policy_test.cc's fake-snapshot
+style, _private/ray_option_utils.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import scheduler as sched
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.protocol import NodeInfo, Resources
+
+
+def _node(i, cpu_total=4.0, cpu_avail=None, extra=None):
+    total = {"CPU": cpu_total, **(extra or {})}
+    avail = dict(total)
+    if cpu_avail is not None:
+        avail["CPU"] = cpu_avail
+    return NodeInfo(node_id=NodeID(bytes([i]) * 20), address=f"n{i}:1",
+                    hostname=f"h{i}", store_path="",
+                    resources_total=total, resources_available=avail)
+
+
+def test_random_policy_uniform_over_feasible():
+    nodes = [_node(1), _node(2), _node(3, cpu_avail=0.0, cpu_total=0.0)]
+    seen = set()
+    for _ in range(50):
+        n = sched.pick_node(nodes, {"CPU": 1}, strategy="RANDOM")
+        seen.add(n.address)
+    assert seen == {"n1:1", "n2:1"}  # infeasible node never chosen
+
+
+def test_locality_prefers_arg_holding_node():
+    nodes = [_node(1), _node(2)]
+    loc = {nodes[1].node_id.hex(): 3}
+    n = sched.pick_node(nodes, {"CPU": 1}, locality=loc)
+    assert n.address == "n2:1"
+    # Saturated holder: locality must NOT pin the task to a full node.
+    nodes2 = [_node(1), _node(2, cpu_avail=0.0)]
+    n = sched.pick_node(nodes2, {"CPU": 1}, locality=loc)
+    assert n.address == "n1:1"
+
+
+def test_accelerator_type_demand_routes_to_advertising_node():
+    r = Resources.from_options({"accelerator_type": "TPU-V5E"})
+    assert r.to_dict()["accelerator_type:TPU-V5E"] == 0.001
+    plain, tpu_node = _node(1), _node(
+        2, extra={"accelerator_type:TPU-V5E": 1.0})
+    n = sched.pick_node([plain, tpu_node], r.to_dict())
+    assert n.address == "n2:1"
+    # No advertising node at all -> infeasible.
+    assert sched.pick_node([plain], r.to_dict()) is None
+
+
+def test_memory_resource_schedules_and_gates():
+    nodes = [_node(1, extra={"memory": 1000.0})]
+    assert sched.pick_node(nodes, {"CPU": 1, "memory": 800.0}) is not None
+    assert sched.pick_node(nodes, {"CPU": 1, "memory": 2000.0}) is None
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4, object_store_memory=64 << 20)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_memory_option_end_to_end(cluster):
+    """Nodes advertise detected memory; a memory-demanding task runs."""
+
+    @ray_tpu.remote(num_cpus=1, memory=64 << 20)
+    def f():
+        return "ran"
+
+    assert ray_tpu.get(f.remote(), timeout=60) == "ran"
+
+
+def test_multiprocessing_pool_shim(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(lambda x: x * x, range(10)) == [
+            x * x for x in range(10)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(lambda a, b=0: a - b, (10,), {"b": 4}) == 6
+        res = pool.apply_async(lambda: 42)
+        assert res.get(timeout=60) == 42
+        assert sorted(pool.imap_unordered(lambda x: -x, range(5))) == [
+            -4, -3, -2, -1, 0]
+        assert list(pool.imap(lambda x: x + 1, range(5))) == [1, 2, 3, 4, 5]
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])
