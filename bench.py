@@ -25,7 +25,8 @@ def main():
     from ray_tpu.models import gpt
 
     cfg = gpt.CONFIGS["gpt2-small"]
-    batch, seq = 16, 1024    # b16 measured fastest per-token (PERF.md)
+    batch, seq = 24, 1024    # b24 fastest per-token after the block/chunk
+                             # retune (PERF.md round-2 sweep)
 
     init_state, train_step = gpt.make_train_step(cfg, optax.adamw(1e-4))
     state = init_state(jax.random.key(0))

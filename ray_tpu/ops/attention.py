@@ -116,8 +116,8 @@ except Exception:  # pragma: no cover
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
 def flash_attention(q, k, v, *, causal: bool = True,
-                    scale: Optional[float] = None, block_q: int = 512,
-                    block_k: int = 512, interpret: Optional[bool] = None):
+                    scale: Optional[float] = None, block_q: int = 1024,
+                    block_k: int = 1024, interpret: Optional[bool] = None):
     """Blockwise attention via Pallas.  Falls back to XLA attention when the
     shape does not tile (length % block != 0) or Pallas is unavailable.
 

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def fused_cross_entropy(x, head, targets, valid, n_chunks: int = 8):
+def fused_cross_entropy(x, head, targets, valid, n_chunks: int = 4):
     """Mean masked NLL of `targets` under softmax(x @ head).
 
     x: [T, D] activations (bf16 ok); head: [D, V]; targets: [T] int;
