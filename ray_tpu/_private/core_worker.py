@@ -217,6 +217,7 @@ class CoreWorker:
         s.register("CoreWorker", "AddBorrow", self._rpc_add_borrow)
         s.register("CoreWorker", "RemoveBorrow", self._rpc_remove_borrow)
         s.register("CoreWorker", "AddLocation", self._rpc_add_location)
+        s.register("CoreWorker", "StackTrace", self._rpc_stack_trace)
         s.register("CoreWorker", "Ping", self._rpc_ping)
 
     async def _rpc_ping(self, req):
@@ -272,6 +273,12 @@ class CoreWorker:
         if st is not None:
             st.locations.add(req["node"])
         return {"ok": True}
+
+    async def _rpc_stack_trace(self, req):
+        """Live per-thread Python stacks (reference: `ray stack`
+        scripts.py:1798)."""
+        from ray_tpu._private.stack_dump import dump_threads
+        return {"pid": os.getpid(), "threads": dump_threads()}
 
     # ---- execution services ----
 

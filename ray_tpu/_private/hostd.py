@@ -866,6 +866,30 @@ class NodeDaemon:
         _metrics()["store_used_bytes"].set(self.store.stats()["used"])
         return {"metrics": mt.collect(), "node_id": self.node_id.hex()}
 
+    async def stack_traces(self, req):
+        """Aggregate live thread stacks from this node's workers plus the
+        daemon itself (reference: `ray stack` scripts.py:1798).  Worker
+        probes run CONCURRENTLY: a node full of wedged workers — the very
+        thing this exists to debug — must dump in ~one timeout, not N."""
+        from ray_tpu._private.stack_dump import dump_threads
+        out = [{"pid": os.getpid(), "kind": "hostd",
+                "threads": dump_threads()}]
+        handles = [h for h in self.workers.values() if h.address]
+
+        async def probe(handle):
+            try:
+                reply = await self.pool.get(handle.address).call(
+                    "CoreWorker", "StackTrace", {}, timeout=5)
+                return {"pid": reply["pid"], "kind": "worker",
+                        "state": handle.state, "threads": reply["threads"]}
+            except Exception as e:
+                return {"pid": handle.proc.pid, "kind": "worker",
+                        "state": handle.state, "error": repr(e),
+                        "threads": []}
+
+        out.extend(await asyncio.gather(*[probe(h) for h in handles]))
+        return {"processes": out}
+
     async def list_workers(self, req):
         """Per-node worker table for the state API (reference:
         experimental/state/api.py list_workers via raylet)."""
@@ -985,6 +1009,7 @@ class NodeDaemon:
         self.server.register("NodeManager", "SpillObjects",
                              self.spill_objects)
         self.server.register("NodeManager", "ListWorkers", self.list_workers)
+        self.server.register("NodeManager", "StackTraces", self.stack_traces)
         self.server.register("NodeManager", "Metrics", self.get_metrics)
         self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
         port = await self.server.start(port)

@@ -1,0 +1,19 @@
+"""Shared live-thread-dump helper for `ray_tpu stack` (reference:
+`ray stack`, scripts.py:1798 — py-spy over worker pids; here every
+process self-reports via sys._current_frames, no ptrace)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Dict, List
+
+
+def dump_threads() -> List[Dict]:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    return [{
+        "thread_id": ident,
+        "name": names.get(ident, "?"),
+        "stack": "".join(traceback.format_stack(frame)),
+    } for ident, frame in sys._current_frames().items()]

@@ -30,8 +30,11 @@ from ray_tpu.data.read_api import (  # noqa: F401
     range,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_webdataset,
 )

@@ -152,3 +152,77 @@ def read_binary_files(paths) -> Dataset:
             return blk.rows_to_block([{"path": path, "bytes": f.read()}])
 
     return Dataset(ExecPlan([load.remote(p) for p in files]))
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = 8) -> Dataset:
+    """Load the result rows of a SQL query (reference:
+    data/datasource/sql_datasource.py — connection_factory() -> DBAPI2
+    connection; sqlite3 from the stdlib qualifies).  The query executes
+    EXACTLY ONCE, in one worker task, which streams the cursor into
+    `parallelism` blocks (offset-splitting across re-executions would
+    corrupt results on backends with non-deterministic scan order)."""
+    p = max(1, parallelism)
+
+    @ray_tpu.remote(num_returns=p)
+    def load():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+        finally:
+            conn.close()
+        blocks = [blk.rows_to_block(c) for c in _chunk(rows, p)]
+        blocks += [blk.rows_to_block([])] * (p - len(blocks))
+        return tuple(blocks) if p > 1 else blocks[0]
+
+    refs = load.remote()
+    return Dataset(ExecPlan(list(refs) if p > 1 else [refs]))
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: str = "RGB") -> Dataset:
+    """Decode image files into {"image": HWC uint8 array, "path"} rows
+    (reference: data/datasource/image_datasource.py).  One task per file;
+    `size` resizes, `mode` converts (RGB/L/...)."""
+    files = _expand_paths(paths)
+
+    @ray_tpu.remote
+    def load(path):
+        from PIL import Image
+        img = Image.open(path)
+        if mode:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize(size)
+        return blk.rows_to_block(
+            [{"image": np.asarray(img), "path": path}])
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))
+
+
+def read_webdataset(paths) -> Dataset:
+    """Read webdataset-style tar shards: files grouped by key (basename
+    before the first dot), one row per key with a column per extension
+    (reference: data/datasource/webdataset_datasource.py).  One task per
+    shard; payloads stay bytes — decode with map()."""
+    files = _expand_paths(paths)
+
+    @ray_tpu.remote
+    def load(path):
+        import tarfile
+        samples: dict = {}
+        with tarfile.open(path) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                key, _, ext = base.partition(".")
+                payload = tar.extractfile(member).read()
+                samples.setdefault(key, {"__key__": key})[ext] = payload
+        return blk.rows_to_block(
+            [samples[k] for k in sorted(samples)])
+
+    return Dataset(ExecPlan([load.remote(p) for p in files]))

@@ -164,11 +164,23 @@ def _fold_heads(x):
     return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
 
 
+def _fit_blocks(q_len, kv_len, block_q, block_k):
+    """Clamp blocks to the lengths, then halve until they tile — lengths
+    like 1536 must ride the Pallas path with 512-blocks rather than fall
+    back to the [L,L]-materializing XLA reference."""
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, kv_len)
+    while block_q > 128 and q_len % block_q:
+        block_q //= 2
+    while block_k > 128 and kv_len % block_k:
+        block_k //= 2
+    return block_q, block_k
+
+
 def _flash_forward_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     b, q_len, h, d = q.shape
     kv_len = k.shape[1]
-    block_q = min(block_q, q_len)
-    block_k = min(block_k, kv_len)
+    block_q, block_k = _fit_blocks(q_len, kv_len, block_q, block_k)
     if not _use_pallas(q_len, kv_len, d, block_q, block_k, causal):
         return reference_attention(q, k, v, causal=causal, scale=scale), None
     if interpret is None:
@@ -299,8 +311,7 @@ def _flash_backward_impl(q, k, v, out, lse, g, causal, scale, block_q,
                          block_k, interpret):
     b, q_len, h, d = q.shape
     kv_len = k.shape[1]
-    block_q = min(block_q, q_len)
-    block_k = min(block_k, kv_len)
+    block_q, block_k = _fit_blocks(q_len, kv_len, block_q, block_k)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else 1.0 / np.sqrt(d)

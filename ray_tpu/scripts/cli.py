@@ -286,6 +286,31 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Dump live thread stacks cluster-wide (reference: `ray stack`)."""
+    from ray_tpu import state
+    per_node = state.stack_traces(args.address)
+    if args.json:
+        print(json.dumps(per_node, indent=2, default=str))
+        return 0
+    for node_id, reply in per_node.items():
+        print(f"=== node {node_id[:12]} ===")
+        if "error" in reply:
+            print(f"  unreachable: {reply['error']}")
+            continue
+        for proc in reply["processes"]:
+            state_txt = proc.get("state", "")
+            print(f"-- pid {proc['pid']} ({proc['kind']}"
+                  f"{' ' + state_txt if state_txt else ''}) --")
+            if proc.get("error"):
+                print(f"   <no dump: {proc['error']}>")
+            for th in proc["threads"]:
+                print(f"  thread {th['name']} ({th['thread_id']}):")
+                for line in th["stack"].rstrip().splitlines():
+                    print(f"    {line}")
+    return 0
+
+
 def cmd_memory(args) -> int:
     from ray_tpu import state
     rows = [r for r in state.list_objects(args.address) if "capacity" in r]
@@ -314,7 +339,7 @@ def main(argv=None) -> int:
 
     for name, fn in (("stop", cmd_stop), ("status", cmd_status),
                      ("memory", cmd_memory), ("metrics", cmd_metrics),
-                     ("timeline", cmd_timeline)):
+                     ("timeline", cmd_timeline), ("stack", cmd_stack)):
         q = sub.add_parser(name)
         q.add_argument("--address", required=True)
         q.add_argument("--json", action="store_true")

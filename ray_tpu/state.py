@@ -213,3 +213,10 @@ def summarize_cluster(address: Optional[str] = None) -> Dict[str, Any]:
         "actors": by_state,
         "placement_groups": len(pgs),
     }
+
+
+def stack_traces(address: Optional[str] = None) -> Dict[str, Any]:
+    """Live per-thread Python stacks for every daemon/worker process
+    (reference: `ray stack`, scripts.py:1798)."""
+    addr = _gcs_address(address)
+    return _run(_each_node(addr, "NodeManager", "StackTraces"))

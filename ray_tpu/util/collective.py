@@ -1,0 +1,252 @@
+"""Actor-group collectives: allreduce/allgather/broadcast/... between
+cluster processes.
+
+Reference parity: python/ray/util/collective/collective.py
+(init_collective_group:120, allreduce:258, broadcast:373, allgather:423,
+reducescatter:472, send:531/recv:594, barrier) with group rendezvous via a
+named actor holding the NCCL unique id.
+
+TPU-first split: this module is the HOST plane — control/bulk collectives
+between actor processes over the object store (the reference's gloo
+backend role).  The accelerator plane is NOT here: device-array
+collectives compile to XLA psum/all-gather/reduce-scatter over the ICI
+mesh (ray_tpu.parallel + jax shardings), which is the reference's NCCL
+path re-imagined for TPU (SURVEY §2.5 mapping).
+
+Usage (inside each participating actor/driver process):
+
+    from ray_tpu.util import collective
+    collective.init_collective_group(world_size=4, rank=r, group_name="g")
+    out = collective.allreduce(np.ones(8), group_name="g")
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_COORD_PREFIX = "_collective_coord:"
+_OPS = ("SUM", "PRODUCT", "MIN", "MAX")
+
+
+class _Coordinator:
+    """Named async actor: one per group; synchronizes each collective call
+    and computes reductions (the reference's rendezvous-actor role, plus
+    the gloo data plane since the host plane has no NCCL)."""
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self._calls: Dict[tuple, dict] = {}   # (kind, seq) -> state
+        self._p2p: Dict[tuple, Any] = {}      # (seq-less src->dst tag) -> data
+        self._p2p_events: Dict[tuple, asyncio.Event] = {}
+
+    def _state(self, key):
+        st = self._calls.get(key)
+        if st is None:
+            st = {"data": {}, "event": asyncio.Event()}
+            self._calls[key] = st
+        return st
+
+    async def _gather(self, key, rank, data):
+        st = self._state(key)
+        st["data"][rank] = data
+        if len(st["data"]) == self.world:
+            st["event"].set()
+        else:
+            await st["event"].wait()
+        return st
+
+    def _maybe_gc(self, key, st):
+        st.setdefault("done", 0)
+        st["done"] += 1
+        if st["done"] == self.world:
+            del self._calls[key]
+
+    async def allreduce(self, seq: int, rank: int, data, op: str):
+        st = await self._gather(("ar", seq, op), rank, data)
+        if "result" not in st:
+            arrs = [np.asarray(st["data"][r]) for r in range(self.world)]
+            if op == "SUM":
+                out = sum(arrs[1:], arrs[0].copy())
+            elif op == "PRODUCT":
+                out = arrs[0].copy()
+                for a in arrs[1:]:
+                    out = out * a
+            elif op == "MIN":
+                out = np.minimum.reduce(arrs)
+            elif op == "MAX":
+                out = np.maximum.reduce(arrs)
+            else:
+                raise ValueError(f"unknown op {op}")
+            st["result"] = out
+        result = st["result"]
+        self._maybe_gc(("ar", seq, op), st)
+        return result
+
+    async def allgather(self, seq: int, rank: int, data):
+        st = await self._gather(("ag", seq), rank, data)
+        result = [st["data"][r] for r in range(self.world)]
+        self._maybe_gc(("ag", seq), st)
+        return result
+
+    async def reducescatter(self, seq: int, rank: int, data, op: str):
+        st = await self._gather(("rs", seq, op), rank, data)
+        if "result" not in st:
+            arrs = [np.asarray(st["data"][r]) for r in range(self.world)]
+            total = sum(arrs[1:], arrs[0].copy()) if op == "SUM" else None
+            if total is None:
+                raise ValueError(f"reducescatter supports SUM, got {op}")
+            st["result"] = np.array_split(total, self.world)
+        result = st["result"][rank]
+        self._maybe_gc(("rs", seq, op), st)
+        return result
+
+    async def broadcast(self, seq: int, rank: int, data, src: int):
+        st = self._state(("bc", seq, src))
+        if rank == src:
+            st["data"][src] = data
+            st["event"].set()
+        else:
+            await st["event"].wait()
+        result = st["data"][src]
+        self._maybe_gc(("bc", seq, src), st)
+        return result
+
+    async def barrier(self, seq: int, rank: int):
+        st = await self._gather(("ba", seq), rank, None)
+        self._maybe_gc(("ba", seq), st)
+        return True
+
+    async def send(self, tag: tuple, data):
+        self._p2p[tag] = data
+        self._p2p_events.setdefault(tag, asyncio.Event()).set()
+        return True
+
+    async def recv(self, tag: tuple):
+        ev = self._p2p_events.setdefault(tag, asyncio.Event())
+        await ev.wait()
+        data = self._p2p.pop(tag)
+        del self._p2p_events[tag]
+        return data
+
+
+class _Group:
+    def __init__(self, coordinator, world_size: int, rank: int, name: str):
+        self.coord = coordinator
+        self.world = world_size
+        self.rank = rank
+        self.name = name
+        self.seq = 0           # collective-call counter (all ranks in step)
+        self.p2p_seq: Dict[tuple, int] = {}
+
+    def next_seq(self) -> int:
+        s = self.seq
+        self.seq += 1
+        return s
+
+
+_groups: Dict[str, _Group] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default",
+                          backend: str = "objstore") -> None:
+    """Join a collective group from THIS process (reference:
+    collective.py:120 — every participant calls this; rank 0's call
+    creates the rendezvous actor)."""
+    if backend != "objstore":
+        raise ValueError(
+            "host-plane backend is 'objstore'; device collectives use the "
+            "mesh/XLA plane (ray_tpu.parallel), not this API")
+    if group_name in _groups:
+        raise RuntimeError(f"group {group_name!r} already initialized here")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside world {world_size}")
+    coord = ray_tpu.remote(_Coordinator).options(
+        name=_COORD_PREFIX + group_name, get_if_exists=True,
+        num_cpus=0, max_concurrency=max(8, 2 * world_size),
+    ).remote(world_size)
+    _groups[group_name] = _Group(coord, world_size, rank, group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            ray_tpu.kill(g.coord)
+        except Exception:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _groups[group_name].world
+
+
+def _group(name: str) -> _Group:
+    g = _groups.get(name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {name!r} not initialized in this process")
+    return g
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "SUM"):
+    g = _group(group_name)
+    if op not in _OPS:
+        raise ValueError(f"op must be one of {_OPS}")
+    return ray_tpu.get(g.coord.allreduce.remote(
+        g.next_seq(), g.rank, np.asarray(tensor), op))
+
+
+def allgather(tensor, group_name: str = "default"):
+    g = _group(group_name)
+    return [np.asarray(x) for x in ray_tpu.get(
+        g.coord.allgather.remote(g.next_seq(), g.rank,
+                                 np.asarray(tensor)))]
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "SUM"):
+    g = _group(group_name)
+    return np.asarray(ray_tpu.get(g.coord.reducescatter.remote(
+        g.next_seq(), g.rank, np.asarray(tensor), op)))
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    return np.asarray(ray_tpu.get(g.coord.broadcast.remote(
+        g.next_seq(), g.rank, np.asarray(tensor), src_rank)))
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _group(group_name)
+    ray_tpu.get(g.coord.barrier.remote(g.next_seq(), g.rank))
+
+
+def send(tensor, dest_rank: int, group_name: str = "default") -> None:
+    g = _group(group_name)
+    key = (g.rank, dest_rank)
+    n = g.p2p_seq.get(key, 0)
+    g.p2p_seq[key] = n + 1
+    ray_tpu.get(g.coord.send.remote(("p2p", g.rank, dest_rank, n),
+                                    np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    key = (src_rank, g.rank)
+    n = g.p2p_seq.get(key, 0)
+    g.p2p_seq[key] = n + 1
+    return np.asarray(ray_tpu.get(
+        g.coord.recv.remote(("p2p", src_rank, g.rank, n))))

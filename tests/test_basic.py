@@ -702,3 +702,40 @@ def test_pubsub_channels(cluster):
     # A second subscriber from seq 0 replays the ring.
     sub2 = Subscriber("events")
     assert len(sub2.poll(timeout_s=2)) == 3
+
+
+def test_stack_traces(cluster):
+    """`ray_tpu stack` equivalent: live thread dumps show a worker inside
+    the running task (reference: `ray stack`, scripts.py:1798)."""
+    import time as _time
+
+    from ray_tpu import state
+
+    @ray_tpu.remote
+    def marker_fn_sleeps():
+        _time.sleep(45)
+        return 1
+
+    ref = marker_fn_sleeps.remote()
+    dumped = ""
+    deadline = _time.time() + 60
+    while _time.time() < deadline:   # worker spawn can be slow on 1 cpu
+        per_node = state.stack_traces()
+        dumped = "\n".join(
+            th["stack"]
+            for reply in per_node.values()
+            for proc in reply.get("processes", [])
+            for th in proc["threads"])
+        if "marker_fn_sleeps" in dumped:
+            break
+        _time.sleep(1.0)
+    assert "marker_fn_sleeps" in dumped
+    # the daemon reports itself too
+    kinds = {proc["kind"] for reply in per_node.values()
+             for proc in reply.get("processes", [])}
+    assert "hostd" in kinds
+    ray_tpu.cancel(ref, force=True)
+    from ray_tpu.exceptions import (
+        TaskCancelledError, WorkerCrashedError)
+    with pytest.raises((TaskCancelledError, WorkerCrashedError)):
+        ray_tpu.get(ref, timeout=60)

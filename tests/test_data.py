@@ -323,3 +323,53 @@ def test_dataset_pipeline_repeat_and_window(cluster):
     n = sum(len(b["id"]) for b in
             rdata.range(10).repeat(2).iter_batches(batch_size=4))
     assert n == 20
+
+
+def test_read_sql_sqlite(cluster, tmp_path):
+    """read_sql over a stdlib sqlite3 database (reference:
+    datasource/sql_datasource.py)."""
+    import sqlite3
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO items VALUES (?, ?)",
+                     [(i, f"n{i}") for i in range(20)])
+    conn.commit()
+    conn.close()
+
+    ds = rd.read_sql("SELECT id, name FROM items ORDER BY id",
+                        lambda: sqlite3.connect(db), parallelism=4)
+    rows = ds.take_all()
+    assert len(rows) == 20
+    assert rows[0] == {"id": 0, "name": "n0"}
+    assert rows[19]["name"] == "n19"
+
+
+def test_read_images(cluster, tmp_path):
+    from PIL import Image
+    for i in range(3):
+        Image.new("RGB", (8 + i, 8), color=(i * 10, 0, 0)).save(
+            tmp_path / f"img{i}.png")
+    ds = rd.read_images(str(tmp_path / "*.png"), size=(8, 8))
+    rows = ds.take_all()
+    assert len(rows) == 3
+    img = np.asarray(rows[0]["image"]).reshape(8, 8, 3)
+    assert img.min() >= 0 and img.max() <= 255
+
+
+def test_read_webdataset(cluster, tmp_path):
+    import io
+    import tarfile
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for key in ("a", "b"):
+            for ext, payload in (("txt", f"text-{key}".encode()),
+                                 ("cls", b"7")):
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+    ds = rd.read_webdataset(str(shard))
+    rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+    assert rows[0]["__key__"] == "a"
+    assert bytes(rows[0]["txt"]) == b"text-a"
+    assert bytes(rows[1]["cls"]) == b"7"

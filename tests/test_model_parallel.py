@@ -316,3 +316,20 @@ def test_resnet_memorizes():
         state, m = step(state, batch)
     assert float(m["loss"]) < 1.5
     assert float(m["accuracy"]) > 0.5
+
+
+def test_flash_attention_non_power_of_two_multiple_stays_pallas():
+    """L=1536 tiles at 512 even though the default block is 1024 — the
+    halving fit must keep such lengths on the Pallas path (regression:
+    raising default blocks must not fall back to [L,L] XLA attention)."""
+    from ray_tpu.ops.attention import _fit_blocks
+    assert _fit_blocks(1536, 1536, 1024, 1024) == (512, 512)
+    assert _fit_blocks(1024, 1024, 1024, 1024) == (1024, 1024)
+    assert _fit_blocks(96, 96, 1024, 1024)[0] <= 96  # shorter than a block
+    q = jax.random.normal(jax.random.key(0), (1, 1536, 2, 32))
+    k = jax.random.normal(jax.random.key(1), (1, 1536, 2, 32))
+    v = jax.random.normal(jax.random.key(2), (1, 1536, 2, 32))
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
