@@ -672,7 +672,11 @@ class CoreWorker:
                     break
                 for f in done:
                     f.exception()  # consume; errored objects count as ready
-                    ready.append(pending.pop(f))
+                    # Cap at num_returns ("at most num_returns" contract):
+                    # several probes can complete in one event-loop tick, and
+                    # extras must stay in pending, not be silently dropped.
+                    if len(ready) < num_returns:
+                        ready.append(pending.pop(f))
         finally:
             for f in pending:
                 f.cancel()

@@ -118,6 +118,22 @@ class JobSupervisor:
         rec["status"] = JobStatus.RUNNING
         rec["start_time"] = time.time()
         _put_record(rec)
+        # Close the lost-update window: stop_job's PENDING path may persist
+        # STOPPED between our re-read above and the RUNNING write, which the
+        # write just clobbered.  stop_job also sets an append-only stop-intent
+        # key that nothing overwrites; honor it after the RUNNING write.
+        if _kv_get(_KV_NS, self.submission_id + ".stop_intent") is not None:
+            self.stop()
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:
+                pass
+            rec["status"] = JobStatus.STOPPED
+            rec["message"] = "stopped before start"
+            rec["end_time"] = time.time()
+            _put_record(rec)
+            self._finish_without_run()
+            return JobStatus.STOPPED
         threading.Thread(target=self._drain, daemon=True,
                          name="job-drain").start()
         return JobStatus.RUNNING
@@ -338,8 +354,10 @@ class JobManager:
         reply = _kv_call("kv_keys", {"ns": _KV_NS, "prefix": ""})
         jobs = []
         for key in reply["keys"]:
-            blob = _kv_get(_KV_NS, key.decode()
-                           if isinstance(key, bytes) else key)
+            key = key.decode() if isinstance(key, bytes) else key
+            if key.endswith(".stop_intent"):
+                continue
+            blob = _kv_get(_KV_NS, key)
             if blob is not None:
                 jobs.append(self._maybe_reconcile(pickle.loads(blob)))
         jobs.sort(key=lambda r: r.get("submit_time") or 0)
@@ -376,7 +394,9 @@ class JobManager:
             if rec["status"] == JobStatus.PENDING:
                 # Supervisor not nameable yet — persist the stop intent;
                 # JobSupervisor.start honors a STOPPED record by never
-                # launching (and tears down if the spawn raced us).
+                # launching (and tears down if the spawn raced us).  The
+                # separate intent key survives a concurrent RUNNING write.
+                _kv_put(_KV_NS, submission_id + ".stop_intent", b"1")
                 rec["status"] = JobStatus.STOPPED
                 rec["message"] = "stopped before start"
                 rec["end_time"] = time.time()
@@ -397,5 +417,6 @@ class JobManager:
             raise RuntimeError("cannot delete a non-terminal job; stop it "
                                "first")
         _kv_call("kv_del", {"ns": _KV_NS, "key": submission_id})
+        _kv_call("kv_del", {"ns": _KV_NS, "key": submission_id + ".stop_intent"})
         _kv_call("kv_del", {"ns": _LOG_NS, "key": submission_id})
         return True

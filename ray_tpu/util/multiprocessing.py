@@ -35,6 +35,8 @@ class AsyncResult:
         return len(done) == len(self._refs)
 
     def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("AsyncResult not ready")
         try:
             ray_tpu.get(self._refs, timeout=0)
             return True
@@ -119,7 +121,8 @@ class Pool:
                    for c in self._chunks(iterable, chunksize)]
         while pending:
             done, pending = ray_tpu.wait(pending, num_returns=1)
-            yield from ray_tpu.get(done[0])
+            for ref in done:
+                yield from ray_tpu.get(ref)
 
     def close(self) -> None:
         self._closed = True
