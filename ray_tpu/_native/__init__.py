@@ -18,6 +18,9 @@ _LIBS = {
     # Transfer plane links the store's C API into the same .so; its
     # handles attach to the same /dev/shm segment independently.
     "tpuxfer": ["objstore.cc", "objtransfer.cc"],
+    # Task-submission hot path (framed TCP client/server, batched
+    # completion delivery) — see taskrpc.cc.
+    "tpttask": ["taskrpc.cc"],
 }
 
 

@@ -41,6 +41,7 @@ class RayTpuConfig:
 
     def __init__(self):
         self._overrides: Dict[str, Any] = {}
+        self._cache: Dict[str, Any] = {}
 
     def apply_system_config(self, overrides: Dict[str, Any] | None) -> None:
         """ray_tpu.init(_system_config={...}) hook."""
@@ -49,17 +50,30 @@ class RayTpuConfig:
                 raise ValueError(f"unknown config flag {k!r}; known: "
                                  f"{sorted(self._FLAGS)}")
             self._overrides[k] = self._FLAGS[k].cast(v)
+        self._cache.clear()
+
+    def invalidate_cache(self) -> None:
+        """Call after mutating RAY_TPU_* env vars in-process (tests do)."""
+        self._cache.clear()
 
     def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # Resolved values are cached: flag reads sit on per-task hot paths
+        # (lease pump, submit), and an os.environ hit per read is ~7us.
+        cached = self._cache.get(name, self)
+        if cached is not self:
+            return cached
         flag = self._FLAGS.get(name)
         if flag is None:
             raise AttributeError(name)
         if name in self._overrides:
-            return self._overrides[name]
-        env = os.environ.get(f"RAY_TPU_{name.upper()}")
-        if env is not None:
-            return flag.cast(env)
-        return flag.default
+            value = self._overrides[name]
+        else:
+            env = os.environ.get(f"RAY_TPU_{name.upper()}")
+            value = flag.cast(env) if env is not None else flag.default
+        self._cache[name] = value
+        return value
 
     def dump(self) -> Dict[str, Any]:
         return {name: getattr(self, name) for name in sorted(self._FLAGS)}
@@ -75,13 +89,19 @@ _D("lease_idle_ttl_s", 1.0, float,
    "held worker leases idle past this return to the daemon")
 _D("max_pending_lease_requests", 16, int,
    "in-flight LeaseWorker RPCs per scheduling key")
+_D("lease_pipeline_depth", 8, int,
+   "tasks in flight per held worker lease (receiver queues them; "
+   "reference: OnWorkerIdle pushes all queued tasks onto a lease)")
 _D("task_max_retries", 3, int, "default task retry budget")
 _D("worker_idle_ttl_s", 60.0, float,
    "idle pooled workers are reaped after this")
 _D("max_workers_per_node", 0, int,
    "worker-pool cap per node; 0 = max(8, 4x CPUs)")
 _D("max_startup_concurrency", 0, int,
-   "concurrent worker spawns per node; 0 = host core count")
+   "concurrent worker spawns per node; 0 = max(4, host core count)")
+_D("native_task_transport", True, _bool,
+   "push tasks over the native framed-TCP plane (taskrpc.cc) instead of "
+   "the Python RPC layer")
 _D("heartbeat_interval_s", 0.5, float, "hostd -> GCS heartbeat period")
 _D("node_death_timeout_s", 5.0, float,
    "missed-heartbeat window before a node is declared dead")

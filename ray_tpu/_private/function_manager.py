@@ -21,6 +21,12 @@ class FunctionManager:
         self._import_cache: dict[str, object] = {}
         self._lock = threading.Lock()
 
+    def export_cached(self, obj) -> str | None:
+        """Synchronous cache peek — the submit fast path avoids an event-
+        loop round trip when the function was already exported."""
+        with self._lock:
+            return self._export_cache.get(id(obj))
+
     async def export(self, job_id: int, obj) -> str:
         with self._lock:
             key = self._export_cache.get(id(obj))
@@ -34,6 +40,12 @@ class FunctionManager:
             self._export_cache[id(obj)] = key
             self._import_cache[key] = obj  # local fast path
         return key
+
+    def fetch_cached(self, key: str):
+        """Synchronous cache peek — the execution hot path avoids an
+        event-loop round trip for already-imported functions."""
+        with self._lock:
+            return self._import_cache.get(key)
 
     async def fetch(self, key: str):
         with self._lock:

@@ -92,12 +92,20 @@ def detect_resources() -> dict:
     return res
 
 
+def _wants_tpu(demand: dict) -> bool:
+    """A lease needs TPU runtime access iff it demands a TPU resource
+    (``num_tpus`` / ``TPU`` / ``TPU-<gen>-head`` custom resources)."""
+    return any(v > 0 and (k == "TPU" or k.startswith("TPU"))
+               for k, v in demand.items())
+
+
 class WorkerHandle:
     def __init__(self, proc: subprocess.Popen, job_id: int,
-                 env_hash: str = ""):
+                 env_hash: str = "", tpu: bool = False):
         self.proc = proc
         self.job_id = job_id
         self.env_hash = env_hash  # runtime-env cache key (worker_pool.h:156)
+        self.tpu = tpu           # spawned with TPU runtime access
         self.worker_id: WorkerID | None = None
         self.address: str = ""
         self.state = "starting"  # starting/idle/claimed/leased/actor
@@ -147,8 +155,11 @@ class NodeDaemon:
         # python spawns contend for cores — past this many in-flight
         # spawns, lease requests wait for an existing worker instead of
         # forking another interpreter.
+        # Floor of 4: spawning is import-I/O heavy, and on small/cgroup-
+        # restricted hosts (cpu_count()==1) a throttle of 1 serializes the
+        # whole pool ramp-up behind one ~0.3s boot at a time.
         self.max_startup_concurrency = (
-            _cfg().max_startup_concurrency or max(1, os.cpu_count() or 1))
+            _cfg().max_startup_concurrency or max(4, os.cpu_count() or 1))
         self._capacity_freed: asyncio.Event | None = None  # made on start()
         # Object spilling (reference: raylet LocalObjectManager
         # local_object_manager.h:41 + _private/external_storage.py:246
@@ -166,12 +177,21 @@ class NodeDaemon:
     # ---------------- worker pool ----------------
 
     def _spawn_worker(self, job_id: int,
-                      runtime_env: dict | None = None) -> WorkerHandle:
+                      runtime_env: dict | None = None,
+                      tpu: bool = False) -> WorkerHandle:
         from ray_tpu._private import runtime_env as renv
         log_base = os.path.join(self.session_dir, "logs",
                                 f"worker-{len(self.workers)}-{os.getpid()}")
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if not tpu:
+            # Leases without a TPU demand get a worker that skips runtime
+            # TPU registration (the site hook imports jax + the PJRT plugin
+            # — ~2s of the ~2.3s worker boot).  Non-TPU workers boot in
+            # ~0.3s, and user jax code in them falls back to host CPU.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            if "axon" in env.get("JAX_PLATFORMS", ""):
+                env["JAX_PLATFORMS"] = "cpu"
         if runtime_env:
             import json as _json
             env.update(runtime_env.get("env_vars", {}))
@@ -187,7 +207,7 @@ class NodeDaemon:
         out = open(log_base + ".out", "ab")
         err = open(log_base + ".err", "ab")
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
-        handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env))
+        handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env), tpu)
         handle.log_paths = {"stdout": log_base + ".out",
                             "stderr": log_base + ".err"}
         handle.log_offsets = {"stdout": 0, "stderr": 0}
@@ -212,9 +232,10 @@ class NodeDaemon:
         return {"ok": True, "node_id": self.node_id}
 
     async def _get_worker(self, job_id: int, timeout: float = 60.0,
-                          runtime_env: dict | None = None):
-        """Pop an idle worker for (job, runtime-env hash), spawning if
-        necessary.  The returned handle is already claimed
+                          runtime_env: dict | None = None,
+                          tpu: bool = False):
+        """Pop an idle worker for (job, runtime-env hash, tpu-ness),
+        spawning if necessary.  The returned handle is already claimed
         (state="claimed") so concurrent leases can never share a worker."""
         from ray_tpu._private import runtime_env as renv
         want_hash = renv.env_hash(runtime_env)
@@ -223,7 +244,8 @@ class NodeDaemon:
             for handle in self.workers.values():
                 if handle.state == "idle" and not handle.reserved \
                         and handle.job_id == job_id \
-                        and handle.env_hash == want_hash:
+                        and handle.env_hash == want_hash \
+                        and handle.tpu == tpu:
                     handle.state = "claimed"
                     return handle
             live = [w for w in self.workers.values() if w.proc.poll() is None]
@@ -242,7 +264,8 @@ class NodeDaemon:
                 for handle in live:
                     if handle.state == "idle" and not handle.reserved \
                             and (handle.job_id != job_id
-                                 or handle.env_hash != want_hash):
+                                 or handle.env_hash != want_hash
+                                 or handle.tpu != tpu):
                         self._kill_worker(handle)
                         break
                 else:
@@ -250,7 +273,7 @@ class NodeDaemon:
             # Spawn a worker pinned to this lease (reserved=True) so another
             # lease cannot steal it the moment it boots — stealing cascades
             # into one extra spawn per steal.
-            handle = self._spawn_worker(job_id, runtime_env)
+            handle = self._spawn_worker(job_id, runtime_env, tpu)
             handle.reserved = True
             try:
                 await asyncio.wait_for(
@@ -349,7 +372,8 @@ class NodeDaemon:
                         else self._reserve(demand))
             if reserved:
                 handle = await self._get_worker(
-                    job_id, runtime_env=req.get("runtime_env"))
+                    job_id, runtime_env=req.get("runtime_env"),
+                    tpu=_wants_tpu(demand))
                 if handle is not None:
                     break
                 if bundle:
@@ -402,7 +426,8 @@ class NodeDaemon:
         elif not self._reserve(demand):
             return {"granted": False, "reason": "resources"}
         handle = await self._get_worker(
-            req.get("job_id", 0), runtime_env=req.get("runtime_env"))
+            req.get("job_id", 0), runtime_env=req.get("runtime_env"),
+            tpu=_wants_tpu(demand))
         if handle is None:
             if bundle:
                 self._bundle_unreserve(bundle, demand)

@@ -10,9 +10,27 @@ simplified: random unique bytes + embedded parent prefixes.
 from __future__ import annotations
 
 import os
+import random
 import threading
 
 _UNIQUE_SIZE = 16  # random portion
+
+# ID entropy comes from a per-process PRNG seeded from the OS once (plus
+# re-seeding after fork): os.urandom is a syscall (~50us inside cgroups)
+# and sat directly on the task-submission hot path at one TaskID + N
+# ObjectIDs per task.
+_rng = random.Random(os.urandom(16))
+_rng_pid = os.getpid()
+_rng_lock = threading.Lock()
+
+
+def _rand_bytes(n: int) -> bytes:
+    global _rng, _rng_pid
+    with _rng_lock:
+        if os.getpid() != _rng_pid:  # forked child must not replay parent
+            _rng = random.Random(os.urandom(16))
+            _rng_pid = os.getpid()
+        return _rng.getrandbits(n * 8).to_bytes(n, "little")
 
 
 class BaseID:
@@ -28,7 +46,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls) -> "BaseID":
-        return cls(os.urandom(cls.SIZE))
+        return cls(_rand_bytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str) -> "BaseID":
@@ -91,7 +109,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "ActorID":
-        return cls(os.urandom(cls.SIZE - JobID.SIZE) + job_id.binary())
+        return cls(_rand_bytes(cls.SIZE - JobID.SIZE) + job_id.binary())
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[-JobID.SIZE:])
@@ -105,7 +123,7 @@ class TaskID(BaseID):
     @classmethod
     def of(cls, actor_id: "ActorID | None" = None) -> "TaskID":
         aid = actor_id.binary() if actor_id is not None else b"\x00" * ActorID.SIZE
-        return cls(os.urandom(cls.SIZE - ActorID.SIZE) + aid)
+        return cls(_rand_bytes(cls.SIZE - ActorID.SIZE) + aid)
 
     def actor_id(self) -> ActorID:
         return ActorID(self._bytes[-ActorID.SIZE:])

@@ -286,10 +286,14 @@ def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):
 
     Single chip uses the fused chunked cross-entropy (never materializes
     [B, L, V] — see ops/cross_entropy.py and PERF.md; the naive fp32
-    log_softmax was ~75% of the train step).  Under a mesh the standard
-    path keeps GSPMD free to shard the logits.
+    log_softmax was ~75% of the train step).  Under a mesh the shard_map
+    variant keeps the same property per-chip with vocab-sharded
+    logsumexp; the naive path remains only as the fallback for
+    non-divisible shapes.
     """
-    from ray_tpu.ops.cross_entropy import fused_cross_entropy
+    from ray_tpu.ops.cross_entropy import (fused_cross_entropy,
+                                           fused_cross_entropy_spmd,
+                                           spmd_ce_applicable)
 
     tokens = batch["tokens"]
     c = config
@@ -309,6 +313,13 @@ def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):
                 else params["lm_head"]).astype(c.dtype)
         loss = fused_cross_entropy(x.reshape(b * l, d), head,
                                    targets.reshape(-1), valid.reshape(-1))
+        return loss + 0.01 * aux
+
+    if spmd_ce_applicable(mesh, c.vocab_size, *tokens.shape):
+        x, aux = forward_trunk(params, tokens, c, mesh)
+        head = (params["tok_embed"].T if c.tie_embeddings
+                else params["lm_head"]).astype(c.dtype)
+        loss = fused_cross_entropy_spmd(x, head, targets, valid, mesh)
         return loss + 0.01 * aux
 
     logits, aux = forward(params, tokens, c, mesh)

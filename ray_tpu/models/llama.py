@@ -215,9 +215,12 @@ def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
 def loss_fn(params: dict, batch: dict, config: LlamaConfig, mesh=None):
     """Next-token cross-entropy; same shift/mask scheme as gpt.loss_fn
     (full-length forward, rolled targets, last position masked).  Single
-    chip rides the fused chunked cross-entropy; under a mesh the standard
-    path leaves logits sharding to GSPMD."""
-    from ray_tpu.ops.cross_entropy import fused_cross_entropy
+    chip rides the fused chunked cross-entropy; a mesh rides the
+    shard_map variant (vocab-sharded logsumexp), with the naive path as
+    the non-divisible-shape fallback."""
+    from ray_tpu.ops.cross_entropy import (fused_cross_entropy,
+                                           fused_cross_entropy_spmd,
+                                           spmd_ce_applicable)
 
     c = config
     tokens = batch["tokens"]
@@ -235,6 +238,11 @@ def loss_fn(params: dict, batch: dict, config: LlamaConfig, mesh=None):
         return fused_cross_entropy(
             x.reshape(b * l, d), params["lm_head"].astype(c.dtype),
             targets.reshape(-1), valid.reshape(-1))
+
+    if spmd_ce_applicable(mesh, c.vocab_size, *tokens.shape):
+        x = forward_trunk(params, tokens, c, mesh)
+        return fused_cross_entropy_spmd(
+            x, params["lm_head"].astype(c.dtype), targets, valid, mesh)
 
     logits = forward(params, tokens, c, mesh)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

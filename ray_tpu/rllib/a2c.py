@@ -23,7 +23,7 @@ def a2c_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
     vf_coeff = cfg.get("vf_loss_coeff", 0.5)
     ent_coeff = cfg.get("entropy_coeff", 0.0)
 
-    values, logp, adv, entropy = policy_terms(apply, params, mb)
+    values, logp, adv, entropy = policy_terms(apply, params, mb, cfg)
     policy_loss = -(logp * adv).mean()
     vf_loss = ((values - mb[SampleBatch.VALUE_TARGETS]) ** 2).mean()
     total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
@@ -54,4 +54,5 @@ class A2C(PPO):
                     "sgd_minibatch_size": mb,
                     "vf_loss_coeff": getattr(cfg, "vf_loss_coeff", 0.5),
                     "entropy_coeff": getattr(cfg, "entropy_coeff", 0.0)},
-            hidden=cfg.model_hidden, seed=cfg.seed)
+            hidden=cfg.model_hidden, seed=cfg.seed,
+            mesh=cfg.learner_mesh)

@@ -38,6 +38,9 @@ class AlgorithmConfig:
         self.num_sgd_iter = 8
         self.model_hidden = (64, 64)
         self.seed = 0
+        # Data-parallel learner group: a jax Mesh whose "data" axis spans
+        # the learner chips (reference: LearnerGroup learner_group.py:51).
+        self.learner_mesh: Any = None
         self.extra: Dict[str, Any] = {}
 
     # fluent setters ------------------------------------------------------
@@ -65,10 +68,12 @@ class AlgorithmConfig:
                 self.extra[k] = v
         return self
 
-    def resources(self, *, num_cpus_per_worker: Optional[float] = None
-                  ) -> "AlgorithmConfig":
+    def resources(self, *, num_cpus_per_worker: Optional[float] = None,
+                  learner_mesh: Any = None) -> "AlgorithmConfig":
         if num_cpus_per_worker is not None:
             self.num_cpus_per_worker = num_cpus_per_worker
+        if learner_mesh is not None:
+            self.learner_mesh = learner_mesh
         return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
@@ -78,7 +83,7 @@ class AlgorithmConfig:
 
     def to_dict(self) -> Dict[str, Any]:
         d = {k: v for k, v in self.__dict__.items()
-             if k not in ("algo_class", "extra")}
+             if k not in ("algo_class", "extra", "learner_mesh")}
         d.update(self.extra)
         return d
 

@@ -47,7 +47,7 @@ class _VTraceLearner:
     """Single-fragment jitted V-trace SGD step over time-major batches."""
 
     def __init__(self, obs_dim: int, num_actions: int, cfg: IMPALAConfig,
-                 hidden, seed: int):
+                 hidden, seed: int, mesh=None):
         init_params, self.apply = make_model(obs_dim, num_actions, hidden)
         self.params = init_params(jax.random.key(seed))
         self.tx = optax.chain(
@@ -55,6 +55,8 @@ class _VTraceLearner:
             optax.adam(cfg.lr, eps=1e-5))
         self.opt_state = self.tx.init(self.params)
         self.num_updates = 0
+        self.mesh = (mesh if mesh is not None
+                     and any(s > 1 for s in mesh.shape.values()) else None)
 
         gamma = cfg.gamma
         vf_coeff = cfg.vf_loss_coeff
@@ -92,16 +94,47 @@ class _VTraceLearner:
         def step(params, opt_state, batch):
             (_, metrics), grads = jax.value_and_grad(
                 loss, has_aux=True)(params, batch)
+            if self.mesh is not None:
+                grads = jax.lax.pmean(grads, "data")
+                metrics = jax.lax.pmean(metrics, "data")
             updates, opt_state = self.tx.update(updates=grads,
                                                 state=opt_state,
                                                 params=params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, metrics
 
+        if self.mesh is not None:
+            # Data-parallel learner: fragments (the batch dim of the
+            # time-major [T, B] batch) are sliced across the data axis;
+            # V-trace is per-sequence so slicing columns is exact, and
+            # the gradient pmean reconstructs the global batch gradient
+            # (reference: LearnerGroup's DDP fleet, learner_group.py:51).
+            from jax.sharding import PartitionSpec as P
+
+            from ray_tpu.parallel.mesh import shard_map_compat
+            k = self.mesh.shape["data"]
+
+            def shard_step(params, opt_state, batch):
+                idx = jax.lax.axis_index("data")
+
+                def slice_cols(key, x):
+                    axis = 0 if key == "bootstrap_obs" else 1
+                    rows = x.shape[axis] // k
+                    return jax.lax.dynamic_slice_in_dim(
+                        x, idx * rows, rows, axis=axis)
+
+                local = {key: slice_cols(key, v)
+                         for key, v in batch.items()}
+                return step(params, opt_state, local)
+
+            step_fn = shard_map_compat(
+                shard_step, self.mesh, (P(), P(), P()), (P(), P(), P()))
+        else:
+            step_fn = step
         # No donation: the learner thread updates params while the driver
         # thread concurrently reads them for weight broadcast — donating
         # would delete buffers out from under the reader.
-        self._step = jax.jit(step)
+        self._step = jax.jit(step_fn)
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
@@ -172,7 +205,8 @@ class IMPALA(Algorithm):
                 hidden=cfg.model_hidden, seed=cfg.seed,
                 postprocess=False))
         self.learner = _VTraceLearner(
-            self.obs_dim, self.num_actions, cfg, cfg.model_hidden, cfg.seed)
+            self.obs_dim, self.num_actions, cfg, cfg.model_hidden, cfg.seed,
+            mesh=cfg.learner_mesh)
         self.workers.sync_weights(self.learner.get_weights())
         self.learner_thread = LearnerThread(
             self.learner, cfg.learner_queue_size)

@@ -59,7 +59,21 @@ class JaxLearner:
         self.opt_state = self.tx.init(self.params)
         self._loss_fn = loss_fn
         self._rng = jax.random.key(seed + 17)
-        self._update = jax.jit(self._make_update(), donate_argnums=(0, 1))
+        # Data-parallel learner group over the mesh's data axis
+        # (reference: learner_group.py:51 — a fleet of DDP-wrapped
+        # learners; here one SPMD program with a pmean on gradients).
+        self.mesh = None
+        if mesh is not None and any(s > 1 for s in mesh.shape.values()):
+            bad = [a for a, s in mesh.shape.items()
+                   if s > 1 and a != "data"]
+            if bad:
+                raise ValueError(
+                    f"JaxLearner is data-parallel only; mesh axes {bad} "
+                    f"have size > 1 (shard the model with models/, not "
+                    f"the RL learner)")
+            self.mesh = mesh
+        make = self._make_update_dp if self.mesh else self._make_update
+        self._update = jax.jit(make(), donate_argnums=(0, 1))
 
     def _make_update(self):
         num_epochs = self.config.get("num_sgd_iter", 1)
@@ -98,6 +112,73 @@ class JaxLearner:
 
         return update
 
+    def _make_update_dp(self):
+        """SPMD data-parallel update: every shard holds the full batch
+        (replicated in_specs), computes identical global permutations and
+        per-minibatch advantage normalization, then takes ITS slice of
+        each minibatch; gradients pmean over the data axis reconstruct
+        the exact global-minibatch gradient, so a dp-k learner walks the
+        same parameter trajectory as a single chip (up to fp summation
+        order — regression-gated in tests/test_rllib_dp.py)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel.mesh import shard_map_compat
+
+        mesh = self.mesh
+        k = mesh.shape["data"]
+        num_epochs = self.config.get("num_sgd_iter", 1)
+        mb_size = self.config.get("sgd_minibatch_size", 128)
+        loss_fn, apply, tx = self._loss_fn, self.apply, self.tx
+        # Normalization already applied globally per minibatch below.
+        cfg = dict(self.config)
+        cfg["advantages_prenormalized"] = True
+
+        def minibatch_step(carry, mb):
+            params, opt_state = carry
+            (_, metrics), grads = jax.value_and_grad(
+                partial(loss_fn, apply), has_aux=True)(params, mb, cfg)
+            grads = jax.lax.pmean(grads, "data")
+            metrics = jax.lax.pmean(metrics, "data")
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), metrics
+
+        def shard_update(params, opt_state, batch, rng):
+            idx = jax.lax.axis_index("data")
+            n = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            num_mb = max(n // mb_size, 1)
+            mb_rows = (min(mb_size, n) // k) * k   # divisible by k
+            take = num_mb * mb_rows
+            local_rows = mb_rows // k
+
+            def epoch_step(carry, rng_e):
+                params, opt_state = carry
+                perm = jax.random.permutation(rng_e, n)  # same every shard
+                mbs = jax.tree_util.tree_map(
+                    lambda x: x[perm][:take].reshape(
+                        (num_mb, mb_rows) + x.shape[1:]), batch)
+                if SampleBatch.ADVANTAGES in mbs:
+                    adv = mbs[SampleBatch.ADVANTAGES]
+                    mbs[SampleBatch.ADVANTAGES] = (
+                        (adv - adv.mean(1, keepdims=True))
+                        / (adv.std(1, keepdims=True) + 1e-8))
+                local = jax.tree_util.tree_map(
+                    lambda x: jax.lax.dynamic_slice_in_dim(
+                        x, idx * local_rows, local_rows, axis=1), mbs)
+                (params, opt_state), metrics = jax.lax.scan(
+                    minibatch_step, (params, opt_state), local)
+                return (params, opt_state), metrics
+
+            rngs = jax.random.split(rng, num_epochs)
+            (params, opt_state), metrics = jax.lax.scan(
+                epoch_step, (params, opt_state), rngs)
+            mean_metrics = jax.tree_util.tree_map(
+                lambda m: jnp.mean(m), metrics)
+            return params, opt_state, mean_metrics
+
+        return shard_map_compat(shard_update, mesh,
+                                (P(), P(), P(), P()), (P(), P(), P()))
+
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         self._rng, sub = jax.random.split(self._rng)
@@ -120,7 +201,7 @@ class JaxLearner:
         self.opt_state = jax.device_put(state["opt_state"])
 
 
-def policy_terms(apply, params, mb):
+def policy_terms(apply, params, mb, cfg=None):
     """Shared per-minibatch terms: (values, taken-action logp, normalized
     advantages, entropy) — used by the PPO and A2C losses."""
     logits, values = apply(params, mb[SampleBatch.OBS])
@@ -128,7 +209,8 @@ def policy_terms(apply, params, mb):
     actions = mb[SampleBatch.ACTIONS].astype(jnp.int32)
     logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
     adv = mb[SampleBatch.ADVANTAGES]
-    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    if not (cfg or {}).get("advantages_prenormalized"):
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
     entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
     return values, logp, adv, entropy
 
@@ -143,7 +225,8 @@ def _ppo_surrogate(mb, cfg, values, logp, entropy):
     ent_coeff = cfg.get("entropy_coeff", 0.0)
 
     adv = mb[SampleBatch.ADVANTAGES]
-    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    if not cfg.get("advantages_prenormalized"):
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
     ratio = jnp.exp(logp - mb[SampleBatch.ACTION_LOGP])
     surr = jnp.minimum(ratio * adv,
                        jnp.clip(ratio, 1 - clip, 1 + clip) * adv)

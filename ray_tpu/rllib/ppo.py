@@ -60,7 +60,8 @@ class PPO(Algorithm):
                 "vf_loss_coeff": getattr(cfg, "vf_loss_coeff", 0.5),
                 "entropy_coeff": getattr(cfg, "entropy_coeff", 0.0),
             },
-            hidden=cfg.model_hidden, seed=cfg.seed)
+            hidden=cfg.model_hidden, seed=cfg.seed,
+            mesh=cfg.learner_mesh)
 
     def training_step(self) -> Dict[str, Any]:
         """Reference: ppo.py:384."""

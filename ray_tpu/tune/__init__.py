@@ -9,6 +9,7 @@ from ray_tpu.tune.controller import Trial, TuneController  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -18,6 +19,7 @@ from ray_tpu.tune.search import (  # noqa: F401
     Categorical,
     ConcurrencyLimiter,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,

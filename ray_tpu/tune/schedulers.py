@@ -188,3 +188,72 @@ class PopulationBasedTraining(TrialScheduler):
             else:  # numeric: scale by a perturbation factor
                 config[key] = config[key] * self._rng.choice(self.factors)
         return config
+
+
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand as a family of successive-halving brackets.
+
+    Reference: tune/schedulers/hyperband.py.  Trials are assigned
+    round-robin to brackets b = 0..s_max; bracket b starts trials at
+    budget max_t / eta^(s_max - b) and halves asynchronously at each rung
+    (the pause-free asynchronous formulation — each bracket behaves like
+    an ASHA instance with its own grace period, which preserves
+    HyperBand's exploration/exploitation spread without requiring trial
+    pause/resume support in the executor).
+    """
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 max_t: int = 81, reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in (None, "min", "max")
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        import math
+        self.s_max = int(math.log(max_t) / math.log(reduction_factor))
+        # bracket index -> list of rung budgets (ascending)
+        self.brackets: List[List[int]] = []
+        for s in range(self.s_max, -1, -1):
+            start = max(1, max_t // (reduction_factor ** s))
+            rungs = []
+            t = start
+            while t < max_t:
+                rungs.append(t)
+                t *= reduction_factor
+            self.brackets.append(rungs)
+        self._recorded: Dict[tuple, list] = {}   # (bracket, rung) -> values
+        self._assigned: Dict[str, int] = {}      # trial_id -> bracket
+        self._next_bracket = 0
+
+    def _bracket_of(self, trial) -> int:
+        b = self._assigned.get(trial.trial_id)
+        if b is None:
+            b = self._next_bracket % len(self.brackets)
+            self._next_bracket += 1
+            self._assigned[trial.trial_id] = b
+        return b
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        self._require_metric()
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        b = self._bracket_of(trial)
+        for rung in reversed(self.brackets[b]):
+            if t >= rung and (b, rung) not in trial.reached_rungs:
+                trial.reached_rungs.add((b, rung))
+                recorded = self._recorded.setdefault((b, rung), [])
+                recorded.append(value)
+                if len(recorded) < self.eta:
+                    return CONTINUE
+                ordered = sorted(recorded, reverse=(self.mode == "max"))
+                cutoff = ordered[max(0, len(ordered) // self.eta - 1)]
+                good = (value >= cutoff if self.mode == "max"
+                        else value <= cutoff)
+                return CONTINUE if good else STOP
+        return CONTINUE

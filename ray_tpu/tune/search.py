@@ -116,6 +116,139 @@ class BasicVariantGenerator(Searcher):
         return self._queue.pop(0) if self._queue else None
 
 
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator searcher.
+
+    Reference role: python/ray/tune/search/optuna/optuna_search.py (the
+    reference delegates model-based suggestion to plugin libraries; this
+    is a from-scratch TPE behind the same Searcher interface, so plugin
+    searchers and this one are interchangeable).
+
+    Classic TPE: past observations split at the gamma-quantile of the
+    objective into good/bad sets; per-dimension Parzen (KDE) densities
+    l(x) (good) and g(x) (bad); candidates are drawn from l and ranked by
+    the acquisition log l(x) - log g(x).  Dimensions are treated
+    independently; Float dims with log=True are modeled in log space;
+    Categorical dims use smoothed count ratios.
+    """
+
+    def __init__(self, space: Dict[str, Any], metric: str,
+                 mode: str = "min", n_startup: int = 10,
+                 n_candidates: int = 24, gamma: float = 0.25,
+                 seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self._space = {k: v for k, v in space.items()}
+        self._metric = metric
+        self._mode = mode
+        self._n_startup = n_startup
+        self._n_candidates = n_candidates
+        self._gamma = gamma
+        self._rng = random.Random(seed)
+        self._configs: Dict[str, dict] = {}     # trial_id -> config
+        self._obs: List[tuple] = []             # (config, objective)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _to_unit(self, dom, value: float) -> float:
+        import math
+        if isinstance(dom, Float) and dom.log:
+            lo, hi = math.log(dom.low), math.log(dom.high)
+            return (math.log(value) - lo) / (hi - lo)
+        lo, hi = float(dom.low), float(dom.high)
+        return (float(value) - lo) / (hi - lo)
+
+    def _from_unit(self, dom, u: float):
+        import math
+        u = min(max(u, 0.0), 1.0)
+        if isinstance(dom, Float):
+            if dom.log:
+                lo, hi = math.log(dom.low), math.log(dom.high)
+                return math.exp(lo + u * (hi - lo))
+            return dom.low + u * (dom.high - dom.low)
+        return min(int(dom.low + u * (dom.high - dom.low)), dom.high - 1)
+
+    @staticmethod
+    def _kde_logpdf(x: float, centers: List[float], bw: float) -> float:
+        import math
+        if not centers:
+            return 0.0
+        acc = 0.0
+        for c in centers:
+            z = (x - c) / bw
+            acc += math.exp(-0.5 * z * z)
+        return math.log(max(acc / (len(centers) * bw), 1e-12))
+
+    def _split(self):
+        vals = sorted(o for _, o in self._obs)
+        n_good = max(1, int(self._gamma * len(vals)))
+        cut = vals[n_good - 1]
+        good = [c for c, o in self._obs if o <= cut][:n_good * 2]
+        bad = [c for c, o in self._obs if o > cut]
+        return good, bad or [c for c, _ in self._obs]
+
+    # -- Searcher ----------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        import math
+        cfg: dict = {}
+        model = len(self._obs) >= self._n_startup
+        good = bad = None
+        if model:
+            good, bad = self._split()
+        for key, dom in self._space.items():
+            if not isinstance(dom, Domain):
+                cfg[key] = dom
+                continue
+            if isinstance(dom, Categorical):
+                if not model:
+                    cfg[key] = dom.sample(self._rng)
+                    continue
+                cats = dom.categories
+
+                def smoothed(obs_set):
+                    counts = {c: 1.0 for c in cats}  # +1 prior
+                    for c_cfg in obs_set:
+                        counts[c_cfg[key]] = counts.get(c_cfg[key], 1.) + 1
+                    total = sum(counts.values())
+                    return {c: counts[c] / total for c in cats}
+
+                pl, pg = smoothed(good), smoothed(bad)
+                cfg[key] = max(
+                    cats, key=lambda c: math.log(pl[c]) - math.log(pg[c])
+                    + self._rng.random() * 1e-6)
+                continue
+            if not model:
+                cfg[key] = dom.sample(self._rng)
+                continue
+            gu = [self._to_unit(dom, c[key]) for c in good]
+            bu = [self._to_unit(dom, c[key]) for c in bad]
+            bw_g = max(1.0 / math.sqrt(len(gu) + 1), 0.05)
+            bw_b = max(1.0 / math.sqrt(len(bu) + 1), 0.05)
+            best_u, best_score = None, -1e18
+            for _ in range(self._n_candidates):
+                center = self._rng.choice(gu)
+                u = center + self._rng.gauss(0.0, bw_g)
+                u = min(max(u, 0.0), 1.0)
+                score = (self._kde_logpdf(u, gu, bw_g)
+                         - self._kde_logpdf(u, bu, bw_b))
+                if score > best_score:
+                    best_u, best_score = u, score
+            cfg[key] = self._from_unit(dom, best_u)
+        self._configs[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None or error or not result \
+                or self._metric not in result:
+            return
+        value = float(result[self._metric])
+        if self._mode == "max":
+            value = -value
+        self._obs.append((cfg, value))
+
+
 class ConcurrencyLimiter(Searcher):
     """Cap in-flight suggestions (reference: concurrency_limiter.py)."""
 

@@ -3,15 +3,22 @@ cluster processes.
 
 Reference parity: python/ray/util/collective/collective.py
 (init_collective_group:120, allreduce:258, broadcast:373, allgather:423,
-reducescatter:472, send:531/recv:594, barrier) with group rendezvous via a
-named actor holding the NCCL unique id.
+reducescatter:472, send:531/recv:594, barrier) with the gloo backend's
+ring data movement (collective_group/gloo_collective_group.py).
 
 TPU-first split: this module is the HOST plane — control/bulk collectives
-between actor processes over the object store (the reference's gloo
-backend role).  The accelerator plane is NOT here: device-array
-collectives compile to XLA psum/all-gather/reduce-scatter over the ICI
-mesh (ray_tpu.parallel + jax shardings), which is the reference's NCCL
-path re-imagined for TPU (SURVEY §2.5 mapping).
+between actor processes over the object store.  The accelerator plane is
+NOT here: device-array collectives compile to XLA psum/all-gather/
+reduce-scatter over the ICI mesh (ray_tpu.parallel + jax shardings),
+which is the reference's NCCL path re-imagined for TPU (SURVEY §2.5).
+
+Data plane: bulk payloads are ring-passed as OBJECT-STORE objects —
+rank r puts a segment, its neighbour pulls it store-to-store (the native
+TCP plane moves the bytes shm-to-shm) — while the named coordinator
+actor relays only ObjectRefs and acks (~100 bytes per hop).  Ring
+allreduce moves 2*(W-1)/W of the tensor per rank, like gloo's ring.
+Payloads under _SMALL bypass the store: the ref+pull round trips cost
+more than shipping tiny arrays through the coordinator directly.
 
 Usage (inside each participating actor/driver process):
 
@@ -31,18 +38,42 @@ import ray_tpu
 
 _COORD_PREFIX = "_collective_coord:"
 _OPS = ("SUM", "PRODUCT", "MIN", "MAX")
+_SMALL = 64 * 1024  # bytes: below this, data rides the coordinator
+
+
+def _reduce2(a: np.ndarray, b: np.ndarray, op: str) -> np.ndarray:
+    if op == "SUM":
+        return a + b
+    if op == "PRODUCT":
+        return a * b
+    if op == "MIN":
+        return np.minimum(a, b)
+    if op == "MAX":
+        return np.maximum(a, b)
+    raise ValueError(f"unknown op {op}")
 
 
 class _Coordinator:
-    """Named async actor: one per group; synchronizes each collective call
-    and computes reductions (the reference's rendezvous-actor role, plus
-    the gloo data plane since the host plane has no NCCL)."""
+    """Named async actor: one per group.  For bulk collectives it is pure
+    CONTROL plane — mailboxes of ObjectRefs + acks + barriers; payload
+    bytes never pass through it.  Sub-_SMALL payloads use the legacy
+    direct methods (gather/reduce in-actor)."""
 
     def __init__(self, world_size: int):
         self.world = world_size
         self._calls: Dict[tuple, dict] = {}   # (kind, seq) -> state
-        self._p2p: Dict[tuple, Any] = {}      # (seq-less src->dst tag) -> data
-        self._p2p_events: Dict[tuple, asyncio.Event] = {}
+        self._boxes: Dict[tuple, Any] = {}    # mailbox tag -> ref/data
+        self._box_events: Dict[tuple, asyncio.Event] = {}
+        self._acks: Dict[tuple, asyncio.Event] = {}
+        # Payload bytes that crossed THIS actor (small-path only; the ring
+        # plane moves refs, so this must stay ~0 for bulk collectives —
+        # asserted in tests).
+        self.bytes_through = 0
+
+    def payload_bytes_through(self) -> int:
+        return self.bytes_through
+
+    # ---- shared machinery ------------------------------------------------
 
     def _state(self, key):
         st = self._calls.get(key)
@@ -66,71 +97,99 @@ class _Coordinator:
         if st["done"] == self.world:
             del self._calls[key]
 
-    async def allreduce(self, seq: int, rank: int, data, op: str):
+    def _ev(self, table: dict, tag) -> asyncio.Event:
+        ev = table.get(tag)
+        if ev is None:
+            ev = table[tag] = asyncio.Event()
+        return ev
+
+    # ---- ring control plane (refs only) ---------------------------------
+
+    async def exchange(self, out_tag, in_tag, ref):
+        """Drop `ref` in out_tag's mailbox; wait for and return in_tag's."""
+        self._boxes[out_tag] = ref
+        self._ev(self._box_events, out_tag).set()
+        await self._ev(self._box_events, in_tag).wait()
+        got = self._boxes.pop(in_tag)
+        del self._box_events[in_tag]
+        return got
+
+    async def ack_and_wait(self, acked_tag, my_tag):
+        """Ack consumption of acked_tag's payload, then wait until MY
+        outgoing payload was consumed — the sender may then free it
+        (bounds live segments to ~2 per rank during a ring)."""
+        self._ev(self._acks, acked_tag).set()
+        await self._ev(self._acks, my_tag).wait()
+        del self._acks[my_tag]
+        return True
+
+    async def ack(self, tag):
+        self._ev(self._acks, tag).set()
+        return True
+
+    async def wait_ack(self, tag):
+        await self._ev(self._acks, tag).wait()
+        del self._acks[tag]
+        return True
+
+    async def gather_refs(self, seq, rank, ref):
+        """All-to-all ref exchange (allgather/broadcast control)."""
+        self.bytes_through += getattr(ref, "nbytes", 0)
+        st = await self._gather(("gr", seq), rank, ref)
+        result = [st["data"][r] for r in range(self.world)]
+        self._maybe_gc(("gr", seq), st)
+        return result
+
+    async def barrier(self, seq, rank):
+        st = await self._gather(("ba", seq), rank, None)
+        self._maybe_gc(("ba", seq), st)
+        return True
+
+    # ---- small-payload direct plane -------------------------------------
+
+    async def allreduce_small(self, seq, rank, data, op: str):
+        self.bytes_through += getattr(data, "nbytes", 0)
         st = await self._gather(("ar", seq, op), rank, data)
         if "result" not in st:
             arrs = [np.asarray(st["data"][r]) for r in range(self.world)]
-            if op == "SUM":
-                out = sum(arrs[1:], arrs[0].copy())
-            elif op == "PRODUCT":
-                out = arrs[0].copy()
-                for a in arrs[1:]:
-                    out = out * a
-            elif op == "MIN":
-                out = np.minimum.reduce(arrs)
-            elif op == "MAX":
-                out = np.maximum.reduce(arrs)
-            else:
-                raise ValueError(f"unknown op {op}")
+            out = arrs[0].copy()
+            for a in arrs[1:]:
+                out = _reduce2(out, a, op)
             st["result"] = out
         result = st["result"]
         self._maybe_gc(("ar", seq, op), st)
         return result
 
-    async def allgather(self, seq: int, rank: int, data):
+    async def allgather_small(self, seq, rank, data):
+        self.bytes_through += getattr(data, "nbytes", 0)
         st = await self._gather(("ag", seq), rank, data)
         result = [st["data"][r] for r in range(self.world)]
         self._maybe_gc(("ag", seq), st)
         return result
 
-    async def reducescatter(self, seq: int, rank: int, data, op: str):
+    async def reducescatter_small(self, seq, rank, data, op: str):
+        self.bytes_through += getattr(data, "nbytes", 0)
         st = await self._gather(("rs", seq, op), rank, data)
         if "result" not in st:
             arrs = [np.asarray(st["data"][r]) for r in range(self.world)]
-            total = sum(arrs[1:], arrs[0].copy()) if op == "SUM" else None
-            if total is None:
-                raise ValueError(f"reducescatter supports SUM, got {op}")
+            total = arrs[0].copy()
+            for a in arrs[1:]:
+                total = _reduce2(total, a, op)
             st["result"] = np.array_split(total, self.world)
         result = st["result"][rank]
         self._maybe_gc(("rs", seq, op), st)
         return result
 
-    async def broadcast(self, seq: int, rank: int, data, src: int):
-        st = self._state(("bc", seq, src))
-        if rank == src:
-            st["data"][src] = data
-            st["event"].set()
-        else:
-            await st["event"].wait()
-        result = st["data"][src]
-        self._maybe_gc(("bc", seq, src), st)
-        return result
-
-    async def barrier(self, seq: int, rank: int):
-        st = await self._gather(("ba", seq), rank, None)
-        self._maybe_gc(("ba", seq), st)
-        return True
-
     async def send(self, tag: tuple, data):
-        self._p2p[tag] = data
-        self._p2p_events.setdefault(tag, asyncio.Event()).set()
+        self.bytes_through += getattr(data, "nbytes", 0)
+        self._boxes[tag] = data
+        self._ev(self._box_events, tag).set()
         return True
 
     async def recv(self, tag: tuple):
-        ev = self._p2p_events.setdefault(tag, asyncio.Event())
-        await ev.wait()
-        data = self._p2p.pop(tag)
-        del self._p2p_events[tag]
+        await self._ev(self._box_events, tag).wait()
+        data = self._boxes.pop(tag)
+        del self._box_events[tag]
         return data
 
 
@@ -202,31 +261,109 @@ def _group(name: str) -> _Group:
     return g
 
 
+# ---------------------------------------------------------------------------
+# Ring data plane
+# ---------------------------------------------------------------------------
+
+def _ring_exchange(g: _Group, tag: tuple, payload: np.ndarray) -> np.ndarray:
+    """One ring step: hand `payload` to the right neighbour, receive the
+    left neighbour's, via refs through the coordinator.  Returns after the
+    right neighbour has CONSUMED our payload, so the put ref may be freed
+    immediately (live segments stay O(1))."""
+    right = (g.rank + 1) % g.world
+    left = (g.rank - 1) % g.world
+    ref = ray_tpu.put(payload)
+    out_tag = tag + (g.rank, right)
+    in_tag = tag + (left, g.rank)
+    got_ref = ray_tpu.get(g.coord.exchange.remote(out_tag, in_tag, ref))
+    data = np.asarray(ray_tpu.get(got_ref))
+    ray_tpu.get(g.coord.ack_and_wait.remote(in_tag, out_tag))
+    return data
+
+
+def _ring_reduce_scatter(g: _Group, flat: np.ndarray, seq: int,
+                         op: str) -> list:
+    """In-place ring reduce-scatter over np.array_split segments; after
+    W-1 steps rank r holds the fully reduced segment (r+1) % W."""
+    segs = [s.copy() for s in np.array_split(flat, g.world)]
+    for step in range(g.world - 1):
+        send_idx = (g.rank - step) % g.world
+        recv_idx = (g.rank - step - 1) % g.world
+        incoming = _ring_exchange(g, ("rs", seq, step), segs[send_idx])
+        segs[recv_idx] = _reduce2(segs[recv_idx], incoming, op)
+    return segs
+
+
 def allreduce(tensor, group_name: str = "default", op: str = "SUM"):
     g = _group(group_name)
     if op not in _OPS:
         raise ValueError(f"op must be one of {_OPS}")
-    return ray_tpu.get(g.coord.allreduce.remote(
-        g.next_seq(), g.rank, np.asarray(tensor), op))
-
-
-def allgather(tensor, group_name: str = "default"):
-    g = _group(group_name)
-    return [np.asarray(x) for x in ray_tpu.get(
-        g.coord.allgather.remote(g.next_seq(), g.rank,
-                                 np.asarray(tensor)))]
+    arr = np.asarray(tensor)
+    seq = g.next_seq()
+    if g.world == 1:
+        return arr.copy()
+    if arr.nbytes < _SMALL:
+        return np.asarray(ray_tpu.get(g.coord.allreduce_small.remote(
+            seq, g.rank, arr, op))).reshape(arr.shape)
+    flat = arr.reshape(-1)
+    segs = _ring_reduce_scatter(g, flat, seq, op)
+    # Ring allgather of the reduced segments.
+    for step in range(g.world - 1):
+        send_idx = (g.rank + 1 - step) % g.world
+        recv_idx = (g.rank - step) % g.world
+        segs[recv_idx] = _ring_exchange(g, ("ag", seq, step),
+                                        segs[send_idx])
+    return np.concatenate(segs).reshape(arr.shape)
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "SUM"):
     g = _group(group_name)
-    return np.asarray(ray_tpu.get(g.coord.reducescatter.remote(
-        g.next_seq(), g.rank, np.asarray(tensor), op)))
+    if op not in _OPS:
+        raise ValueError(f"op must be one of {_OPS}")
+    arr = np.asarray(tensor)
+    seq = g.next_seq()
+    if g.world == 1:
+        return arr.copy()
+    if arr.nbytes < _SMALL:
+        return np.asarray(ray_tpu.get(g.coord.reducescatter_small.remote(
+            seq, g.rank, arr, op)))
+    segs = _ring_reduce_scatter(g, arr.reshape(-1), seq, op)
+    return segs[(g.rank + 1) % g.world]
+
+
+def allgather(tensor, group_name: str = "default"):
+    g = _group(group_name)
+    arr = np.asarray(tensor)
+    seq = g.next_seq()
+    if g.world == 1:
+        return [arr.copy()]
+    if arr.nbytes < _SMALL:
+        return [np.asarray(x) for x in ray_tpu.get(
+            g.coord.allgather_small.remote(seq, g.rank, arr))]
+    # Refs through the coordinator, payloads store-to-store.
+    ref = ray_tpu.put(arr)
+    refs = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, ref))
+    out = [np.asarray(ray_tpu.get(r)) for r in refs]
+    # Everyone fetched before any rank's put ref can die.
+    ray_tpu.get(g.coord.barrier.remote(("agf", seq), g.rank))
+    return out
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _group(group_name)
-    return np.asarray(ray_tpu.get(g.coord.broadcast.remote(
-        g.next_seq(), g.rank, np.asarray(tensor), src_rank)))
+    arr = np.asarray(tensor)
+    seq = g.next_seq()
+    if g.world == 1:
+        return arr.copy()
+    if arr.nbytes < _SMALL:
+        refs = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, arr))
+        return np.asarray(refs[src_rank])
+    ref = ray_tpu.put(arr) if g.rank == src_rank else None
+    refs = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, ref))
+    out = (arr.copy() if g.rank == src_rank
+           else np.asarray(ray_tpu.get(refs[src_rank])))
+    ray_tpu.get(g.coord.barrier.remote(("bcf", seq), g.rank))
+    return out
 
 
 def barrier(group_name: str = "default") -> None:
@@ -236,11 +373,18 @@ def barrier(group_name: str = "default") -> None:
 
 def send(tensor, dest_rank: int, group_name: str = "default") -> None:
     g = _group(group_name)
+    arr = np.asarray(tensor)
     key = (g.rank, dest_rank)
     n = g.p2p_seq.get(key, 0)
     g.p2p_seq[key] = n + 1
-    ray_tpu.get(g.coord.send.remote(("p2p", g.rank, dest_rank, n),
-                                    np.asarray(tensor)))
+    tag = ("p2p", g.rank, dest_rank, n)
+    if arr.nbytes < _SMALL:
+        ray_tpu.get(g.coord.send.remote(tag, arr))
+        return
+    ref = ray_tpu.put(arr)
+    ray_tpu.get(g.coord.send.remote(tag, ref))
+    # Block until the receiver consumed the payload; the ref may then die.
+    ray_tpu.get(g.coord.wait_ack.remote(tag + ("ack",)))
 
 
 def recv(src_rank: int, group_name: str = "default"):
@@ -248,5 +392,10 @@ def recv(src_rank: int, group_name: str = "default"):
     key = (src_rank, g.rank)
     n = g.p2p_seq.get(key, 0)
     g.p2p_seq[key] = n + 1
-    return np.asarray(ray_tpu.get(
-        g.coord.recv.remote(("p2p", src_rank, g.rank, n))))
+    tag = ("p2p", src_rank, g.rank, n)
+    got = ray_tpu.get(g.coord.recv.remote(tag))
+    if isinstance(got, ray_tpu.ObjectRef):
+        data = np.asarray(ray_tpu.get(got))
+        ray_tpu.get(g.coord.ack.remote(tag + ("ack",)))
+        return data
+    return np.asarray(got)

@@ -524,10 +524,12 @@ def test_system_config_flags(cluster):
 
     assert GLOBAL_CONFIG.task_max_retries == 3
     os.environ["RAY_TPU_TASK_MAX_RETRIES"] = "7"
+    GLOBAL_CONFIG.invalidate_cache()
     try:
         assert GLOBAL_CONFIG.task_max_retries == 7
     finally:
         del os.environ["RAY_TPU_TASK_MAX_RETRIES"]
+        GLOBAL_CONFIG.invalidate_cache()
 
     cfg = RayTpuConfig()
     cfg.apply_system_config({"lease_idle_ttl_s": 2.5})

@@ -333,3 +333,64 @@ def test_flash_attention_non_power_of_two_multiple_stays_pallas():
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_spmd_fused_ce_matches_naive_dp2_tp2():
+    """Numerics gate for the mesh fused cross-entropy: loss AND grads at
+    dp2/tp2(/sp2) must match the naive materialized-logits loss to fp32
+    epsilon (VERDICT r2 item: the mesh path must never re-pay the [T,V]
+    materialization the single-chip bench eliminated)."""
+    from ray_tpu.ops.cross_entropy import (fused_cross_entropy_spmd,
+                                           spmd_ce_applicable)
+
+    B, L, D, V = 4, 8, 16, 32
+    x = jax.random.normal(jax.random.key(0), (B, L, D), jnp.float32)
+    head = jax.random.normal(jax.random.key(1), (D, V), jnp.float32)
+    t = jax.random.randint(jax.random.key(2), (B, L), 0, V)
+    valid = jnp.ones((B, L), jnp.float32).at[:, -1].set(0.0)
+
+    def naive(x, head):
+        logits = (x @ head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(logp, t[..., None], -1)[..., 0]
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    ln = naive(x, head)
+    gn = jax.grad(naive, argnums=(0, 1))(x, head)
+    for shape in (MeshConfig(data=2, fsdp=1, tensor=2, seq=2),
+                  MeshConfig(data=2, fsdp=2, tensor=2, seq=1)):
+        mesh = create_mesh(shape)
+        assert spmd_ce_applicable(mesh, V, B, L)
+        with mesh:
+            def f(x, h):
+                return fused_cross_entropy_spmd(x, h, t, valid, mesh)
+            ls = jax.jit(f)(x, head)
+            gs = jax.jit(jax.grad(f, argnums=(0, 1)))(x, head)
+        assert abs(float(ln - ls)) < 1e-5
+        assert float(jnp.max(jnp.abs(gn[0] - gs[0]))) < 1e-6
+        assert float(jnp.max(jnp.abs(gn[1] - gs[1]))) < 1e-6
+
+
+def test_gpt_mesh_loss_uses_spmd_fused_ce(monkeypatch):
+    """The model loss under a mesh must route through the shard_map fused
+    CE (not the materialized-logits fallback) for divisible shapes."""
+    from ray_tpu.ops import cross_entropy as ce
+
+    called = {}
+    real = ce.fused_cross_entropy_spmd
+
+    def spy(x, head, targets, valid, mesh, n_chunks=4):
+        called["hit"] = True
+        return real(x, head, targets, valid, mesh, n_chunks)
+
+    monkeypatch.setattr(ce, "fused_cross_entropy_spmd", spy)
+    cfg = gpt.CONFIGS["nano"]
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    params = gpt.shard_params(gpt.init_params(cfg, jax.random.key(0)),
+                              mesh, cfg)
+    batch = shard_batch(mesh, _tiny_batch(cfg, batch=8))
+    with mesh:
+        loss = jax.jit(lambda p, b: gpt.loss_fn(p, b, cfg, mesh))(
+            params, batch)
+    assert np.isfinite(float(loss))
+    assert called.get("hit")

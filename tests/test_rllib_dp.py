@@ -1,0 +1,99 @@
+"""Data-parallel learner group tests (VERDICT r2 item 2).
+
+Reference parity: rllib/core/learner/learner_group.py:51 +
+torch_learner.py:154 — the reference scales learners as a DDP-wrapped
+actor fleet; here the learner is ONE SPMD program over the mesh's data
+axis with a pmean on gradients.  The gate: a dp-8 learner must walk the
+same parameter trajectory as the single-chip learner on the same batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+from ray_tpu.rllib.learner import JaxLearner, ppo_loss
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _fake_ppo_batch(n=512, obs_dim=6, num_actions=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return SampleBatch({
+        SampleBatch.OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        SampleBatch.ACTIONS: rng.integers(0, num_actions, size=n)
+            .astype(np.int32),
+        SampleBatch.ACTION_LOGP: rng.normal(size=n).astype(np.float32)
+            * 0.1 - 1.0,
+        SampleBatch.ADVANTAGES: rng.normal(size=n).astype(np.float32),
+        SampleBatch.VALUE_TARGETS: rng.normal(size=n).astype(np.float32),
+    })
+
+
+def _make_learner(mesh):
+    return JaxLearner(
+        6, 3, loss_fn=ppo_loss,
+        config={"lr": 3e-3, "grad_clip": 0.5, "num_sgd_iter": 4,
+                "sgd_minibatch_size": 128, "clip_param": 0.2},
+        seed=7, mesh=mesh)
+
+
+def test_dp8_learner_matches_single_chip():
+    """dp8 and dp1 run the SAME global permutation and per-minibatch
+    advantage normalization; gradients pmean to the exact global-minibatch
+    gradient, so parameters must match to fp-summation-order tolerance."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    batch = _fake_ppo_batch()
+    dp8 = _make_learner(create_mesh(MeshConfig(data=8, fsdp=1)))
+    dp1 = _make_learner(create_mesh(MeshConfig(data=1, fsdp=1),
+                                    devices=devs[:1]))
+    m8 = dp8.update(batch)
+    m1 = dp1.update(batch)
+    for p8, p1 in zip(jax.tree_util.tree_leaves(dp8.get_weights()),
+                      jax.tree_util.tree_leaves(dp1.get_weights())):
+        np.testing.assert_allclose(p8, p1, rtol=1e-4, atol=1e-5)
+    assert abs(m8["total_loss"] - m1["total_loss"]) < 1e-3
+
+
+def test_dp_learner_rejects_model_axes():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    with pytest.raises(ValueError, match="data-parallel only"):
+        _make_learner(create_mesh(MeshConfig(data=4, tensor=2)))
+
+
+def test_impala_vtrace_learner_dp():
+    """dp V-trace learner: fragment columns slice exactly (V-trace is
+    per-sequence), so dp-8 matches the single-chip step."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from ray_tpu.rllib.impala import IMPALAConfig, _VTraceLearner
+
+    T, B, obs_dim, acts = 16, 8, 4, 2
+    rng = np.random.default_rng(1)
+    batch = SampleBatch({
+        SampleBatch.OBS: rng.normal(size=(T, B, obs_dim))
+            .astype(np.float32),
+        SampleBatch.ACTIONS: rng.integers(0, acts, size=(T, B))
+            .astype(np.int32),
+        SampleBatch.ACTION_LOGP: (rng.normal(size=(T, B)) * 0.1 - 0.7)
+            .astype(np.float32),
+        SampleBatch.REWARDS: rng.normal(size=(T, B)).astype(np.float32),
+        SampleBatch.TERMINATEDS: np.zeros((T, B), bool),
+        SampleBatch.TRUNCATEDS: np.zeros((T, B), bool),
+        "bootstrap_obs": rng.normal(size=(B, obs_dim)).astype(np.float32),
+    })
+    cfg = IMPALAConfig()
+    single = _VTraceLearner(obs_dim, acts, cfg, (32,), 3)
+    dp = _VTraceLearner(obs_dim, acts, cfg, (32,), 3,
+                        mesh=create_mesh(MeshConfig(data=8, fsdp=1)))
+    ms = single.update(batch)
+    md = dp.update(batch)
+    for ps, pd in zip(jax.tree_util.tree_leaves(single.get_weights()),
+                      jax.tree_util.tree_leaves(dp.get_weights())):
+        np.testing.assert_allclose(ps, pd, rtol=1e-4, atol=1e-5)
+    assert abs(ms["total_loss"] - md["total_loss"]) < 1e-3
