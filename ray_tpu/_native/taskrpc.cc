@@ -59,6 +59,7 @@ enum {
   TPT_ECONN = -1,   // connection closed / reset with requests in flight
   TPT_ESYS = -2,
   TPT_EARG = -3,
+  TPT_EBUF = -4,    // head record exceeds caller buffer; *used = needed size
 };
 
 struct Buf {
@@ -518,7 +519,14 @@ int tpt_poll(void* h, uint8_t* buf, uint64_t cap, uint64_t* used,
                      [&] { return !cl->completions.empty()
                                   || cl->stop.load(); });
   }
-  return int(pack_records(cl->completions, buf, cap, used));
+  int n = int(pack_records(cl->completions, buf, cap, used));
+  if (n == 0 && !cl->completions.empty()) {
+    // Head record alone exceeds `cap`: without this signal it would sit
+    // at the queue head forever, wedging every later completion.
+    *used = 28 + cl->completions.front().payload.size();
+    return TPT_EBUF;
+  }
+  return n;
 }
 
 void tpt_client_close(void* h) {
@@ -583,7 +591,12 @@ int tpt_server_pop(void* h, uint8_t* buf, uint64_t cap, uint64_t* used,
     s->tcv.wait_for(g, std::chrono::milliseconds(timeout_ms),
                     [&] { return !s->tasks.empty() || s->stop.load(); });
   }
-  return int(pack_records(s->tasks, buf, cap, used));
+  int n = int(pack_records(s->tasks, buf, cap, used));
+  if (n == 0 && !s->tasks.empty()) {
+    *used = 28 + s->tasks.front().payload.size();
+    return TPT_EBUF;
+  }
+  return n;
 }
 
 int tpt_server_reply(void* h, uint64_t conn_tag, uint64_t req_id,

@@ -91,6 +91,7 @@ class _PendingTask:
     cancelled: bool = False              # ray.cancel requested
     worker_address: str | None = None    # where the task was pushed
     payload: bytes | None = None         # pre-pickled PushTask request
+    payload_epoch_base: int = 0          # sub.epoch_base baked into payload
 
 
 class _ActorSubmitter:
@@ -379,36 +380,38 @@ class CoreWorker:
     def _enqueue_actor_native(self, req, reply):
         """Per-caller in-order release, same window logic as the RPC path
         (_enqueue_actor_task) but completing via the native reply stream.
-        The lock makes the window safe from the tpt-exec thread."""
+        The lock makes the window safe from the tpt-exec thread.
+
+        Tasks are released onto the SAME exec_queue as the RPC path (with
+        a callable done-sink in place of an asyncio future, loop=None):
+        a sync actor with mixed-transport callers must still run its
+        methods strictly serialized on the one exec thread, and the held
+        window must hold one entry shape."""
         spec: TaskSpec = req["spec"]
         caller = req.get("caller", b"")
         wire_seq = req.get("seq", spec.seq_no)
-        run_now = []
+        entry = (spec, self._native_done_sink(reply), None)
         with self._native_seq_lock:
             state = self._actor_seq_state.setdefault(
                 caller, {"next": 0, "held": {}})
             if wire_seq < state["next"]:
-                run_now.append((spec, reply))
-            else:
-                state["held"][wire_seq] = (spec, reply)
-                while state["next"] in state["held"]:
-                    run_now.append(state["held"].pop(state["next"]))
-                    state["next"] += 1
-        for sp, rp in run_now:
-            self._dispatch_actor_native(sp, rp)
+                self.exec_queue.put(entry)
+                return
+            state["held"][wire_seq] = entry
+            while state["next"] in state["held"]:
+                self.exec_queue.put(state["held"].pop(state["next"]))
+                state["next"] += 1
 
-    def _dispatch_actor_native(self, spec: TaskSpec, reply):
+    @staticmethod
+    def _native_done_sink(reply):
         import pickle as _pickle
-        if self._async_loop is not None:
-            def _complete(r, rp=reply):
-                rp(_pickle.dumps(r, protocol=5))
-            asyncio.run_coroutine_threadsafe(
-                self._execute_actor_async(spec, _complete),
-                self._async_loop)
-        elif self._exec_pool is not None:
-            self._exec_pool.submit(self._run_one_native, spec, reply)
-        else:
-            self._run_one_native(spec, reply)
+
+        def sink(r):
+            try:
+                reply(_pickle.dumps(r, protocol=5))
+            except Exception:
+                logger.exception("native reply failed")
+        return sink
 
     # ---- native-transport submission side ----
 
@@ -1510,10 +1513,11 @@ class CoreWorker:
         if self._native_on:
             import pickle as _pickle
             with sub.lock:
-                wire_seq = seq_no - sub.epoch_base
+                epoch_base = sub.epoch_base
             pending.payload = _pickle.dumps(
                 {"spec": spec, "caller": self.worker_id.binary(),
-                 "seq": wire_seq}, protocol=5)
+                 "seq": seq_no - epoch_base}, protocol=5)
+            pending.payload_epoch_base = epoch_base
         self.tasks[task_id] = pending
         self._enqueue_fast(("actor", sub, task_id))
         return True
@@ -1525,7 +1529,13 @@ class CoreWorker:
         if pending is None:
             return
         addr = sub.address
-        if addr and pending.payload is not None and self._native_sub:
+        if (addr and pending.payload is not None and self._native_sub
+                and pending.payload_epoch_base == sub.epoch_base):
+            # The epoch check guards a submit-time-baked wire seq: a
+            # restart detected between payload build and this dispatch
+            # rebases epoch_base, and a stale (too-large) wire seq could
+            # collide in the receiver's held window.  Rebased tasks take
+            # the slow path, which computes the seq fresh per attempt.
             naddr = self._native_addrs.get(addr)
             if naddr:
                 fut = self._native_sub.call(naddr, pending.payload)
@@ -1764,8 +1774,11 @@ class CoreWorker:
             is_actor_call = spec.actor_id is not None and not spec.actor_creation
             if is_actor_call and self._async_loop is not None:
                 def _complete(r, d=done, lp=loop):
-                    lp.call_soon_threadsafe(
-                        lambda: d.done() or d.set_result(r))
+                    if lp is None:
+                        d(r)  # native done-sink: pickles + streams reply
+                    else:
+                        lp.call_soon_threadsafe(
+                            lambda: d.done() or d.set_result(r))
                 asyncio.run_coroutine_threadsafe(
                     self._execute_actor_async(spec, _complete),
                     self._async_loop)
@@ -1785,8 +1798,11 @@ class CoreWorker:
             # landing in the sliver between the task body returning and the
             # running-task deregistration; don't kill the exec thread.
             reply = self._error_reply(spec, e)
-        loop.call_soon_threadsafe(
-            lambda d=done, r=reply: d.done() or d.set_result(r))
+        if loop is None:
+            done(reply)  # native done-sink
+        else:
+            loop.call_soon_threadsafe(
+                lambda d=done, r=reply: d.done() or d.set_result(r))
 
     def _setup_actor_execution(self, cls, spec: TaskSpec):
         """Choose the actor's execution mode after __init__ succeeds.

@@ -189,9 +189,13 @@ class NodeDaemon:
             # TPU registration (the site hook imports jax + the PJRT plugin
             # — ~2s of the ~2.3s worker boot).  Non-TPU workers boot in
             # ~0.3s, and user jax code in them falls back to host CPU.
+            # Forced unconditionally (not just for the axon plugin): on a
+            # standard PJRT host an unset/"tpu" JAX_PLATFORMS would still
+            # auto-init the TPU runtime and could seize exclusive-access
+            # chips away from TPU-leased workers.  A runtime_env env_vars
+            # override below still wins (applied after this).
             env.pop("PALLAS_AXON_POOL_IPS", None)
-            if "axon" in env.get("JAX_PLATFORMS", ""):
-                env["JAX_PLATFORMS"] = "cpu"
+            env["JAX_PLATFORMS"] = "cpu"
         if runtime_env:
             import json as _json
             env.update(runtime_env.get("env_vars", {}))

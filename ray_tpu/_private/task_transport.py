@@ -31,6 +31,7 @@ _REC_HDR = struct.Struct("<QQiQ")  # tag, req_id, status, payload_len
 
 TPT_OK = 0
 TPT_ECONN = -1
+TPT_EBUF = -4  # head record exceeds caller buffer; `used` = needed size
 
 
 class _Lib:
@@ -184,11 +185,18 @@ class NativeSubmitter:
     # -- completion pump --------------------------------------------------
 
     def _poll_loop(self):
-        buf = ctypes.create_string_buffer(self.POLL_BUF)
+        cap = self.POLL_BUF
+        buf = ctypes.create_string_buffer(cap)
         used = ctypes.c_uint64()
         while not self._closed:
-            n = self._l.tpt_poll(self._h, buf, self.POLL_BUF,
+            n = self._l.tpt_poll(self._h, buf, cap,
                                  ctypes.byref(used), 200)
+            if n == TPT_EBUF:
+                # Oversized head record: grow and retry (the bigger
+                # buffer sticks, so growth is amortized).
+                cap = max(cap * 2, int(used.value))
+                buf = ctypes.create_string_buffer(cap)
+                continue
             if n <= 0:
                 continue
             batch = []
@@ -254,11 +262,16 @@ class NativeReceiver:
         self._exec.start()
 
     def _exec_loop(self):
-        buf = ctypes.create_string_buffer(self.POP_BUF)
+        cap = self.POP_BUF
+        buf = ctypes.create_string_buffer(cap)
         used = ctypes.c_uint64()
         while not self._closed:
-            n = self._l.tpt_server_pop(self._h, buf, self.POP_BUF,
+            n = self._l.tpt_server_pop(self._h, buf, cap,
                                        ctypes.byref(used), 200)
+            if n == TPT_EBUF:
+                cap = max(cap * 2, int(used.value))
+                buf = ctypes.create_string_buffer(cap)
+                continue
             if n <= 0:
                 continue
             raw = ctypes.string_at(buf, used.value)

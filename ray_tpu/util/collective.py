@@ -269,14 +269,19 @@ def _ring_exchange(g: _Group, tag: tuple, payload: np.ndarray) -> np.ndarray:
     """One ring step: hand `payload` to the right neighbour, receive the
     left neighbour's, via refs through the coordinator.  Returns after the
     right neighbour has CONSUMED our payload, so the put ref may be freed
-    immediately (live segments stay O(1))."""
+    immediately (live segments stay O(1)).
+
+    The ref rides inside a 1-tuple: a BARE ObjectRef argument is resolved
+    to its value at the callee (reference semantics, _resolve_arg), which
+    would ship the whole segment through the coordinator process; a
+    nested ref stays a ref."""
     right = (g.rank + 1) % g.world
     left = (g.rank - 1) % g.world
     ref = ray_tpu.put(payload)
     out_tag = tag + (g.rank, right)
     in_tag = tag + (left, g.rank)
-    got_ref = ray_tpu.get(g.coord.exchange.remote(out_tag, in_tag, ref))
-    data = np.asarray(ray_tpu.get(got_ref))
+    got = ray_tpu.get(g.coord.exchange.remote(out_tag, in_tag, (ref,)))
+    data = np.asarray(ray_tpu.get(got[0]))
     ray_tpu.get(g.coord.ack_and_wait.remote(in_tag, out_tag))
     return data
 
@@ -284,11 +289,12 @@ def _ring_exchange(g: _Group, tag: tuple, payload: np.ndarray) -> np.ndarray:
 def _ring_reduce_scatter(g: _Group, flat: np.ndarray, seq: int,
                          op: str) -> list:
     """In-place ring reduce-scatter over np.array_split segments; after
-    W-1 steps rank r holds the fully reduced segment (r+1) % W."""
+    W-1 steps rank r holds the fully reduced segment r (matching the
+    reducescatter contract: rank i receives reduced partition i)."""
     segs = [s.copy() for s in np.array_split(flat, g.world)]
     for step in range(g.world - 1):
-        send_idx = (g.rank - step) % g.world
-        recv_idx = (g.rank - step - 1) % g.world
+        send_idx = (g.rank - step - 1) % g.world
+        recv_idx = (g.rank - step - 2) % g.world
         incoming = _ring_exchange(g, ("rs", seq, step), segs[send_idx])
         segs[recv_idx] = _reduce2(segs[recv_idx], incoming, op)
     return segs
@@ -307,10 +313,10 @@ def allreduce(tensor, group_name: str = "default", op: str = "SUM"):
             seq, g.rank, arr, op))).reshape(arr.shape)
     flat = arr.reshape(-1)
     segs = _ring_reduce_scatter(g, flat, seq, op)
-    # Ring allgather of the reduced segments.
+    # Ring allgather of the reduced segments (rank r starts holding seg r).
     for step in range(g.world - 1):
-        send_idx = (g.rank + 1 - step) % g.world
-        recv_idx = (g.rank - step) % g.world
+        send_idx = (g.rank - step) % g.world
+        recv_idx = (g.rank - step - 1) % g.world
         segs[recv_idx] = _ring_exchange(g, ("ag", seq, step),
                                         segs[send_idx])
     return np.concatenate(segs).reshape(arr.shape)
@@ -328,7 +334,7 @@ def reducescatter(tensor, group_name: str = "default", op: str = "SUM"):
         return np.asarray(ray_tpu.get(g.coord.reducescatter_small.remote(
             seq, g.rank, arr, op)))
     segs = _ring_reduce_scatter(g, arr.reshape(-1), seq, op)
-    return segs[(g.rank + 1) % g.world]
+    return segs[g.rank]
 
 
 def allgather(tensor, group_name: str = "default"):
@@ -340,10 +346,11 @@ def allgather(tensor, group_name: str = "default"):
     if arr.nbytes < _SMALL:
         return [np.asarray(x) for x in ray_tpu.get(
             g.coord.allgather_small.remote(seq, g.rank, arr))]
-    # Refs through the coordinator, payloads store-to-store.
+    # Refs through the coordinator (tuple-wrapped so they STAY refs),
+    # payloads store-to-store.
     ref = ray_tpu.put(arr)
-    refs = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, ref))
-    out = [np.asarray(ray_tpu.get(r)) for r in refs]
+    boxes = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, (ref,)))
+    out = [np.asarray(ray_tpu.get(b[0])) for b in boxes]
     # Everyone fetched before any rank's put ref can die.
     ray_tpu.get(g.coord.barrier.remote(("agf", seq), g.rank))
     return out
@@ -358,10 +365,10 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     if arr.nbytes < _SMALL:
         refs = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, arr))
         return np.asarray(refs[src_rank])
-    ref = ray_tpu.put(arr) if g.rank == src_rank else None
-    refs = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, ref))
+    box = (ray_tpu.put(arr),) if g.rank == src_rank else None
+    boxes = ray_tpu.get(g.coord.gather_refs.remote(seq, g.rank, box))
     out = (arr.copy() if g.rank == src_rank
-           else np.asarray(ray_tpu.get(refs[src_rank])))
+           else np.asarray(ray_tpu.get(boxes[src_rank][0])))
     ray_tpu.get(g.coord.barrier.remote(("bcf", seq), g.rank))
     return out
 
@@ -382,7 +389,7 @@ def send(tensor, dest_rank: int, group_name: str = "default") -> None:
         ray_tpu.get(g.coord.send.remote(tag, arr))
         return
     ref = ray_tpu.put(arr)
-    ray_tpu.get(g.coord.send.remote(tag, ref))
+    ray_tpu.get(g.coord.send.remote(tag, (ref,)))
     # Block until the receiver consumed the payload; the ref may then die.
     ray_tpu.get(g.coord.wait_ack.remote(tag + ("ack",)))
 
@@ -394,8 +401,8 @@ def recv(src_rank: int, group_name: str = "default"):
     g.p2p_seq[key] = n + 1
     tag = ("p2p", src_rank, g.rank, n)
     got = ray_tpu.get(g.coord.recv.remote(tag))
-    if isinstance(got, ray_tpu.ObjectRef):
-        data = np.asarray(ray_tpu.get(got))
+    if isinstance(got, tuple) and isinstance(got[0], ray_tpu.ObjectRef):
+        data = np.asarray(ray_tpu.get(got[0]))
         ray_tpu.get(g.coord.ack.remote(tag + ("ack",)))
         return data
     return np.asarray(got)

@@ -1,0 +1,95 @@
+"""Native task-transport (taskrpc.cc) unit tests, exercised directly
+through the ctypes binding without a cluster.
+
+Reference parity: src/ray/core_worker/transport/direct_task_transport.h:75
+(pipelined PushTask) — here the framed-TCP client/server pair plus the
+batched completion pump.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from ray_tpu._private import task_transport as tt
+
+
+@pytest.fixture
+def loop_thread():
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    yield loop
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def _roundtrip(submitter, receiver, loop, payload, timeout=15):
+    async def go():
+        return await asyncio.wait_for(
+            submitter.call(f"127.0.0.1:{receiver.port}", payload), timeout)
+    return asyncio.run_coroutine_threadsafe(go(), loop).result(timeout + 5)
+
+
+def test_pipelined_roundtrip_order(loop_thread):
+    seen = []
+    r = tt.NativeReceiver(
+        lambda payload, reply: (seen.append(payload), reply(payload + b"!")))
+    s = tt.NativeSubmitter(loop_thread)
+    try:
+        async def go():
+            futs = [s.call(f"127.0.0.1:{r.port}", b"m%d" % i)
+                    for i in range(200)]
+            return await asyncio.wait_for(asyncio.gather(*futs), 30)
+        outs = asyncio.run_coroutine_threadsafe(go(), loop_thread).result(40)
+        assert outs == [b"m%d!" % i for i in range(200)]
+        # Per-connection FIFO: the receiver saw submission order.
+        assert seen == [b"m%d" % i for i in range(200)]
+    finally:
+        s.close()
+        r.close()
+
+
+def test_oversized_record_grows_buffer(loop_thread):
+    """A request or reply bigger than the pop/poll buffer must not wedge
+    the endpoint (ADVICE r3: pack_records used to leave it queued forever);
+    the TPT_EBUF signal makes Python grow its buffer and retry."""
+
+    class SmallReceiver(tt.NativeReceiver):
+        POP_BUF = 1024
+
+    class SmallSubmitter(tt.NativeSubmitter):
+        POLL_BUF = 1024
+
+    big_reply = b"y" * (2 << 20)
+    r = SmallReceiver(lambda payload, reply: reply(big_reply))
+    s = SmallSubmitter(loop_thread)
+    try:
+        # Oversized request (4KB > 1KB pop buf) AND oversized reply (2MB >
+        # 1KB poll buf) both cross the wire; later small calls still work
+        # (nothing stuck at the queue head).
+        out = _roundtrip(s, r, loop_thread, b"x" * 4096)
+        assert out == big_reply
+        out2 = _roundtrip(s, r, loop_thread, b"tiny")
+        assert out2 == big_reply
+    finally:
+        s.close()
+        r.close()
+
+
+def test_connection_failure_fails_inflight(loop_thread):
+    ev = threading.Event()
+    r = tt.NativeReceiver(lambda payload, reply: ev.wait(10))  # never replies
+    s = tt.NativeSubmitter(loop_thread)
+    try:
+        async def go():
+            fut = s.call(f"127.0.0.1:{r.port}", b"stall")
+            await asyncio.sleep(0.2)
+            r.close()  # kill server with the request in flight
+            with pytest.raises(tt.ConnClosedError):
+                await asyncio.wait_for(fut, 10)
+            return True
+        assert asyncio.run_coroutine_threadsafe(go(), loop_thread).result(20)
+    finally:
+        ev.set()
+        s.close()
