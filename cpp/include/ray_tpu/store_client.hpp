@@ -1,0 +1,179 @@
+// ray_tpu C++ embedding API — zero-copy object-store client.
+//
+// Reference parity: the role of cpp/include/ray/api.h (the reference's C++
+// API lets native programs produce/consume cluster objects).  Scope here
+// (recorded in STATUS.md): C++ programs embed as DATA-PLANE peers — they
+// attach to a node's shared-memory object store and exchange zero-copy
+// buffers with Python tasks on the same node (native data loaders,
+// feature pipelines, sensor ingest).  Task submission from C++ rides the
+// typed-proto control plane as that migration completes; it is NOT part
+// of this header yet.
+//
+// Usage:
+//   #include <ray_tpu/store_client.hpp>
+//   auto store = ray_tpu::Store::attach("/dev/shm/ray_tpu_store_...");
+//   ray_tpu::ObjectId id = ray_tpu::ObjectId::random();
+//   store.put(id, data, size);               // visible to Python ray_tpu
+//   auto buf = store.get(id, /*timeout_ms=*/1000);   // zero-copy view
+//
+// Link against lib tpustore.so (built by ray_tpu/_native, or compile
+// objstore.cc into your binary).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+#include <string>
+
+extern "C" {
+int tpus_attach(const char* path, void** out);
+void tpus_close(void* h);
+int tpus_obj_create(void* h, const uint8_t* id, uint64_t data_size,
+                    uint64_t meta_size, uint64_t* data_off);
+int tpus_obj_seal(void* h, const uint8_t* id);
+int tpus_obj_abort(void* h, const uint8_t* id);
+int tpus_obj_get(void* h, const uint8_t* id, int64_t timeout_ms,
+                 uint64_t* data_off, uint64_t* data_size,
+                 uint64_t* meta_size);
+int tpus_obj_release(void* h, const uint8_t* id);
+int tpus_obj_contains(void* h, const uint8_t* id);
+unsigned char* tpus_base(void* h);
+}
+
+namespace ray_tpu {
+
+constexpr int kObjectIdSize = 28;  // ids.py ObjectID: 24B task + 4B index
+
+struct ObjectId {
+  uint8_t bytes[kObjectIdSize];
+
+  static ObjectId random() {
+    ObjectId id{};
+    std::random_device rd;
+    std::mt19937_64 gen(rd());
+    for (int i = 0; i < kObjectIdSize; i += 8) {
+      uint64_t v = gen();
+      std::memcpy(id.bytes + i,
+                  &v, std::min(8, kObjectIdSize - i));
+    }
+    return id;
+  }
+
+  static ObjectId from_binary(const std::string& b) {
+    if (b.size() != kObjectIdSize)
+      throw std::invalid_argument("ObjectId needs 28 bytes");
+    ObjectId id{};
+    std::memcpy(id.bytes, b.data(), kObjectIdSize);
+    return id;
+  }
+
+  std::string binary() const {
+    return std::string(reinterpret_cast<const char*>(bytes),
+                       kObjectIdSize);
+  }
+};
+
+class Store;
+
+// Zero-copy read view; releases its refcount on destruction.
+class ObjectBuffer {
+ public:
+  ObjectBuffer(ObjectBuffer&& o) noexcept
+      : store_(o.store_), id_(o.id_), data_(o.data_), size_(o.size_),
+        meta_(o.meta_), meta_size_(o.meta_size_) {
+    o.store_ = nullptr;
+  }
+  ObjectBuffer(const ObjectBuffer&) = delete;
+  ~ObjectBuffer();
+
+  const uint8_t* data() const { return data_; }
+  uint64_t size() const { return size_; }
+  const uint8_t* metadata() const { return meta_; }
+  uint64_t metadata_size() const { return meta_size_; }
+
+ private:
+  friend class Store;
+  ObjectBuffer(void* store, ObjectId id, const uint8_t* data,
+               uint64_t size, const uint8_t* meta, uint64_t meta_size)
+      : store_(store), id_(id), data_(data), size_(size), meta_(meta),
+        meta_size_(meta_size) {}
+  void* store_;
+  ObjectId id_;
+  const uint8_t* data_;
+  uint64_t size_;
+  const uint8_t* meta_;
+  uint64_t meta_size_;
+};
+
+class Store {
+ public:
+  static Store attach(const std::string& shm_path) {
+    void* h = nullptr;
+    int rc = tpus_attach(shm_path.c_str(), &h);
+    if (rc != 0)
+      throw std::runtime_error("ray_tpu: attach failed rc=" +
+                               std::to_string(rc));
+    return Store(h);
+  }
+
+  Store(Store&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Store(const Store&) = delete;
+  ~Store() {
+    if (h_) tpus_close(h_);
+  }
+
+  // Copy-in put.  For large producers prefer create()/seal() and write
+  // into the returned pointer directly (single copy total).
+  void put(const ObjectId& id, const void* data, uint64_t size,
+           const void* meta = nullptr, uint64_t meta_size = 0) {
+    uint8_t* dst = create(id, size, meta_size);
+    std::memcpy(dst, data, size);
+    if (meta_size) std::memcpy(dst + size, meta, meta_size);
+    seal(id);
+  }
+
+  // Reserve an unsealed buffer; write into it, then seal().
+  uint8_t* create(const ObjectId& id, uint64_t size,
+                  uint64_t meta_size = 0) {
+    uint64_t off = 0;
+    int rc = tpus_obj_create(h_, id.bytes, size, meta_size, &off);
+    if (rc != 0)
+      throw std::runtime_error("ray_tpu: create failed rc=" +
+                               std::to_string(rc));
+    return tpus_base(h_) + off;
+  }
+
+  void seal(const ObjectId& id) {
+    if (tpus_obj_seal(h_, id.bytes) != 0)
+      throw std::runtime_error("ray_tpu: seal failed");
+  }
+
+  void abort(const ObjectId& id) { tpus_obj_abort(h_, id.bytes); }
+
+  bool contains(const ObjectId& id) {
+    return tpus_obj_contains(h_, id.bytes) == 1;
+  }
+
+  // Blocking zero-copy get; timeout_ms < 0 waits forever.
+  ObjectBuffer get(const ObjectId& id, int64_t timeout_ms = -1) {
+    uint64_t off = 0, size = 0, msize = 0;
+    int rc = tpus_obj_get(h_, id.bytes, timeout_ms, &off, &size, &msize);
+    if (rc != 0)
+      throw std::runtime_error("ray_tpu: get failed rc=" +
+                               std::to_string(rc));
+    const uint8_t* base = tpus_base(h_) + off;
+    return ObjectBuffer(h_, id, base, size, base + size, msize);
+  }
+
+ private:
+  explicit Store(void* h) : h_(h) {}
+  void* h_;
+};
+
+inline ObjectBuffer::~ObjectBuffer() {
+  if (store_) tpus_obj_release(store_, id_.bytes);
+}
+
+}  // namespace ray_tpu

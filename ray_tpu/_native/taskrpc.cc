@@ -498,6 +498,49 @@ int tpt_send(void* h, uint64_t conn_tag, uint64_t req_id,
   return TPT_OK;
 }
 
+int tpt_send_raw(void* h, uint64_t conn_tag, const uint8_t* framed,
+                 uint64_t len) {
+  // Batched submission: `framed` is a concatenation of already-framed
+  // requests (u32 frame_len | u64 req_id | payload), built by Python so a
+  // whole dispatch burst costs ONE library call, one queue append and one
+  // wakeup.  Frames are walked (no copy beyond the single buffer append)
+  // to register req_ids for fail_conn's in-flight accounting.
+  Client* cl = static_cast<Client*>(h);
+  // Validate the whole buffer BEFORE mutating any state: a malformed
+  // later frame must not leave earlier req_ids registered in-flight for
+  // a batch that was never enqueued.
+  {
+    uint64_t off = 0;
+    while (off + 12 <= len) {
+      uint32_t flen;
+      memcpy(&flen, framed + off, 4);
+      if (flen < 8 || off + 4 + flen > len) return TPT_EARG;
+      off += 4 + flen;
+    }
+    if (off != len) return TPT_EARG;
+  }
+  {
+    std::lock_guard<std::mutex> g(cl->mu);
+    auto it = cl->conns.find(conn_tag);
+    if (it == cl->conns.end() || it->second->closing) return TPT_ECONN;
+    Conn* c = it->second;
+    uint64_t off = 0;
+    while (off + 12 <= len) {
+      uint32_t flen;
+      memcpy(&flen, framed + off, 4);
+      uint64_t req_id;
+      memcpy(&req_id, framed + off + 4, 8);
+      cl->inflight[req_id] = conn_tag;
+      off += 4 + flen;
+    }
+    Buf b;
+    b.data.assign(framed, framed + len);
+    c->wq.push_back(std::move(b));
+  }
+  if (!cl->wake_pending.exchange(true)) wake_fd(cl->wakefd);
+  return TPT_OK;
+}
+
 int tpt_close_conn(void* h, uint64_t conn_tag) {
   Client* cl = static_cast<Client*>(h);
   {
@@ -611,6 +654,24 @@ int tpt_server_reply(void* h, uint64_t conn_tag, uint64_t req_id,
     Buf b;
     frame_into(b.data, req_id, payload, len);
     c->wq.push_back(std::move(b));
+  }
+  if (!s->wake_pending.exchange(true)) wake_fd(s->wakefd);
+  return TPT_OK;
+}
+
+int tpt_server_reply_raw(void* h, uint64_t conn_tag, const uint8_t* framed,
+                         uint64_t len) {
+  // Batched replies: one library call, one queue append and one io wakeup
+  // for every reply produced by an execution batch (the per-reply eventfd
+  // write costs a context switch on small hosts).
+  Server* s = static_cast<Server*>(h);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    auto it = s->conns.find(conn_tag);
+    if (it == s->conns.end() || it->second->closing) return TPT_ECONN;
+    Buf b;
+    b.data.assign(framed, framed + len);
+    it->second->wq.push_back(std::move(b));
   }
   if (!s->wake_pending.exchange(true)) wake_fd(s->wakefd);
   return TPT_OK;

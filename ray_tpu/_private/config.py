@@ -99,6 +99,9 @@ _D("max_workers_per_node", 0, int,
    "worker-pool cap per node; 0 = max(8, 4x CPUs)")
 _D("max_startup_concurrency", 0, int,
    "concurrent worker spawns per node; 0 = max(4, host core count)")
+_D("worker_zygote", True, _bool,
+   "fork non-TPU workers from a pre-imported template process "
+   "(worker_zygote.py) instead of cold-spawning an interpreter")
 _D("native_task_transport", True, _bool,
    "push tasks over the native framed-TCP plane (taskrpc.cc) instead of "
    "the Python RPC layer")

@@ -41,6 +41,7 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import ObjectStore
+from ray_tpu.util import tracing
 from ray_tpu._private.protocol import (
     INLINE_LIMIT,
     RefArg,
@@ -558,7 +559,12 @@ class CoreWorker:
 
     def _signal_ready(self, oid: ObjectID, st: _ObjectState):
         if st.event is not None:
-            self.io.loop.call_soon_threadsafe(st.event.set)
+            if threading.get_ident() == self.io.ident:
+                # Already on the loop: set directly — the threadsafe
+                # variant writes the loop's self-pipe (~30us) per call.
+                st.event.set()
+            else:
+                self.io.loop.call_soon_threadsafe(st.event.set)
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -914,6 +920,7 @@ class CoreWorker:
             bundle_index=opts.get("placement_group_bundle_index", -1),
             runtime_env=renv_desc,
         )
+        spec.trace_ctx = tracing.current_context()
         for r in pins:
             self._pin_serialized_ref(r)
         pending = _PendingTask(
@@ -943,21 +950,77 @@ class CoreWorker:
     def _drain_fast(self):
         self._fast_scheduled = False
         q = self._fast_q
+        # ONE shared per-worker batch for the whole burst — actor pushes
+        # AND normal-task dispatches coalesce into one library call per
+        # worker (a per-_pump dict would flush single-payload batches).
+        batches: dict = {}   # native addr -> [(payload, cb)]
         while q:
             kind, *rest = q.popleft()
             if kind == "task":
-                self._fast_submit(rest[0])
+                self._fast_submit(rest[0], batches=batches)
             else:
-                self._fast_submit_actor(*rest)
+                self._fast_submit_actor(*rest, batches=batches)
+        if batches:
+            for naddr, items in batches.items():
+                self._native_sub.call_cb_batch(naddr, items)
 
-    def _fast_submit(self, task_id):
+    def _pending_dep_events(self, spec: TaskSpec) -> list:
+        """asyncio.Events for this task's UNRESOLVED owned dependencies.
+
+        Dependency gating (reference: raylet dependency manager,
+        task_dependency_manager.h — a task is not dispatched until its
+        args are available): normal tasks execute INLINE in per-worker
+        FIFO order, so a task pushed ahead of its not-yet-finished
+        producer would block the worker its producer needs — a
+        head-of-line deadlock when both land on one worker.  Holding
+        dispatch until owned deps complete makes the order safe by
+        construction.  Borrowed refs (owner elsewhere) stay eager: their
+        producers were submitted by another owner, so no local FIFO
+        ordering exists to violate, and the worker-side poll makes
+        progress independently."""
+        evs = []
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if not isinstance(arg, RefArg):
+                continue
+            st = self.objects.get(ObjectID(arg.id_binary))
+            if st is not None and st.pending:
+                if st.event is None:
+                    st.event = asyncio.Event()
+                evs.append((ObjectID(arg.id_binary), st))
+        return evs
+
+    async def _submit_after_deps(self, task_id, deps):
+        await self._await_deps(deps)
+        self._fast_submit(task_id)
+
+    async def _await_deps(self, deps) -> None:
+        for _oid, st in deps:
+            while st.pending:
+                ev = st.event
+                if ev is None:
+                    ev = st.event = asyncio.Event()
+                try:
+                    # Bounded wait: lineage reconstruction replaces the
+                    # event object, so re-read it instead of blocking on
+                    # a stale one forever.
+                    await asyncio.wait_for(ev.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    pass
+
+    def _fast_submit(self, task_id, batches=None):
         """Loop-side entry for fast-path tasks: enqueue on the scheduling-
         key scheduler with a direct-completion sink (no coroutine, no
-        future).  Placement/affinity strategies take the coroutine path."""
+        future).  Placement/affinity strategies take the coroutine path.
+        With `batches`, dispatches accumulate for the caller's one-call-
+        per-worker flush (_drain_fast)."""
         pending = self.tasks.get(task_id)
         if pending is None:
             return
         spec = pending.spec
+        deps = self._pending_dep_events(spec)
+        if deps:
+            asyncio.ensure_future(self._submit_after_deps(task_id, deps))
+            return
         if (spec.placement_group is not None
                 or spec.scheduling_strategy not in (None, "DEFAULT")
                 or spec.node_affinity):
@@ -968,20 +1031,21 @@ class CoreWorker:
         if sched is None:
             sched = self._lease_cache[key] = _KeyScheduler(
                 self, key, spec, [])
-        sched.submit_nowait(spec)
+        sched.submit_nowait(spec, batches=batches)
 
-    def _push_native_nowait(self, payload: bytes, lease: dict):
-        """Zero-coroutine native push: returns an asyncio future resolving
-        to the RAW reply bytes, or None when the native route to this
+    def _push_native_cb(self, payload: bytes, lease: dict, cb) -> bool:
+        """Zero-coroutine native push: `cb(status, raw_reply)` runs on the
+        io loop when done.  Returns False when the native route to this
         worker isn't (yet) established — caller falls back to the
         coroutine path, which performs discovery."""
         sub = self._native_sub
         if not sub:
-            return None
+            return False
         naddr = self._native_addrs.get(lease["worker_address"])
         if not naddr:
-            return None
-        return sub.call(naddr, payload)
+            return False
+        sub.call_cb(naddr, payload, cb)
+        return True
 
     async def _resume_task_fast(self, task_id: TaskID, exc):
         """Apply one failure outcome to a fast-path task, then continue in
@@ -1048,6 +1112,7 @@ class CoreWorker:
             runtime_env=await self._build_runtime_env(
                 opts.get("runtime_env")),
         )
+        spec.trace_ctx = tracing.current_context()
         self.tasks[task_id] = _PendingTask(
             spec=spec, retries_left=spec.max_retries, future=None, lineage=True)
         asyncio.ensure_future(self._run_task_to_completion(task_id))
@@ -1131,6 +1196,9 @@ class CoreWorker:
         from ray_tpu.exceptions import TaskCancelledError
         pending = self.tasks.get(task_id)
         spec = pending.spec
+        # Dependency gate (see _pending_dep_events): never push a task
+        # ahead of its unfinished producer.
+        await self._await_deps(self._pending_dep_events(spec))
         exclude: list = []
         # Resubmissions dispatch exclusively (see _KeyScheduler._pump's
         # dependency-safety sketch).
@@ -1306,6 +1374,8 @@ class CoreWorker:
         self._release_arg_pins(spec)
 
     def _release_arg_pins(self, spec: TaskSpec):
+        if not spec.args and not spec.kwargs:
+            return
         for arg in list(spec.args) + list(spec.kwargs.values()):
             if isinstance(arg, RefArg):
                 oid = ObjectID(arg.id_binary)
@@ -1376,6 +1446,7 @@ class CoreWorker:
             runtime_env=await self._build_runtime_env(
                 opts.get("runtime_env")),
         )
+        spec.trace_ctx = tracing.current_context()
         info = ActorInfo(
             actor_id=actor_id,
             name=opts.get("name") or "",
@@ -1474,6 +1545,7 @@ class CoreWorker:
             max_retries=opts.get("max_task_retries", 0),
         )
         spec.seq_no = seq_no
+        spec.trace_ctx = tracing.current_context()
         return spec
 
     def _launch_actor_sync(self, sub, method_name, args, kwargs, opts,
@@ -1522,9 +1594,11 @@ class CoreWorker:
         self._enqueue_fast(("actor", sub, task_id))
         return True
 
-    def _fast_submit_actor(self, sub, task_id):
+    def _fast_submit_actor(self, sub, task_id, batches=None):
         """Loop-side actor dispatch: straight onto the native plane when
-        the actor's address and native route are already known."""
+        the actor's address and native route are already known.  With
+        `batches`, the push is accumulated for a one-call-per-worker
+        flush by the caller (_drain_fast)."""
         pending = self.tasks.get(task_id)
         if pending is None:
             return
@@ -1538,30 +1612,36 @@ class CoreWorker:
             # the slow path, which computes the seq fresh per attempt.
             naddr = self._native_addrs.get(addr)
             if naddr:
-                fut = self._native_sub.call(naddr, pending.payload)
-                fut.add_done_callback(
-                    lambda f: self._on_actor_push_done(sub, task_id, addr, f))
+                cb = (lambda status, data: self._on_actor_push_done(
+                    sub, task_id, addr, status, data))
+                if batches is not None:
+                    batches.setdefault(naddr, []).append(
+                        (pending.payload, cb))
+                else:
+                    self._native_sub.call_cb(naddr, pending.payload, cb)
                 return
         asyncio.ensure_future(self._run_actor_task(sub, task_id))
 
-    def _on_actor_push_done(self, sub, task_id, addr, f):
+    def _on_actor_push_done(self, sub, task_id, addr, status, data):
         pending = self.tasks.get(task_id)
         if pending is None:
             return
         spec = pending.spec
-        exc = None if f.cancelled() else f.exception()
-        if exc is None and not f.cancelled():
+        if status == 0:
             import pickle as _pickle
             try:
-                reply = _pickle.loads(f.result())
+                reply = _pickle.loads(data)
             except BaseException as e:  # noqa: BLE001
                 self._complete_task_error(spec, e)
                 return
             sub.completed += 1
             self._complete_task_reply(spec, reply)
             return
+        from ray_tpu._private.task_transport import ConnClosedError
         asyncio.ensure_future(
-            self._actor_push_failed_cont(sub, task_id, addr, exc))
+            self._actor_push_failed_cont(
+                sub, task_id, addr,
+                ConnClosedError("native connection closed")))
 
     async def _actor_push_failed_cont(self, sub, task_id, addr, exc):
         pending = self.tasks.get(task_id)
@@ -1766,30 +1846,53 @@ class CoreWorker:
         - async actor (any coroutine method): scheduled on a dedicated
           asyncio loop, bounded by a semaphore.
         """
-        while True:
-            item = self.exec_queue.get()
-            if item is None:
-                break
-            spec, done, loop = item
-            is_actor_call = spec.actor_id is not None and not spec.actor_creation
-            if is_actor_call and self._async_loop is not None:
-                def _complete(r, d=done, lp=loop):
-                    if lp is None:
-                        d(r)  # native done-sink: pickles + streams reply
-                    else:
-                        lp.call_soon_threadsafe(
-                            lambda: d.done() or d.set_result(r))
-                asyncio.run_coroutine_threadsafe(
-                    self._execute_actor_async(spec, _complete),
-                    self._async_loop)
-            elif is_actor_call and self._exec_pool is not None:
-                self._exec_pool.submit(self._run_one, spec, done, loop)
-            else:
-                self._run_one(spec, done, loop)
+        import contextlib
+        stop = False
+        while not stop:
+            burst = [self.exec_queue.get()]
+            while True:
+                try:
+                    burst.append(self.exec_queue.get_nowait())
+                except queue.Empty:
+                    break
+            # Replies of a burst coalesce into one native flush per conn
+            # (a per-reply enqueue costs an io wakeup; see NativeReceiver).
+            rx = getattr(self, "_native_rx", None)
+            scope = rx.batch_scope() if rx is not None \
+                else contextlib.nullcontext()
+            with scope:
+                for item in burst:
+                    if item is None:
+                        stop = True
+                        break
+                    t0 = time.monotonic()
+                    self._exec_one_item(item)
+                    if rx is not None and time.monotonic() - t0 > 0.002:
+                        # Don't hold fast tasks' replies behind a slow
+                        # burst neighbour (head-of-line).
+                        rx.flush_thread_batch()
         if self._exec_pool is not None:
             self._exec_pool.shutdown(wait=False)
         if self._async_loop is not None:
             self._async_loop.call_soon_threadsafe(self._async_loop.stop)
+
+    def _exec_one_item(self, item):
+        spec, done, loop = item
+        is_actor_call = spec.actor_id is not None and not spec.actor_creation
+        if is_actor_call and self._async_loop is not None:
+            def _complete(r, d=done, lp=loop):
+                if lp is None:
+                    d(r)  # native done-sink: pickles + streams reply
+                else:
+                    lp.call_soon_threadsafe(
+                        lambda: d.done() or d.set_result(r))
+            asyncio.run_coroutine_threadsafe(
+                self._execute_actor_async(spec, _complete),
+                self._async_loop)
+        elif is_actor_call and self._exec_pool is not None:
+            self._exec_pool.submit(self._run_one, spec, done, loop)
+        else:
+            self._run_one(spec, done, loop)
 
     def _run_one(self, spec: TaskSpec, done, loop):
         try:
@@ -1819,6 +1922,9 @@ class CoreWorker:
             limit = mc if mc > 0 else 1000
             loop = asyncio.new_event_loop()
             self._async_loop = loop
+            rx = getattr(self, "_native_rx", None)
+            if rx is not None:
+                rx.enable_tick_batching(loop)
             self._async_sem = asyncio.Semaphore(limit)
             threading.Thread(target=loop.run_forever, daemon=True,
                              name="actor-async-exec").start()
@@ -1827,9 +1933,13 @@ class CoreWorker:
             self._exec_pool = ThreadPoolExecutor(
                 max_workers=mc, thread_name_prefix="actor-exec")
 
-    def _record_task_event(self, spec: TaskSpec, started: float):
-        """Buffer one execution event; a loop-side flusher ships batches."""
-        self._task_events.append({
+    def _record_task_event(self, spec: TaskSpec, started: float,
+                           span=None):
+        """Buffer one execution event; a loop-side flusher ships batches.
+        With tracing on, the event doubles as the task's SPAN: trace_id/
+        span_id/parent_id group a driver's whole call tree in the
+        timeline (reference: tracing_helper.py spans per task)."""
+        ev = {
             "task_id": spec.task_id.hex(),
             "name": spec.name,
             "actor_id": spec.actor_id.hex() if spec.actor_id else None,
@@ -1838,7 +1948,10 @@ class CoreWorker:
             "node_id": self.node_id.hex()[:12] if self.node_id else "",
             "start": started,
             "end": time.time(),
-        })
+        }
+        if span is not None:
+            ev["trace_id"], ev["span_id"], ev["parent_id"] = span
+        self._task_events.append(ev)
         if self._task_event_flusher is None:
             def _start_flusher():
                 if self._task_event_flusher is None:
@@ -1919,6 +2032,7 @@ class CoreWorker:
                     "error": TaskCancelledError(f"task {spec.name} cancelled")}
         with self._cancel_lock:
             self._running_tasks[spec.task_id] = threading.get_ident()
+        span = tracing.enter_task(spec)  # nested submits join the trace
         try:
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
@@ -1947,10 +2061,12 @@ class CoreWorker:
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(spec, e)
         finally:
+            if span is not None:
+                tracing.exit_task()
             with self._cancel_lock:
                 self._running_tasks.pop(spec.task_id, None)
             self._cancelled_exec.discard(spec.task_id)
-            self._record_task_event(spec, _t0)
+            self._record_task_event(spec, _t0, span)
             # Don't leak this task's context (e.g. its placement group) to
             # whatever runs on this reused worker next.
             self.current_task_spec = None
@@ -2082,11 +2198,13 @@ class _KeyScheduler:
         self._pump()
         return await fut
 
-    def submit_nowait(self, spec):
+    def submit_nowait(self, spec, batches=None):
         """Fast-path enqueue: completion flows straight into the owner's
-        object table (sink None) — no future, no coroutine."""
+        object table (sink None) — no future, no coroutine.  An external
+        `batches` dict lets a burst of submissions share one native
+        flush per worker (_drain_fast owns the flush then)."""
         self.queue.append((spec, None, False))
-        self._pump()
+        self._pump(batches)
 
     async def drain(self):
         if self._reaper is not None:
@@ -2098,7 +2216,7 @@ class _KeyScheduler:
             await self.worker._return_lease(lease)
 
     # -- internals ---------------------------------------------------------
-    def _pump(self):
+    def _pump(self, batches=None):
         """Dispatch queued tasks onto held leases, several in flight per
         lease (reference OnWorkerIdle:151 pushes every queued task onto a
         granted lease; the receiver queues them).  Retried tasks dispatch
@@ -2112,6 +2230,9 @@ class _KeyScheduler:
         submission order (exclusive retries exempt but never queued behind
         anything); hence the waits-on relation is acyclic and the earliest
         blocked task's dependency is always running or done."""
+        flush_here = batches is None
+        if batches is None:
+            batches = {}   # native addr -> list[(payload, cb)]
         while self.queue:
             spec, sink, exclusive = self.queue[0]
             cap = 1 if exclusive else self.DEPTH
@@ -2125,54 +2246,64 @@ class _KeyScheduler:
                 break
             self.queue.popleft()
             best["inflight"] += 1
-            self._dispatch(spec, sink, best)
+            self._dispatch(spec, sink, best, batches)
+        if flush_here and batches:
+            sub = self.worker._native_sub
+            for naddr, items in batches.items():
+                sub.call_cb_batch(naddr, items)
         # Lease demand scales by pipeline depth (a lease carries DEPTH
-        # tasks), bounded by the reference-style pending-lease cap.
+        # tasks).  Anything still queued found every held lease full, so
+        # the remaining queue needs NEW leases; only the number of
+        # in-flight lease REQUESTS is capped (reference
+        # lease_policy/max_pending_lease_requests_per_scheduling_category)
+        # — total held leases are bounded by cluster resources at the
+        # hostd, not by the client.
         want = min((len(self.queue) + self.DEPTH - 1) // self.DEPTH
                    - self.pending_leases,
-                   self.MAX_PENDING_LEASES - self.pending_leases
-                   - self.held)
+                   self.MAX_PENDING_LEASES - self.pending_leases)
         for _ in range(max(0, want)):
             self.pending_leases += 1
             asyncio.ensure_future(self._acquire_lease())
 
-    def _dispatch(self, spec, sink, lease):
+    def _dispatch(self, spec, sink, lease, batches=None):
         worker = self.worker
         pending = worker.tasks.get(spec.task_id)
         if pending is not None:
             pending.worker_address = lease["worker_address"]
-        fut = None
         if pending is not None and pending.payload is not None:
-            fut = worker._push_native_nowait(pending.payload, lease)
-        if fut is None:
-            asyncio.ensure_future(self._run_on_lease(spec, sink, lease))
-            return
-        fut.add_done_callback(
-            lambda f: self._on_push_done(spec, sink, lease, f))
+            cb = (lambda status, data: self._on_push_done(
+                spec, sink, lease, status, data))
+            if batches is not None and worker._native_sub:
+                naddr = worker._native_addrs.get(lease["worker_address"])
+                if naddr:
+                    batches.setdefault(naddr, []).append(
+                        (pending.payload, cb))
+                    return
+            elif worker._push_native_cb(pending.payload, lease, cb):
+                return
+        asyncio.ensure_future(self._run_on_lease(spec, sink, lease))
 
-    def _on_push_done(self, spec, sink, lease, f):
-        """Completion callback for zero-coroutine native pushes."""
+    def _on_push_done(self, spec, sink, lease, status, data):
+        """Completion callback for zero-coroutine native pushes (runs
+        inline on the io loop, one batch of these per loop wakeup)."""
         worker = self.worker
-        exc = None if f.cancelled() else f.exception()
-        if exc is not None:
+        if status != 0:
             worker.pool.invalidate(lease["worker_address"])
             if lease in self.leases:
                 self.leases.remove(lease)
                 asyncio.ensure_future(
                     worker._return_lease(lease, kill=True))
             self._deliver(spec, sink, None, _RetryableSubmitError(
-                f"worker died: {exc}", lease.get("node_id")))
+                "worker died: native connection closed",
+                lease.get("node_id")))
             self._pump()
             return
         lease["inflight"] -= 1
         if lease["inflight"] == 0:
             lease["idle_since"] = time.monotonic()
-        if f.cancelled():
-            self._pump()
-            return
         import pickle as _pickle
         try:
-            reply = _pickle.loads(f.result())
+            reply = _pickle.loads(data)
         except BaseException as e:  # noqa: BLE001
             self._deliver(spec, sink, None, e)
             self._pump()

@@ -34,8 +34,11 @@ class FunctionManager:
                 return key
         blob = cloudpickle.dumps(obj, protocol=5)
         key = f"fn:{job_id}:{hashlib.sha1(blob).hexdigest()}"
-        await self._kv_call("kv_put", {"ns": "fn", "key": key, "value": blob,
-                                       "overwrite": False})
+        # Typed contract (pb.KvPutRequest) — the function-distribution
+        # path is the first library RPC migrated off pickled dicts.
+        from ray_tpu import protocol
+        await self._kv_call("kv_put", protocol.pb.KvPutRequest(
+            ns="fn", key=key, value=blob, overwrite=False))
         with self._lock:
             self._export_cache[id(obj)] = key
             self._import_cache[key] = obj  # local fast path
@@ -51,8 +54,10 @@ class FunctionManager:
         with self._lock:
             if key in self._import_cache:
                 return self._import_cache[key]
-        reply = await self._kv_call("kv_get", {"ns": "fn", "key": key})
-        blob = reply["value"]
+        from ray_tpu import protocol
+        reply = await self._kv_call(
+            "kv_get", protocol.pb.KvGetRequest(ns="fn", key=key))
+        blob = reply.value if reply.found else None
         if blob is None:
             raise RuntimeError(f"function {key} not found in GCS")
         obj = cloudpickle.loads(blob)

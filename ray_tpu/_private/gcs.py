@@ -61,20 +61,44 @@ class KvManager:
         self.on_change = None  # set by GcsServer for persistence
 
     async def kv_put(self, req):
+        """Typed (pb.KvPutRequest) or legacy dict (reference: the KV rows
+        of gcs_service.proto InternalKVPut)."""
+        from ray_tpu import protocol
+        typed = protocol.is_message(req)
+        if typed:
+            req = {"ns": req.ns, "key": req.key, "value": req.value,
+                   "overwrite": req.overwrite}
         ns = self._data.setdefault(req.get("ns", ""), {})
         existed = req["key"] in ns
         if req.get("overwrite", True) or not existed:
             ns[req["key"]] = req["value"]
             if self.on_change is not None:
-                self.on_change()
+                self.on_change(req.get("ns", ""), req["key"])
+        if typed:
+            return protocol.pb.KvPutReply(existed=existed)
         return {"existed": existed}
 
     async def kv_get(self, req):
+        from ray_tpu import protocol
+        if protocol.is_message(req):
+            v = self._data.get(req.ns, {}).get(req.key)
+            return protocol.pb.KvGetReply(found=v is not None,
+                                          value=v or b"")
         return {"value": self._data.get(req.get("ns", ""), {}).get(req["key"])}
 
     async def kv_del(self, req):
+        from ray_tpu import protocol
+        typed = protocol.is_message(req)
+        if typed:
+            req = {"ns": req.ns, "key": req.key}
         ns = self._data.get(req.get("ns", ""), {})
-        return {"deleted": ns.pop(req["key"], None) is not None}
+        deleted = ns.pop(req["key"], None) is not None
+        if deleted and self.on_change is not None:
+            # Without this, a deleted key would resurrect on restore.
+            self.on_change(req.get("ns", ""), req["key"])
+        if typed:
+            return protocol.pb.KvDelReply(deleted=deleted)
+        return {"deleted": deleted}
 
     async def kv_exists(self, req):
         return {"exists": req["key"] in self._data.get(req.get("ns", ""), {})}
@@ -88,35 +112,80 @@ class KvManager:
 class GcsTableStorage:
     """Pluggable control-plane persistence (reference:
     gcs/store_client/ — in_memory_store_client.h vs redis_store_client.h,
-    selected by gcs_storage, ray_config_def.h:382).  The file backend
-    snapshots the durable tables (actors, placement groups, KV, job
-    counter) atomically; node membership is NOT persisted — nodes
-    re-register through the heartbeat reregister path."""
+    selected by gcs_storage, ray_config_def.h:382).  The sqlite backend
+    stores one row per record, so a mutation costs O(changed records) —
+    the redis store client's role — not a whole-state snapshot; node
+    membership IS persisted (the reference keeps the node table in the
+    GCS store and reconciles against re-registration after restart)."""
 
     def __init__(self, path: str | None):
         self.path = path  # None = memory-only
+        self._db = None
+        self.write_ops = 0  # rows written, for O(delta) assertions
 
-    def load(self) -> dict | None:
-        import pickle
+    def _conn(self):
+        if self._db is None and self.path:
+            import sqlite3
+            db = sqlite3.connect(self.path, check_same_thread=False)
+            try:
+                db.execute("PRAGMA journal_mode=WAL")
+                db.execute("PRAGMA synchronous=NORMAL")
+                db.execute(
+                    "CREATE TABLE IF NOT EXISTS t "
+                    "(tab TEXT, k BLOB, v BLOB, PRIMARY KEY (tab, k))")
+                db.commit()
+            except sqlite3.DatabaseError:
+                # Unreadable / pre-sqlite persist file: rotate it away and
+                # start fresh rather than wedging the control plane.
+                db.close()
+                try:
+                    os.replace(self.path, self.path + ".corrupt")
+                except OSError:
+                    pass
+                db = sqlite3.connect(self.path, check_same_thread=False)
+                db.execute(
+                    "CREATE TABLE IF NOT EXISTS t "
+                    "(tab TEXT, k BLOB, v BLOB, PRIMARY KEY (tab, k))")
+                db.commit()
+            self._db = db
+        return self._db
+
+    def write_rows(self, puts: list, dels: list) -> None:
+        """One transaction: upsert `puts` [(tab, key, value)] and remove
+        `dels` [(tab, key)]."""
+        db = self._conn()
+        if db is None:
+            return
+        with db:
+            if puts:
+                db.executemany(
+                    "INSERT INTO t (tab, k, v) VALUES (?, ?, ?) "
+                    "ON CONFLICT(tab, k) DO UPDATE SET v=excluded.v", puts)
+            if dels:
+                db.executemany("DELETE FROM t WHERE tab=? AND k=?", dels)
+        self.write_ops += len(puts) + len(dels)
+
+    def load_all(self) -> dict | None:
+        """{tab: {key_bytes: value_bytes}} or None when empty/memory-only."""
+        import sqlite3
         if not self.path or not os.path.exists(self.path):
             return None
-        with open(self.path, "rb") as f:
-            return pickle.load(f)
-
-    def save_blob(self, blob: bytes) -> None:
-        if not self.path:
-            return
-        tmp = f"{self.path}.tmp{os.getpid()}"
+        db = self._conn()
+        if db is None:
+            return None
         try:
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, self.path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+            rows = db.execute("SELECT tab, k, v FROM t").fetchall()
+        except sqlite3.DatabaseError:
+            return None
+        out: dict = {}
+        for tab, k, v in rows:
+            out.setdefault(tab, {})[bytes(k)] = bytes(v)
+        return out or None
+
+    def close(self):
+        if self._db is not None:
+            self._db.close()
+            self._db = None
 
 
 class GcsServer:
@@ -126,8 +195,13 @@ class GcsServer:
         self.storage = storage or GcsTableStorage(
             os.environ.get("RAY_TPU_GCS_PERSIST") or None)
         self._persist_pending = False
+        self._dirty: set = set()   # (tab, key) records awaiting a flush
+        # Serializes flushes: two concurrent write_rows on the shared
+        # sqlite connection could interleave and commit a STALE value of
+        # a key dirtied in both windows over the fresh one.
+        self._persist_lock = asyncio.Lock()
         self.kv = KvManager()
-        self.kv.on_change = self._schedule_persist
+        self.kv.on_change = lambda ns, key: self._mark_dirty("kv", (ns, key))
         self._task_events: list = []  # ring buffer for the timeline
         self._log_lines: list = []    # (seq, record) worker-log ring
         self._log_seq = 0
@@ -152,12 +226,21 @@ class GcsServer:
         # sleep-polling (reference: pubsub/publisher.h long-poll channels).
         self._change_event = asyncio.Event()
 
-    def _bump(self):
-        """Record a state change and wake every waiter."""
+    def _bump(self, tab: str | None = None, key=None):
+        """Record a state change and wake every waiter.  With (tab, key)
+        the changed record is marked dirty for the incremental persist
+        flush; without them the change is volatile (resource heartbeats)
+        and only wakes waiters."""
         self._cluster_version += 1
         ev = self._change_event
         self._change_event = asyncio.Event()
         ev.set()
+        if tab is not None:
+            self._dirty.add((tab, key))
+            self._schedule_persist()
+
+    def _mark_dirty(self, tab: str, key) -> None:
+        self._dirty.add((tab, key))
         self._schedule_persist()
 
     def _schedule_persist(self):
@@ -165,44 +248,97 @@ class GcsServer:
             self._persist_pending = True
             asyncio.ensure_future(self._persist_soon())
 
-    async def _persist_soon(self):
-        """Debounced snapshot: batch a burst of changes into one write."""
-        await asyncio.sleep(0.2)
-        self._persist_pending = False
-        try:
-            # Serialize ON the loop thread (no mutation can interleave —
-            # a torn snapshot would mix pre/post-transition records), then
-            # hand only the opaque bytes to the executor for disk IO.
-            import pickle
-            blob = pickle.dumps(self._durable_state())
-            await asyncio.get_running_loop().run_in_executor(
-                None, self.storage.save_blob, blob)
-        except Exception:
-            logger.exception("GCS persistence write failed")
-
-    def _durable_state(self) -> dict:
+    # Durable tables: dirty-set tab name -> live dict (record pickled per
+    # row; a flush touches only rows dirtied since the last one).
+    def _tables(self) -> dict:
         return {
-            "actors": dict(self.actors),
-            "named_actors": dict(self.named_actors),
-            "placement_groups": dict(self.placement_groups),
-            "kv": {ns: dict(t) for ns, t in self.kv._data.items()},
-            "next_job": self.next_job,
-            "cluster_version": self._cluster_version,
+            "actors": self.actors,
+            "nodes": self.nodes,
+            "named_actors": self.named_actors,
+            "placement_groups": self.placement_groups,
+            "kv": None,  # nested ns dict, resolved in _persist_soon
         }
 
+    async def _persist_soon(self):
+        """Debounced incremental flush: a burst of changes becomes ONE
+        transaction writing only the dirtied rows (O(delta), reference
+        redis_store_client role) plus a constant meta row."""
+        await asyncio.sleep(0.2)
+        self._persist_pending = False
+        import pickle
+        async with self._persist_lock:
+            await self._flush_dirty(pickle)
+
+    async def _flush_dirty(self, pickle):
+        dirty, self._dirty = self._dirty, set()
+        if not dirty:
+            return
+        tables = self._tables()
+        puts, dels = [], []
+        # Serialize ON the loop thread (no mutation can interleave — a
+        # torn row would mix pre/post-transition state), then hand only
+        # opaque rows to the executor for disk IO.
+        for tab, key in dirty:
+            kb = pickle.dumps(key, protocol=5)
+            if tab == "kv":
+                ns, k = key
+                table = self.kv._data.get(ns, {})
+                obj, present = table.get(k), k in table
+            else:
+                d = tables.get(tab)
+                if d is None:
+                    continue
+                obj, present = d.get(key), key in d
+            if present:
+                puts.append((tab, kb, pickle.dumps(obj, protocol=5)))
+            else:
+                dels.append((tab, kb))
+        puts.append(("meta", b"next_job",
+                     pickle.dumps(self.next_job, protocol=5)))
+        puts.append(("meta", b"cluster_version",
+                     pickle.dumps(self._cluster_version, protocol=5)))
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.storage.write_rows, puts, dels)
+        except Exception:
+            logger.exception("GCS persistence write failed")
+            # Re-mark AND reschedule: without the reschedule a transient
+            # write failure during a quiescent period would leave durable
+            # state unwritten until some unrelated future mutation.
+            self._dirty |= dirty
+            self._schedule_persist()
+
     def _restore(self) -> None:
-        state = self.storage.load()
+        import pickle
+        state = self.storage.load_all()
         if not state:
             return
-        self.actors.update(state.get("actors", {}))
-        self.named_actors.update(state.get("named_actors", {}))
-        self.placement_groups.update(state.get("placement_groups", {}))
-        self.kv._data.update(state.get("kv", {}))
-        self.next_job = max(self.next_job, state.get("next_job", 0))
-        self._cluster_version = state.get("cluster_version", 0)
-        logger.info("restored GCS state: %d actors, %d PGs, job=%d",
-                    len(self.actors), len(self.placement_groups),
-                    self.next_job)
+        unp = pickle.loads
+        for kb, vb in state.get("actors", {}).items():
+            self.actors[unp(kb)] = unp(vb)
+        for kb, vb in state.get("named_actors", {}).items():
+            self.named_actors[unp(kb)] = unp(vb)
+        for kb, vb in state.get("placement_groups", {}).items():
+            self.placement_groups[unp(kb)] = unp(vb)
+        now = time.monotonic()
+        for kb, vb in state.get("nodes", {}).items():
+            info = unp(vb)
+            self.nodes[unp(kb)] = info
+            if info.alive:
+                # Grace stamp: a surviving hostd keeps heartbeating and
+                # stays; a gone one times out through the normal sweep.
+                self.node_heartbeat[unp(kb)] = now
+        for kb, vb in state.get("kv", {}).items():
+            ns, k = unp(kb)
+            self.kv._data.setdefault(ns, {})[k] = unp(vb)
+        meta = state.get("meta", {})
+        if b"next_job" in meta:
+            self.next_job = max(self.next_job, unp(meta[b"next_job"]))
+        if b"cluster_version" in meta:
+            self._cluster_version = unp(meta[b"cluster_version"])
+        logger.info("restored GCS state: %d actors, %d PGs, %d nodes, "
+                    "job=%d", len(self.actors), len(self.placement_groups),
+                    len(self.nodes), self.next_job)
         asyncio.ensure_future(self._reconcile_restored())
 
     async def _reconcile_restored(self):
@@ -253,7 +389,7 @@ class GcsServer:
         info: NodeInfo = req["info"]
         self.nodes[info.node_id] = info
         self.node_heartbeat[info.node_id] = time.monotonic()
-        self._bump()
+        self._bump("nodes", info.node_id)
         logger.info("node %s registered at %s (%s)", info.node_id.hex()[:8],
                     info.address, info.resources_total)
         return {"ok": True}
@@ -385,7 +521,7 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
-        self._bump()
+        self._bump("nodes", nid)
         logger.warning("node %s dead: %s", nid.hex()[:8], reason)
         # Fail over actors that lived there.
         for actor in list(self.actors.values()):
@@ -409,6 +545,9 @@ class GcsServer:
     async def next_job_id(self, req):
         async with self._job_lock:
             self.next_job += 1
+            # ("meta", None) survives to the flush (which always writes
+            # the meta rows) but matches no live table row.
+            self._mark_dirty("meta", None)
             return {"job_id": self.next_job}
 
     # ---------------- actor manager ----------------
@@ -431,7 +570,9 @@ class GcsServer:
                         f"actor name {info.name!r} already taken in "
                         f"namespace {info.namespace!r}")
             self.named_actors[key] = info.actor_id
+            self._mark_dirty("named_actors", key)
         self.actors[info.actor_id] = info
+        self._mark_dirty("actors", info.actor_id)
         asyncio.ensure_future(self._schedule_actor(info))
         return {"existing": None}
 
@@ -552,20 +693,21 @@ class GcsServer:
                 info.state = "DEAD"
                 info.death_cause = f"creation failed: {reply['error']}"
                 info.version += 1
-                self._bump()
+                self._bump("actors", info.actor_id)
                 return
             info.state = "ALIVE"
             info.address = worker_addr
             info.node_id = node.node_id
             info.version += 1
             _metrics()["actors_created"].inc()
-            self._bump()
+            self._bump("actors", info.actor_id)
             logger.info("actor %s alive at %s", info.actor_id.hex()[:8],
                         worker_addr)
             return
         info.state = "DEAD"
         info.death_cause = "scheduling failed after 100 attempts"
         info.version += 1
+        self._bump("actors", info.actor_id)
 
     async def _on_actor_interrupted(self, actor: ActorInfo, reason: str):
         if actor.num_restarts < actor.max_restarts or actor.max_restarts == -1:
@@ -574,7 +716,7 @@ class GcsServer:
             actor.state = "RESTARTING"
             actor.address = ""
             actor.version += 1
-            self._bump()
+            self._bump("actors", actor.actor_id)
             logger.info("restarting actor %s (%d/%s): %s",
                         actor.actor_id.hex()[:8], actor.num_restarts,
                         actor.max_restarts, reason)
@@ -584,17 +726,25 @@ class GcsServer:
             actor.death_cause = reason
             actor.address = ""
             actor.version += 1
-            self._bump()
+            self._bump("actors", actor.actor_id)
 
     async def report_actor_death(self, req):
         actor = self.actors.get(req["actor_id"])
+        # Incarnation guard: a corpse report names the worker address it
+        # died at.  If the actor has already been restarted elsewhere
+        # (fast restarts outrun the ~0.2s corpse sweep), the stale report
+        # must not consume another restart — or kill the live actor.
+        dead_addr = req.get("address")
+        if (actor is not None and dead_addr and actor.address
+                and dead_addr != actor.address):
+            return {"ok": True, "stale": True}
         if actor is not None and actor.state in ("ALIVE", "PENDING"):
             if req.get("intentional"):
                 actor.state = "DEAD"
                 actor.death_cause = req.get("reason", "killed")
                 actor.address = ""
                 actor.version += 1
-                self._bump()
+                self._bump("actors", actor.actor_id)
             else:
                 await self._on_actor_interrupted(actor, req.get("reason", "?"))
         return {"ok": True}
@@ -627,7 +777,7 @@ class GcsServer:
             actor.death_cause = "ray_tpu.kill"
             actor.address = ""
             actor.version += 1
-            self._bump()
+            self._bump("actors", actor.actor_id)
         else:
             # Kill the process but honor max_restarts (reference:
             # ray.kill(no_restart=False) semantics).
@@ -654,6 +804,7 @@ class GcsServer:
             info.bundle_nodes = [None] * len(info.bundles)
             info.bundle_addresses = [""] * len(info.bundles)
         self.placement_groups[info.pg_id] = info
+        self._mark_dirty("placement_groups", info.pg_id)
         asyncio.ensure_future(self._schedule_pg(info))
         return {"ok": True}
 
@@ -800,7 +951,7 @@ class GcsServer:
             info.state = "CREATED"
             info.version += 1
             _metrics()["placement_groups_created"].inc()
-            self._bump()
+            self._bump("placement_groups", info.pg_id)
             logger.info("placement group %s created (%d bundles)",
                         info.pg_id.hex()[:8], len(info.bundles))
             return
@@ -822,7 +973,7 @@ class GcsServer:
             return {"ok": False}
         info.state = "REMOVED"
         info.version += 1
-        self._bump()
+        self._bump("placement_groups", info.pg_id)
         nodes = {nid for nid in info.bundle_nodes if nid is not None}
         for nid in nodes:
             node = self.nodes.get(nid)
@@ -947,8 +1098,30 @@ def main():
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--ready-file", default="")
+    parser.add_argument("--watch-pid", type=int, default=0,
+                        help="exit when this process disappears "
+                             "(driver-embedded clusters)")
     args = parser.parse_args()
     logging.basicConfig(level=os.environ.get("RAY_TPU_LOGLEVEL", "INFO"))
+
+    if args.watch_pid:
+        import threading
+        import time as _time
+
+        def _watch():
+            while True:
+                try:
+                    os.kill(args.watch_pid, 0)
+                except ProcessLookupError:
+                    logger.warning("driver %d gone; GCS exiting",
+                                   args.watch_pid)
+                    os._exit(0)
+                except PermissionError:
+                    pass
+                _time.sleep(1.0)
+
+        threading.Thread(target=_watch, daemon=True,
+                         name="driver-watch").start()
 
     async def run():
         gcs = GcsServer(args.host)

@@ -99,6 +99,111 @@ def _wants_tpu(demand: dict) -> bool:
                for k, v in demand.items())
 
 
+class _ForkedProc:
+    """Popen-compatible view of a worker forked by the zygote.
+
+    The child belongs to the zygote's process tree, so exit detection is
+    authoritative only through the zygote's reap reports (`exits` — a
+    shared {pid: code} map the hostd refreshes each reaper sweep).  The
+    kill(pid, 0) probe alone would misreport after pid reuse and always
+    lose the exit code; here it only accelerates detection between
+    sweeps, and the real code replaces the placeholder when the report
+    lands."""
+
+    def __init__(self, pid: int, exits: dict):
+        self.pid = pid
+        self.returncode: int | None = None
+        self._exits = exits
+
+    def poll(self):
+        if self.returncode is not None:
+            return self.returncode
+        code = self._exits.pop(self.pid, None)
+        if code is not None:
+            self.returncode = code
+            return code
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            # Gone but the reap report hasn't arrived yet; report dead
+            # with an unknown-exit placeholder (refined above if the
+            # report lands before anyone reads it).
+            self.returncode = self._exits.pop(self.pid, 255)
+            return self.returncode
+        except PermissionError:
+            return None
+
+    def terminate(self):
+        try:
+            os.kill(self.pid, 15)
+        except ProcessLookupError:
+            pass
+
+    def kill(self):
+        try:
+            os.kill(self.pid, 9)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(f"pid {self.pid}", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+
+class _Zygote:
+    """Manages the fork-server process (see worker_zygote.py).  Requests
+    are serialized under a lock; a fork round-trip is ~1-2ms, so blocking
+    the caller briefly beats a thread handoff."""
+
+    def __init__(self, env: dict):
+        import threading
+        self._lock = threading.Lock()
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_zygote"],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        import json as _json
+        line = self.proc.stdout.readline()  # waits for {"ready": true}
+        if not line or not _json.loads(line).get("ready"):
+            raise RuntimeError("zygote failed to start")
+
+    def spawn(self, argv: list, env: dict, stdout: str, stderr: str) -> int:
+        import json as _json
+        req = _json.dumps({"argv": argv, "env": env,
+                           "stdout": stdout, "stderr": stderr}) + "\n"
+        with self._lock:
+            self.proc.stdin.write(req.encode())
+            self.proc.stdin.flush()
+            line = self.proc.stdout.readline()
+        if not line:
+            raise RuntimeError("zygote died")
+        return int(_json.loads(line)["pid"])
+
+    def poll_exits(self, into: dict) -> None:
+        """Drain the zygote's reap reports into `into` ({pid: code})."""
+        import json as _json
+        with self._lock:
+            self.proc.stdin.write(b'{"reap": true}\n')
+            self.proc.stdin.flush()
+            line = self.proc.stdout.readline()
+        if not line:
+            raise RuntimeError("zygote died")
+        for pid, code in _json.loads(line).get("exited", []):
+            into[int(pid)] = int(code)
+
+    def close(self):
+        try:
+            self.proc.stdin.close()
+            self.proc.terminate()
+        except Exception:
+            pass
+
+
 class WorkerHandle:
     def __init__(self, proc: subprocess.Popen, job_id: int,
                  env_hash: str = "", tpu: bool = False):
@@ -160,6 +265,11 @@ class NodeDaemon:
         # whole pool ramp-up behind one ~0.3s boot at a time.
         self.max_startup_concurrency = (
             _cfg().max_startup_concurrency or max(4, os.cpu_count() or 1))
+        # Fork-server (worker_zygote.py): prestarted off-loop at daemon
+        # start so its cold-import time never blocks a lease; until it's
+        # ready, spawns fall back to the classic Popen path.
+        self._zygote: _Zygote | None = None
+        self._zygote_exits: dict = {}   # pid -> exit code (reap reports)
         self._capacity_freed: asyncio.Event | None = None  # made on start()
         # Object spilling (reference: raylet LocalObjectManager
         # local_object_manager.h:41 + _private/external_storage.py:246
@@ -202,15 +312,34 @@ class NodeDaemon:
             env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
             env["RAY_TPU_RUNTIME_ENV_CACHE"] = os.path.join(
                 self.session_dir, "runtime_env")
-        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
-               "--gcs", self.gcs_address,
-               "--hostd", f"{self.host}:{self.server.port}",
-               "--store", self.store_path,
-               "--node-id", self.node_id.hex(),
-               "--job-id", str(job_id)]
-        out = open(log_base + ".out", "ab")
-        err = open(log_base + ".err", "ab")
-        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
+        argv = ["--gcs", self.gcs_address,
+                "--hostd", f"{self.host}:{self.server.port}",
+                "--store", self.store_path,
+                "--node-id", self.node_id.hex(),
+                "--job-id", str(job_id)]
+        proc = None
+        if not tpu and _cfg().worker_zygote:
+            # Fast path: fork the pre-imported template (~1-2ms vs ~300ms
+            # cold spawn).  TPU workers never fork — PJRT state must not
+            # cross a fork.
+            try:
+                pid = self._zygote_spawn(
+                    argv, env, log_base + ".out", log_base + ".err")
+                if pid is not None:
+                    proc = _ForkedProc(pid, self._zygote_exits)
+            except Exception:
+                logger.exception("zygote spawn failed; cold-spawning")
+                # Same rule as the reap poll: never kill a live zygote —
+                # its death would cascade to every forked worker.
+                if (self._zygote is not None
+                        and self._zygote.proc.poll() is not None):
+                    self._zygote_close()
+        if proc is None:
+            cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+                   *argv]
+            out = open(log_base + ".out", "ab")
+            err = open(log_base + ".err", "ab")
+            proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
         handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env), tpu)
         handle.log_paths = {"stdout": log_base + ".out",
                             "stderr": log_base + ".err"}
@@ -220,6 +349,39 @@ class NodeDaemon:
         logger.info("spawned worker pid=%d job=%d env=%s", proc.pid, job_id,
                     handle.env_hash or "-")
         return handle
+
+    def _zygote_spawn(self, argv, env, out_path, err_path) -> int | None:
+        """Fork via the prestarted zygote; None while it's still warming
+        (caller cold-spawns instead of waiting)."""
+        if self._zygote is None:
+            self._prestart_zygote()
+            return None
+        return self._zygote.spawn(argv, env, out_path, err_path)
+
+    def _prestart_zygote(self):
+        if getattr(self, "_zygote_starting", False):
+            return
+        self._zygote_starting = True
+
+        def _boot():
+            try:
+                zenv = dict(os.environ)
+                zenv.pop("PALLAS_AXON_POOL_IPS", None)
+                zenv["JAX_PLATFORMS"] = "cpu"
+                self._zygote = _Zygote(zenv)
+            except Exception:
+                logger.exception("zygote failed to start; cold spawns only")
+            finally:
+                self._zygote_starting = False
+
+        import threading
+        threading.Thread(target=_boot, daemon=True,
+                         name="zygote-boot").start()
+
+    def _zygote_close(self):
+        if self._zygote is not None:
+            self._zygote.close()
+            self._zygote = None
 
     async def worker_ready(self, req):
         """Called by a freshly started worker process."""
@@ -254,7 +416,16 @@ class NodeDaemon:
                     return handle
             live = [w for w in self.workers.values() if w.proc.poll() is None]
             starting = sum(1 for w in live if w.state == "starting")
-            if starting >= self.max_startup_concurrency:
+            # Forked (zygote) spawns skip the interpreter+import cost, so
+            # the anti-thundering-herd throttle — which exists because
+            # cold spawns contend for cores — opens up for them.  Only
+            # when the zygote is actually SERVING: while it's still
+            # warming (or failed), spawns are cold Popens and must keep
+            # the cold throttle.
+            throttle = self.max_startup_concurrency
+            if not tpu and self._zygote is not None:
+                throttle = max(throttle, 32)
+            if starting >= throttle:
                 # Throttle check comes BEFORE eviction: only kill an idle
                 # worker when a replacement spawn will actually follow.
                 remaining = deadline - asyncio.get_event_loop().time()
@@ -990,6 +1161,25 @@ class NodeDaemon:
         """Detect dead/idle-expired workers; report dead actor workers."""
         while not self._shutdown.is_set():
             now = time.monotonic()
+            z = self._zygote   # snapshot: _zygote_close can race the await
+            if z is not None:
+                # Drain reap reports (authoritative exit codes for forked
+                # workers) off-loop; the pipe round trip is ~1ms but must
+                # not stall RPC serving under load.
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, z.poll_exits, self._zygote_exits)
+                except Exception:
+                    # Close ONLY if the zygote process is actually dead:
+                    # terminating it reparents every forked worker, whose
+                    # ppid watch then kills them — one transient pipe
+                    # error must never take down the node's workers.
+                    if z.proc.poll() is not None:
+                        logger.warning("zygote died; cold spawns only")
+                        if self._zygote is z:
+                            self._zygote_close()
+                    else:
+                        logger.warning("zygote reap poll failed (kept)")
             for handle in list(self.workers.values()):
                 if handle.proc.poll() is not None:
                     # Final log read FIRST: a crashing worker's traceback
@@ -1004,6 +1194,7 @@ class NodeDaemon:
                             await self.gcs.call(
                                 "Gcs", "report_actor_death",
                                 {"actor_id": handle.actor_id,
+                                 "address": handle.address,
                                  "reason": f"worker exited "
                                            f"({handle.proc.returncode})"},
                                 timeout=2)
@@ -1053,6 +1244,8 @@ class NodeDaemon:
             self.transfer_server = None
         await self.gcs.call("Gcs", "register_node", {"info": self.node_info()},
                             timeout=10)
+        if _cfg().worker_zygote:
+            self._prestart_zygote()  # off-loop; cold imports never block
         self._tasks = [asyncio.ensure_future(self._heartbeat_loop()),
                        asyncio.ensure_future(self._reaper_loop())]
         if self.spill_enabled:
@@ -1079,6 +1272,7 @@ class NodeDaemon:
             t.cancel()
         for handle in list(self.workers.values()):
             self._kill_worker(handle)
+        self._zygote_close()
         deadline = time.monotonic() + 3
         for handle in list(self.workers.values()):
             try:

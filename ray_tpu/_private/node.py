@@ -77,13 +77,20 @@ def new_session_dir() -> str:
     return d
 
 
-def start_gcs(session_dir: str, group: ProcessGroup, host="127.0.0.1") -> str:
+def start_gcs(session_dir: str, group: ProcessGroup, host="127.0.0.1",
+              port: int = 0, watch_parent: bool = False) -> str:
+    """watch_parent: a driver-embedded cluster (ray_tpu.init) dies with
+    its driver even when the driver is SIGKILLed and atexit never runs —
+    the GCS polls the driver pid and exits when it vanishes; hostds then
+    follow via their GCS-unreachable watchdog.  CLI/launcher-started
+    clusters must OUTLIVE the starting process, so they don't watch."""
     ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}")
     log = open(os.path.join(session_dir, "logs", "gcs.err"), "ab")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.gcs",
-         "--host", host, "--ready-file", ready],
-        stdout=log, stderr=log, env=_daemon_env())
+    cmd = [sys.executable, "-m", "ray_tpu._private.gcs",
+           "--host", host, "--ready-file", ready, "--port", str(port)]
+    if watch_parent:
+        cmd += ["--watch-pid", str(os.getpid())]
+    proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=_daemon_env())
     group.procs.append(proc)
     port = _wait_ready_file(ready, proc, what="GCS").strip()
     return f"{host}:{port}"

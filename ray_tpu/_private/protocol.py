@@ -27,6 +27,9 @@ class Resources:
     memory: float = 0.0
     custom: dict = field(default_factory=dict)
 
+    def __reduce__(self):
+        return (Resources, (self.cpu, self.tpu, self.memory, self.custom))
+
     def to_dict(self) -> dict:
         d = dict(self.custom)
         if self.cpu:
@@ -66,11 +69,31 @@ class ValueArg:
     data: bytes
     metadata: bytes
 
+    def __reduce__(self):  # tuple-based: ~2x faster than dataclass default
+        return (ValueArg, (self.data, self.metadata))
+
 
 @dataclass
 class RefArg:
     id_binary: bytes
     owner_address: str
+
+    def __reduce__(self):
+        return (RefArg, (self.id_binary, self.owner_address))
+
+
+def _mk_taskspec(*fields) -> "TaskSpec":
+    """Positional reconstructor for TaskSpec.__reduce__ (pickling a spec
+    sits on the per-task hot path on both sides of the wire; a tuple
+    avoids the dataclass default's per-field name dict)."""
+    s = TaskSpec.__new__(TaskSpec)
+    (s.task_id, s.job_id, s.name, s.fn_key, s.args, s.kwargs,
+     s.num_returns, s.resources, s.max_retries, s.retry_exceptions,
+     s.owner_address, s.actor_id, s.actor_creation, s.method_name,
+     s.seq_no, s.max_concurrency, s.placement_group, s.bundle_index,
+     s.node_affinity, s.node_affinity_soft, s.scheduling_strategy,
+     s.runtime_env, s.trace_ctx) = fields
+    return s
 
 
 @dataclass
@@ -102,6 +125,20 @@ class TaskSpec:
     node_affinity_soft: bool = True
     scheduling_strategy: str = "DEFAULT"     # DEFAULT | SPREAD
     runtime_env: dict = field(default_factory=dict)
+    # Propagated trace context (trace_id, span_id) — injected at submit,
+    # extracted at execute (reference: tracing_helper.py:87).
+    trace_ctx: Optional[tuple] = None
+
+    def __reduce__(self):
+        return (_mk_taskspec, (
+            self.task_id, self.job_id, self.name, self.fn_key, self.args,
+            self.kwargs, self.num_returns, self.resources,
+            self.max_retries, self.retry_exceptions, self.owner_address,
+            self.actor_id, self.actor_creation, self.method_name,
+            self.seq_no, self.max_concurrency, self.placement_group,
+            self.bundle_index, self.node_affinity,
+            self.node_affinity_soft, self.scheduling_strategy,
+            self.runtime_env, self.trace_ctx))
 
 
 @dataclass

@@ -204,6 +204,7 @@ class EventLoopThread:
         self.loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
+        self.ident = self._thread.ident  # loop-thread id for fast checks
 
     def _run(self):
         asyncio.set_event_loop(self.loop)

@@ -1,16 +1,24 @@
-"""Runtime environments: per-task/actor env vars + code shipping.
+"""Runtime environments: per-task/actor env vars + code + packages.
 
 Reference parity: python/ray/_private/runtime_env/ — working_dir.py /
 py_modules.py (zip upload to GCS KV, content-addressed, cached per node),
-plugin descriptor plumbing through the raylet worker pool
-(worker_pool.h:245 runtime-env-hash worker caching).  pip/conda creation
-is gated off (this environment is zero-egress); env_vars, working_dir and
-py_modules are fully supported.
+pip.py (per-env package installation, cached by requirements hash), and
+the plugin descriptor plumbing through the raylet worker pool
+(worker_pool.h:245 runtime-env-hash worker caching).
+
+pip installs come from a LOCAL WHEELHOUSE (`--no-index --find-links`):
+this deployment is zero-egress, so packages must be pre-staged as wheels
+on every node (RAY_TPU_WHEELHOUSE or the descriptor's `wheelhouse` path)
+— the reference's pip.py network fetch replaced by an offline resolve
+with identical isolation semantics (per-requirements-hash target dir
+prepended to sys.path, shared by same-env workers on a node).  conda
+remains gated: a conda solve cannot run offline.
 
 Descriptor shape (what travels in TaskSpec.runtime_env after packaging):
     {"env_vars": {...},
      "working_dir_key": "pkg:<sha1>",       # GCS KV key
-     "py_module_keys": ["pkg:<sha1>", ...]}
+     "py_module_keys": ["pkg:<sha1>", ...],
+     "pip": {"packages": [...], "wheelhouse": "/abs/path"}}
 """
 
 from __future__ import annotations
@@ -48,12 +56,33 @@ async def build_descriptor(runtime_env: Dict[str, Any], kv_call
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}; "
                          f"supported: {sorted(_SUPPORTED)}")
-    if runtime_env.get("pip") or runtime_env.get("conda"):
+    if runtime_env.get("conda"):
         raise NotImplementedError(
-            "runtime_env pip/conda environments need package downloads; "
-            "this deployment is network-isolated — bake dependencies into "
-            "the image and use working_dir/py_modules for code")
+            "runtime_env conda environments need a network solve; this "
+            "deployment is zero-egress — use pip with a local wheelhouse "
+            "or bake dependencies into the image")
     desc: Dict[str, Any] = {}
+    pip_spec = runtime_env.get("pip")
+    if pip_spec:
+        if isinstance(pip_spec, (list, tuple)):
+            packages, wheelhouse = list(pip_spec), None
+        elif isinstance(pip_spec, dict):
+            packages = list(pip_spec.get("packages", []))
+            wheelhouse = pip_spec.get("wheelhouse")
+        else:
+            raise ValueError(
+                "runtime_env pip must be a list of requirements or "
+                "{'packages': [...], 'wheelhouse': path}")
+        wheelhouse = wheelhouse or os.environ.get("RAY_TPU_WHEELHOUSE")
+        if not wheelhouse:
+            raise ValueError(
+                "runtime_env pip needs a local wheelhouse (zero-egress): "
+                "set RAY_TPU_WHEELHOUSE or pass "
+                "pip={'packages': [...], 'wheelhouse': path}")
+        if not packages:
+            raise ValueError("runtime_env pip: no packages listed")
+        desc["pip"] = {"packages": sorted(packages),
+                       "wheelhouse": os.path.abspath(wheelhouse)}
     env_vars = runtime_env.get("env_vars") or {}
     if env_vars:
         if not all(isinstance(k, str) and isinstance(v, str)
@@ -121,3 +150,41 @@ async def setup_in_worker(descriptor: Dict[str, Any], kv_call,
         os.chdir(path)
         if path not in sys.path:
             sys.path.insert(0, path)
+    if descriptor.get("pip"):
+        path = _ensure_pip_env(descriptor["pip"], cache_root)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+
+
+def _ensure_pip_env(pip_desc: Dict[str, Any], cache_root: str) -> str:
+    """Install the requirement set from the local wheelhouse into a
+    per-hash target dir (reference: pip.py's per-env virtualenv, cached
+    by requirements hash).  `--no-index` keeps the resolve offline."""
+    import hashlib as _hashlib
+    import subprocess
+    packages = pip_desc["packages"]
+    wheelhouse = pip_desc["wheelhouse"]
+    tag = _hashlib.sha1(json.dumps(
+        [packages, wheelhouse], sort_keys=True).encode()).hexdigest()[:16]
+    dest = os.path.join(cache_root, f"pip_{tag}")
+    if os.path.isdir(dest):
+        return dest
+    if not os.path.isdir(wheelhouse):
+        raise RuntimeError(
+            f"runtime_env pip wheelhouse does not exist on this node: "
+            f"{wheelhouse}")
+    tmp = dest + f".tmp{os.getpid()}"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pip", "install", "--quiet",
+         "--no-index", "--find-links", wheelhouse,
+         "--target", tmp, *packages],
+        capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pip install from wheelhouse failed: {proc.stderr.strip()}")
+    try:
+        os.replace(tmp, dest)
+    except OSError:  # another worker won the race
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest

@@ -19,15 +19,18 @@ through the C++ writer.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import logging
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
 
 _REC_HDR = struct.Struct("<QQiQ")  # tag, req_id, status, payload_len
+_FRAME_HDR = struct.Struct("<IQ")  # frame_len, req_id (wire framing)
 
 TPT_OK = 0
 TPT_ECONN = -1
@@ -52,6 +55,8 @@ class _Lib:
         fast.tpt_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                   ctypes.c_uint64, ctypes.c_char_p,
                                   ctypes.c_uint64]
+        fast.tpt_send_raw.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                      ctypes.c_char_p, ctypes.c_uint64]
         fast.tpt_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         blocking.tpt_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_uint64,
@@ -68,16 +73,21 @@ class _Lib:
         fast.tpt_server_reply.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                           ctypes.c_uint64, ctypes.c_char_p,
                                           ctypes.c_uint64]
+        fast.tpt_server_reply_raw.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
         blocking.tpt_server_close.argtypes = [ctypes.c_void_p]
         self.tpt_client_new = fast.tpt_client_new
         self.tpt_connect = fast.tpt_connect
         self.tpt_send = fast.tpt_send
+        self.tpt_send_raw = fast.tpt_send_raw
         self.tpt_close_conn = fast.tpt_close_conn
         self.tpt_poll = blocking.tpt_poll
         self.tpt_client_close = blocking.tpt_client_close
         self.tpt_server_new = fast.tpt_server_new
         self.tpt_server_pop = blocking.tpt_server_pop
         self.tpt_server_reply = fast.tpt_server_reply
+        self.tpt_server_reply_raw = fast.tpt_server_reply_raw
         self.tpt_server_close = blocking.tpt_server_close
 
 
@@ -113,11 +123,19 @@ class ConnClosedError(ConnectionError):
 
 
 class NativeSubmitter:
-    """Driver/owner-side pipelined task pusher."""
+    """Driver/owner-side pipelined task pusher.
+
+    Hot-path locking: `call_cb`/`call` run only on the owning event-loop
+    thread, so request registration needs no lock (dict ops are atomic
+    under the GIL and the completion for a request cannot arrive before
+    its `tpt_send`).  The poller thread pops completions with atomic
+    `dict.pop` and hands the batch to the loop in ONE wakeup.  `_mu`
+    guards only the (cold) connection map."""
 
     POLL_BUF = 4 << 20
 
     def __init__(self, loop):
+        import itertools
         self._loop = loop
         self._l = lib()
         h = ctypes.c_void_p()
@@ -126,8 +144,8 @@ class NativeSubmitter:
             raise OSError(f"tpt_client_new failed: {rc}")
         self._h = h
         self._conns: dict[str, int] = {}
-        self._futs: dict[int, object] = {}   # req_id -> asyncio future
-        self._req = 0
+        self._cbs: dict[int, object] = {}   # req_id -> cb(status, payload)
+        self._req_iter = itertools.count(1)
         self._mu = threading.Lock()
         self._closed = False
         self._poller = threading.Thread(
@@ -160,26 +178,71 @@ class NativeSubmitter:
 
     # -- submission -------------------------------------------------------
 
-    def call(self, addr: str, payload: bytes):
-        """Schedule a request; returns an asyncio future on the owning
-        loop (await it there)."""
-        import asyncio
-        fut = self._loop.create_future()
+    def call_cb(self, addr: str, payload: bytes, cb) -> None:
+        """Push a request; `cb(status, payload_bytes)` runs on the owning
+        loop when the reply (or transport failure) arrives.  Zero futures,
+        zero per-request loop callbacks: completions are delivered a
+        BATCH per loop wakeup and cbs run inline.
+
+        Failure callbacks are DEFERRED via call_soon: callers dispatch
+        from inside scheduler loops, and a synchronous error callback
+        would re-enter them mid-iteration (the future-based API always
+        deferred; this preserves that contract)."""
         try:
             tag = self.connect(addr)
-        except ConnectionError as e:
-            fut.set_exception(e)
-            return fut
-        with self._mu:
-            self._req += 1
-            req_id = self._req
-            self._futs[req_id] = fut
+        except ConnectionError:
+            self._loop.call_soon(cb, TPT_ECONN, b"")
+            return
+        req_id = next(self._req_iter)
+        self._cbs[req_id] = cb
         rc = self._l.tpt_send(self._h, tag, req_id, payload, len(payload))
         if rc != 0:
-            with self._mu:
-                self._futs.pop(req_id, None)
+            self._cbs.pop(req_id, None)
             self.invalidate(addr)
-            fut.set_exception(ConnClosedError(f"send to {addr} failed"))
+            self._loop.call_soon(cb, TPT_ECONN, b"")
+
+    def call_cb_batch(self, addr: str, items) -> None:
+        """Push a burst of requests to one worker in a single library
+        call: frames are built in Python (struct.pack + join) and handed
+        to C pre-framed — one queue append, one io wakeup for the whole
+        batch.  `items` is a sequence of (payload, cb)."""
+        try:
+            tag = self.connect(addr)
+        except ConnectionError:
+            for _p, cb in items:   # deferred: see call_cb
+                self._loop.call_soon(cb, TPT_ECONN, b"")
+            return
+        cbs = self._cbs
+        parts = []
+        ids = []
+        for payload, cb in items:
+            req_id = next(self._req_iter)
+            cbs[req_id] = cb
+            ids.append(req_id)
+            parts.append(_FRAME_HDR.pack(8 + len(payload), req_id))
+            parts.append(payload)
+        blob = b"".join(parts)
+        rc = self._l.tpt_send_raw(self._h, tag, blob, len(blob))
+        if rc != 0:
+            self.invalidate(addr)
+            for req_id, (_p, cb) in zip(ids, items):
+                if cbs.pop(req_id, None) is not None:
+                    self._loop.call_soon(cb, TPT_ECONN, b"")
+
+    def call(self, addr: str, payload: bytes):
+        """Awaitable variant: returns an asyncio future on the owning
+        loop (await it there)."""
+        fut = self._loop.create_future()
+
+        def cb(status, data):
+            if fut.cancelled():
+                return
+            if status == 0:
+                fut.set_result(data)
+            else:
+                fut.set_exception(
+                    ConnClosedError("worker connection closed"))
+        self.call_cb(addr, payload, cb)
         return fut
 
     # -- completion pump --------------------------------------------------
@@ -203,12 +266,11 @@ class NativeSubmitter:
             # string_at copies only the used prefix (buf.raw would copy
             # the whole 4MB buffer per batch).
             raw = ctypes.string_at(buf, used.value)
-            with self._mu:
-                for tag, _rid, status, payload in _unpack_records(
-                        raw, used.value):
-                    fut = self._futs.pop(tag, None)
-                    if fut is not None:
-                        batch.append((fut, status, payload))
+            for tag, _rid, status, payload in _unpack_records(
+                    raw, used.value):
+                cb = self._cbs.pop(tag, None)
+                if cb is not None:
+                    batch.append((cb, status, payload))
             if batch:
                 try:
                     self._loop.call_soon_threadsafe(self._resolve, batch)
@@ -217,14 +279,11 @@ class NativeSubmitter:
 
     @staticmethod
     def _resolve(batch):
-        for fut, status, payload in batch:
-            if fut.cancelled():
-                continue
-            if status == 0:
-                fut.set_result(payload)
-            else:
-                fut.set_exception(
-                    ConnClosedError("worker connection closed"))
+        for cb, status, payload in batch:
+            try:
+                cb(status, payload)
+            except Exception:
+                logger.exception("native completion callback failed")
 
     def close(self):
         self._closed = True
@@ -241,6 +300,13 @@ class NativeReceiver:
     the executor thread for every received task, in per-connection FIFO
     order; it either replies synchronously or hands off and replies later
     (async actors).
+
+    Replies produced synchronously while an execution batch is being
+    drained are accumulated and flushed per connection in ONE pre-framed
+    library call (tpt_server_reply_raw): a per-reply enqueue costs an
+    eventfd wake — a context switch on small hosts — where a batch costs
+    one.  Replies from any other thread (async actors, thread-pool
+    actors) go out immediately via the classic per-reply path.
     """
 
     POP_BUF = 4 << 20
@@ -257,9 +323,49 @@ class NativeReceiver:
         self.port = port.value
         self._handler = handler
         self._closed = False
+        # Per-thread reply batches: a thread inside batch_scope() has its
+        # replies accumulated and flushed in one call per conn at scope
+        # exit; all other threads reply immediately.
+        self._batches: dict[int, dict] = {}
+        # Event-loop threads registered for per-tick coalescing (async
+        # actors): replies accumulate across one loop tick and flush via
+        # a call_soon'd drain.
+        self._tick: dict[int, list] = {}   # ident -> [loop, batch dict]
         self._exec = threading.Thread(
             target=self._exec_loop, daemon=True, name="tpt-exec")
         self._exec.start()
+
+    @contextlib.contextmanager
+    def batch_scope(self):
+        """Accumulate this thread's synchronous replies; flush per conn in
+        one pre-framed call at exit (used around execution bursts).
+        Between tasks of a burst, callers invoke flush_thread_batch()
+        after any slow task so a fast task's reply is never held behind a
+        slow neighbour (head-of-line)."""
+        ident = threading.get_ident()
+        outer = self._batches.get(ident)
+        self._batches[ident] = {}
+        try:
+            yield
+        finally:
+            batch = self._batches.pop(ident, {})
+            if outer is not None:
+                self._batches[ident] = outer
+            self._flush(batch)
+
+    def flush_thread_batch(self) -> None:
+        """Ship this thread's accumulated replies NOW (keeps the scope
+        open for subsequent tasks in the burst)."""
+        batch = self._batches.get(threading.get_ident())
+        if batch:
+            drained = dict(batch)
+            batch.clear()
+            self._flush(drained)
+
+    def _flush(self, batch: dict) -> None:
+        for tag, frames in batch.items():
+            blob = b"".join(frames)
+            self._l.tpt_server_reply_raw(self._h, tag, blob, len(blob))
 
     def _exec_loop(self):
         cap = self.POP_BUF
@@ -275,17 +381,52 @@ class NativeReceiver:
             if n <= 0:
                 continue
             raw = ctypes.string_at(buf, used.value)
-            for tag, req_id, _status, payload in _unpack_records(
-                    raw, used.value):
-                reply = self._make_reply(tag, req_id)
-                try:
-                    self._handler(payload, reply)
-                except BaseException:
-                    logger.exception("native task handler failed")
+            with self.batch_scope():
+                for tag, req_id, _status, payload in _unpack_records(
+                        raw, used.value):
+                    reply = self._make_reply(tag, req_id)
+                    t0 = time.monotonic()
+                    try:
+                        self._handler(payload, reply)
+                    except BaseException:
+                        logger.exception("native task handler failed")
+                    if time.monotonic() - t0 > 0.002:
+                        # A slow task must not hold earlier fast tasks'
+                        # replies hostage for the rest of the burst.
+                        self.flush_thread_batch()
+
+    def enable_tick_batching(self, loop):
+        """Coalesce replies produced on `loop`'s thread across one loop
+        tick (async-actor completions land many per tick; each direct
+        reply would cost an io wakeup)."""
+        def _register():
+            self._tick[threading.get_ident()] = [loop, {}]
+        loop.call_soon_threadsafe(_register)
+
+    def _flush_tick(self, ident):
+        entry = self._tick.get(ident)
+        if entry is None:
+            return
+        batch, entry[1] = entry[1], {}
+        self._flush(batch)
 
     def _make_reply(self, tag: int, req_id: int):
         def reply(data: bytes):
-            self._l.tpt_server_reply(self._h, tag, req_id, data, len(data))
+            ident = threading.get_ident()
+            batch = self._batches.get(ident)
+            if batch is not None:
+                batch.setdefault(tag, []).append(
+                    _FRAME_HDR.pack(8 + len(data), req_id) + data)
+                return
+            tick = self._tick.get(ident)
+            if tick is not None:
+                if not tick[1]:
+                    tick[0].call_soon(self._flush_tick, ident)
+                tick[1].setdefault(tag, []).append(
+                    _FRAME_HDR.pack(8 + len(data), req_id) + data)
+                return
+            self._l.tpt_server_reply(self._h, tag, req_id, data,
+                                     len(data))
         return reply
 
     def close(self):

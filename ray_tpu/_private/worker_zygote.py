@@ -1,0 +1,136 @@
+"""Fork-server ("zygote") for fast worker spawn.
+
+Reference parity: the raylet's worker prestart pool (worker_pool.h:245
+PrestartWorkers + maximum_startup_concurrency) exists because cold Python
+worker boot is the latency floor for task fan-out, actor creation storms
+and autoscaler response.  This build goes one step further than
+prestarting: the hostd keeps ONE template process that has already paid
+the interpreter + import cost (~0.3s on a small host), and every non-TPU
+worker is an os.fork() of it (~1-2ms, memory shared copy-on-write).
+
+Protocol (line-delimited JSON over the zygote's stdin/stdout):
+  hostd -> zygote: {"argv": [...], "env": {k: v}, "stdout": path, "stderr": path}
+  zygote -> hostd: {"pid": <child pid>}       (one reply per request)
+The zygote emits {"ready": true} once imports are done.  EOF on stdin or
+the hostd's death (orphan watch) shuts it down; forked children notice
+the zygote's death via their own ppid watch (worker_main.orphan_watch).
+
+TPU workers do NOT fork: PJRT/TPU runtime state must never cross a fork,
+so hostd keeps the classic spawn path for them (hostd._spawn_worker).
+
+Fork safety: the zygote is strictly single-threaded and starts no event
+loops; heavy modules are imported, never initialized (no grpc channels,
+no sockets, no jax).  Children re-create all runtime state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import signal
+import sys
+
+
+_exited: dict = {}   # pid -> exit code, drained by the hostd's "reap" poll
+
+
+def _reap(_sig, _frm):
+    """Collect exited children, recording their REAL exit codes (the
+    hostd cannot waitpid children of this process; it polls them back
+    over the pipe so crashes keep their signal instead of reading as
+    exit 0, and so a recycled pid is never mistaken for a live worker)."""
+    while True:
+        try:
+            pid, status = os.waitpid(-1, os.WNOHANG)
+        except ChildProcessError:
+            return
+        if pid == 0:
+            return
+        if os.WIFSIGNALED(status):
+            _exited[pid] = -os.WTERMSIG(status)
+        else:
+            _exited[pid] = os.WEXITSTATUS(status)
+
+
+def _child(req) -> None:
+    """Runs in the forked child; becomes a full worker process."""
+    os.setsid()
+    out = os.open(req["stdout"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                  0o644)
+    err = os.open(req["stderr"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                  0o644)
+    os.dup2(out, 1)
+    os.dup2(err, 2)
+    os.close(out)
+    os.close(err)
+    env = req["env"]
+    os.environ.clear()
+    os.environ.update(env)
+    sys.argv = ["ray_tpu_worker"] + list(req["argv"])
+    code = 0
+    try:
+        from ray_tpu._private import worker_main
+        worker_main.main()
+    except SystemExit as e:
+        code = e.code if isinstance(e.code, int) else (0 if e.code is None
+                                                       else 1)
+    except BaseException:  # noqa: BLE001 - never unwind into the fork loop
+        import traceback
+        traceback.print_exc()
+        code = 1   # a crash must not read as a clean exit upstream
+    os._exit(code)
+
+
+def main() -> None:
+    # Pre-import the worker stack; forks inherit it copy-on-write.  Keep
+    # this list in sync with what worker_main.main touches on boot —
+    # anything missed still works, it just pays its import in the child.
+    import numpy  # noqa: F401
+    from ray_tpu import api  # noqa: F401
+    from ray_tpu._private import core_worker  # noqa: F401
+    from ray_tpu._private import rpc  # noqa: F401
+    from ray_tpu._private import serialization  # noqa: F401
+    from ray_tpu._private import task_transport  # noqa: F401
+    from ray_tpu._private import worker_main  # noqa: F401
+
+    signal.signal(signal.SIGCHLD, _reap)
+
+    hostd_pid = os.getppid()
+    rd = sys.stdin.buffer
+    wr = sys.stdout.buffer
+    wr.write(b'{"ready": true}\n')
+    wr.flush()
+    while True:
+        # select keeps the process single-threaded (fork-safe) while
+        # still noticing hostd death between requests; hostd death also
+        # closes the pipe, which readline reports as EOF.
+        ready, _, _ = select.select([rd], [], [], 1.0)
+        if not ready:
+            if os.getppid() != hostd_pid:
+                os._exit(0)
+            continue
+        line = rd.readline()
+        if not line:
+            os._exit(0)  # hostd closed the pipe
+        try:
+            req = json.loads(line)
+        except ValueError:
+            continue
+        if req.get("reap"):
+            out = dict(_exited)
+            for k in out:   # pop only what was copied: a SIGCHLD between
+                _exited.pop(k, None)   # copy and clear() must not be lost
+            wr.write((json.dumps({"exited": list(out.items())})
+                      + "\n").encode())
+            wr.flush()
+            continue
+        pid = os.fork()
+        if pid == 0:
+            _child(req)  # never returns
+        wr.write((json.dumps({"pid": pid}) + "\n").encode())
+        wr.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -82,7 +82,7 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
             session_dir = node_mod.new_session_dir()
             group = node_mod.ProcessGroup()
             try:
-                gcs_address = node_mod.start_gcs(session_dir, group)
+                gcs_address = node_mod.start_gcs(session_dir, group, watch_parent=True)
                 head = node_mod.start_hostd(
                     gcs_address, session_dir, group,
                     num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,

@@ -1,0 +1,123 @@
+"""Command runners: how the cluster launcher reaches a machine.
+
+Reference parity: python/ray/autoscaler/_private/command_runner.py —
+SSHCommandRunner (ssh/rsync with ControlMaster options) and the docker
+wrapper.  Here: a LocalCommandRunner executes on this host (single-host
+clusters, tests — the fake provider's analogue), and SSHCommandRunner
+shells out to ssh/scp for real multi-host clusters.  Both speak the same
+three verbs the launcher needs: run, run_detached, put.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+from typing import Optional, Tuple
+
+
+class CommandRunner:
+    def run(self, cmd: str, timeout: Optional[float] = None,
+            env: Optional[dict] = None) -> Tuple[int, str]:
+        """Run `cmd` through a shell; returns (rc, combined output)."""
+        raise NotImplementedError
+
+    def run_detached(self, cmd: str, log_path: str,
+                     env: Optional[dict] = None) -> None:
+        """Start `cmd` so it outlives this process (daemon start)."""
+        raise NotImplementedError
+
+    def put(self, local_path: str, remote_path: str) -> None:
+        """Copy a local file onto the target machine."""
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    """Executes on this host (provider type `local`)."""
+
+    def run(self, cmd, timeout=None, env=None):
+        e = dict(os.environ)
+        if env:
+            e.update(env)
+        proc = subprocess.run(cmd, shell=True, env=e, timeout=timeout,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+        return proc.returncode, proc.stdout
+
+    def run_detached(self, cmd, log_path, env=None):
+        e = dict(os.environ)
+        if env:
+            e.update(env)
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        with open(log_path, "ab") as log:
+            subprocess.Popen(cmd, shell=True, env=e, stdout=log,
+                             stderr=subprocess.STDOUT,
+                             start_new_session=True)
+
+    def put(self, local_path, remote_path):
+        if os.path.abspath(local_path) == os.path.abspath(remote_path):
+            return
+        os.makedirs(os.path.dirname(remote_path) or ".", exist_ok=True)
+        import shutil
+        shutil.copy2(local_path, remote_path)
+
+
+class SSHCommandRunner(CommandRunner):
+    """Drives a remote host over ssh/scp (reference: command_runner.py
+    SSHCommandRunner, incl. the ControlMaster multiplexing options)."""
+
+    _SSH_OPTS = [
+        "-o", "StrictHostKeyChecking=no",
+        "-o", "UserKnownHostsFile=/dev/null",
+        "-o", "LogLevel=ERROR",
+        "-o", "ControlMaster=auto",
+        "-o", "ControlPath=/tmp/ray_tpu_ssh_%C",
+        "-o", "ControlPersist=60s",
+    ]
+
+    def __init__(self, ip: str, user: str = "",
+                 key_path: Optional[str] = None, port: int = 22):
+        self.ip = ip
+        self.user = user
+        self.key_path = key_path
+        self.port = port
+
+    def _target(self) -> str:
+        return f"{self.user}@{self.ip}" if self.user else self.ip
+
+    def _base(self, scp: bool = False) -> list:
+        cmd = ["scp" if scp else "ssh", *self._SSH_OPTS]
+        if self.key_path:
+            cmd += ["-i", os.path.expanduser(self.key_path)]
+        cmd += (["-P", str(self.port)] if scp else ["-p", str(self.port)])
+        return cmd
+
+    def run(self, cmd, timeout=None, env=None):
+        envs = ""
+        if env:
+            envs = " ".join(f"{k}={shlex.quote(str(v))}"
+                            for k, v in env.items()) + " "
+        full = self._base() + [self._target(), envs + cmd]
+        proc = subprocess.run(full, timeout=timeout,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+        return proc.returncode, proc.stdout
+
+    def run_detached(self, cmd, log_path, env=None):
+        envs = ""
+        if env:
+            envs = " ".join(f"{k}={shlex.quote(str(v))}"
+                            for k, v in env.items()) + " "
+        wrapped = (f"mkdir -p $(dirname {shlex.quote(log_path)}); "
+                   f"nohup {envs}{cmd} > {shlex.quote(log_path)} 2>&1 "
+                   f"< /dev/null &")
+        full = self._base() + [self._target(), wrapped]
+        subprocess.run(full, timeout=60, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+
+    def put(self, local_path, remote_path):
+        self.run(f"mkdir -p $(dirname {shlex.quote(remote_path)})",
+                 timeout=60)
+        full = self._base(scp=True) + [
+            local_path, f"{self._target()}:{remote_path}"]
+        subprocess.run(full, check=True, timeout=300)

@@ -1,0 +1,256 @@
+"""Cluster launcher: `up` / `down` / `exec` / `submit` over a config file.
+
+Reference parity: python/ray/scripts/scripts.py:1247 (ray up/down/attach/
+exec/submit/rsync) + autoscaler/_private/commands.py — a YAML/JSON config
+names the machines, the launcher reaches them through a CommandRunner
+(ssh for real hosts, local for this host), starts the head, joins the
+workers, and records the cluster state so later commands find it.
+
+Config (YAML or JSON):
+
+    cluster_name: demo
+    provider:
+      type: local            # local | ssh
+      head_ip: 127.0.0.1
+      worker_ips: []         # one hostd joins per entry
+    auth:                    # ssh only
+      ssh_user: ubuntu
+      ssh_private_key: ~/.ssh/key.pem
+    head_options: "--num-cpus 8"
+    worker_options: ""
+    setup_commands: []       # run on every node before start
+    python: python3          # interpreter on the nodes
+
+State lives in ~/.ray_tpu/clusters/<name>.json (head address, node ips).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import time
+from typing import Optional
+
+from ray_tpu.autoscaler.command_runner import (
+    CommandRunner,
+    LocalCommandRunner,
+    SSHCommandRunner,
+)
+
+_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+def load_config(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        cfg = json.loads(text)
+    except ValueError:
+        try:
+            import yaml
+            cfg = yaml.safe_load(text)
+        except ImportError as e:
+            raise ValueError(
+                "config is not JSON and pyyaml is unavailable") from e
+    cfg.setdefault("cluster_name", "default")
+    cfg.setdefault("provider", {"type": "local", "head_ip": "127.0.0.1"})
+    cfg.setdefault("setup_commands", [])
+    cfg.setdefault("python", "python3")
+    cfg.setdefault("env", {})   # extra env for every launched/exec'd cmd
+    return cfg
+
+
+def _runner(cfg: dict, ip: str) -> CommandRunner:
+    ptype = cfg["provider"].get("type", "local")
+    if ptype == "local":
+        return LocalCommandRunner()
+    if ptype == "ssh":
+        auth = cfg.get("auth", {})
+        return SSHCommandRunner(
+            ip, user=auth.get("ssh_user", ""),
+            key_path=auth.get("ssh_private_key"),
+            port=int(auth.get("ssh_port", 22)))
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+def _state_path(name: str) -> str:
+    return os.path.join(_STATE_DIR, f"{name}.json")
+
+
+def _save_state(cfg: dict, state: dict) -> None:
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    with open(_state_path(cfg["cluster_name"]), "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def load_state(name: str) -> Optional[dict]:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
+def _log_dir(cfg: dict) -> str:
+    return f"/tmp/ray_tpu/launcher/{cfg['cluster_name']}"
+
+
+def create_or_update_cluster(config_path: str,
+                             no_restart: bool = False) -> dict:
+    """`ray up`: setup + start head, join workers, record state."""
+    cfg = load_config(config_path)
+    prov = cfg["provider"]
+    head_ip = prov.get("head_ip", "127.0.0.1")
+    py = cfg["python"]
+    head = _runner(cfg, head_ip)
+
+    for cmd in cfg["setup_commands"]:
+        rc, out = head.run(cmd, timeout=600)
+        if rc != 0:
+            raise RuntimeError(f"setup command failed on head: {cmd}\n{out}")
+
+    state = load_state(cfg["cluster_name"]) or {}
+    gcs_address = state.get("gcs_address")
+    if gcs_address and no_restart and _alive(gcs_address):
+        print(f"head already running at {gcs_address}")
+    else:
+        port = int(prov.get("gcs_port", 0)) or 46379
+        head_opts = cfg.get("head_options", "")
+        log = os.path.join(_log_dir(cfg), "head.log")
+        head.run_detached(
+            f"{py} -m ray_tpu.scripts.cli start --head --block "
+            f"--gcs-port {port} {head_opts}", log, env=cfg["env"])
+        gcs_address = f"{head_ip}:{port}"
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if _alive(gcs_address):
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError(
+                f"head did not come up at {gcs_address}; see {log}")
+        print(f"head started: {gcs_address}")
+
+    worker_ips = list(prov.get("worker_ips", []))
+    for i, ip in enumerate(worker_ips):
+        w = _runner(cfg, ip)
+        for cmd in cfg["setup_commands"]:
+            w.run(cmd, timeout=600)
+        wlog = os.path.join(_log_dir(cfg), f"worker-{i}.log")
+        w.run_detached(
+            f"{py} -m ray_tpu.scripts.cli start --block "
+            f"--address {gcs_address} {cfg.get('worker_options', '')}",
+            wlog, env=cfg["env"])
+        print(f"worker {ip} joining {gcs_address}")
+
+    state = {"gcs_address": gcs_address, "head_ip": head_ip,
+             "worker_ips": worker_ips, "config_path": os.path.abspath(
+                 config_path)}
+    _save_state(cfg, state)
+    _wait_for_nodes(gcs_address, 1 + len(worker_ips))
+    return state
+
+
+def _alive(gcs_address: str) -> bool:
+    from ray_tpu import state as st
+    try:
+        st.list_nodes(gcs_address)
+        return True
+    except Exception:
+        return False
+
+
+def _wait_for_nodes(gcs_address: str, n: int, timeout: float = 60):
+    from ray_tpu import state as st
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            alive = [x for x in st.list_nodes(gcs_address) if x["alive"]]
+            if len(alive) >= n:
+                print(f"{len(alive)} node(s) alive")
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    print(f"warning: expected {n} nodes within {timeout}s")
+
+
+def teardown_cluster(config_path: str) -> None:
+    """`ray down`: stop every daemon, drop the state record."""
+    cfg = load_config(config_path)
+    state = load_state(cfg["cluster_name"])
+    if state and state.get("gcs_address"):
+        LocalCommandRunner()  # shutdown rides the control plane, not ssh
+        import asyncio
+
+        from ray_tpu._private.rpc import RpcClient
+
+        async def stop():
+            c = RpcClient(state["gcs_address"])
+            try:
+                await c.call("Gcs", "shutdown_cluster", {}, timeout=10)
+            except Exception:
+                pass
+            finally:
+                await c.close()
+        try:
+            asyncio.run(stop())
+        except Exception:
+            pass
+        print(f"cluster {cfg['cluster_name']} shutdown requested")
+    try:
+        os.unlink(_state_path(cfg["cluster_name"]))
+    except OSError:
+        pass
+
+
+def exec_cluster(config_path: str, cmd: str,
+                 timeout: Optional[float] = None) -> int:
+    """`ray exec`: run a shell command on the head with RAY_TPU_ADDRESS
+    pointing at the cluster."""
+    cfg = load_config(config_path)
+    state = load_state(cfg["cluster_name"])
+    if not state:
+        raise RuntimeError(f"cluster {cfg['cluster_name']} is not up")
+    head = _runner(cfg, state["head_ip"])
+    rc, out = head.run(cmd, timeout=timeout,
+                       env={**cfg["env"],
+                            "RAY_TPU_ADDRESS": state["gcs_address"]})
+    print(out, end="")
+    return rc
+
+
+def submit(config_path: str, script: str, args: Optional[list] = None,
+           timeout: Optional[float] = None) -> int:
+    """`ray submit`: ship a local script to the head and run it there."""
+    cfg = load_config(config_path)
+    state = load_state(cfg["cluster_name"])
+    if not state:
+        raise RuntimeError(f"cluster {cfg['cluster_name']} is not up")
+    head = _runner(cfg, state["head_ip"])
+    remote = f"/tmp/ray_tpu/launcher/{cfg['cluster_name']}/job_{int(time.time())}_{os.path.basename(script)}"
+    head.put(script, remote)
+    argstr = " ".join(shlex.quote(a) for a in (args or []))
+    rc, out = head.run(f"{cfg['python']} {shlex.quote(remote)} {argstr}",
+                       timeout=timeout,
+                       env={**cfg["env"],
+                            "RAY_TPU_ADDRESS": state["gcs_address"]})
+    print(out, end="")
+    return rc
+
+
+def attach_command(config_path: str) -> list:
+    """`ray attach`: argv for an interactive shell on the head (the CLI
+    exec()s it so the user lands in a live session)."""
+    cfg = load_config(config_path)
+    state = load_state(cfg["cluster_name"])
+    if not state:
+        raise RuntimeError(f"cluster {cfg['cluster_name']} is not up")
+    if cfg["provider"].get("type") == "local":
+        return [os.environ.get("SHELL", "/bin/bash")]
+    auth = cfg.get("auth", {})
+    r = SSHCommandRunner(state["head_ip"], user=auth.get("ssh_user", ""),
+                         key_path=auth.get("ssh_private_key"),
+                         port=int(auth.get("ssh_port", 22)))
+    return r._base() + ["-t", r._target()]

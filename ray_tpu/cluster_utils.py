@@ -19,7 +19,7 @@ class Cluster:
                  head_node_args: Optional[dict] = None):
         self.session_dir = node_mod.new_session_dir()
         self.group = node_mod.ProcessGroup()
-        self.gcs_address = node_mod.start_gcs(self.session_dir, self.group)
+        self.gcs_address = node_mod.start_gcs(self.session_dir, self.group, watch_parent=True)
         self.nodes: list[dict] = []
         self._connected = False
         if initialize_head:

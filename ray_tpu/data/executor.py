@@ -158,40 +158,131 @@ def execute(plan: ExecPlan, window: int = 16) -> List[Any]:
     return refs
 
 
-def iter_output_refs(plan: ExecPlan, window: int = 8) -> Iterator[Any]:
-    """Streaming: yield final block refs one at a time, launching at most
-    `window` fused tasks ahead of the consumer (backpressure)."""
-    segs = _segments(plan.stages)
-    # Barriers force materialization of everything before them; stream only
-    # the trailing fused segment.
-    refs = list(plan.input_refs)
-    trailing: Optional[Callable] = None
-    for i, (kind, seg) in enumerate(segs):
-        is_last = i == len(segs) - 1
-        if kind == "fused" and is_last:
-            trailing = seg
-            break
-        if kind == "fused":
-            refs = [_run_block.remote(r, seg) for r in refs]
-        elif kind == "actor_pool":
-            refs = _run_actor_pool(refs, seg)
-        else:
-            refs = seg.fn(refs)
-    if trailing is None:
-        yield from refs
-        return
+def _sizeof_block(block) -> int:
+    nb = getattr(block, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    try:
+        import sys
+        return sys.getsizeof(block)
+    except Exception:
+        return 1 << 20
+
+
+@ray_tpu.remote(num_cpus=0)
+def _probe_nbytes(block) -> int:
+    return _sizeof_block(block)
+
+
+class _ByteWindow:
+    """Adaptive in-flight bound: counts until the segment's first output
+    block has been size-probed, then bytes/size blocks — resource-aware
+    backpressure without a separate control plane (reference:
+    StreamingExecutor's per-operator resource budgets,
+    streaming_executor.py:41)."""
+
+    def __init__(self, window: int, window_bytes: int):
+        self.window = max(1, window)
+        self.window_bytes = window_bytes
+        self._probe = None
+        self._est: Optional[int] = None
+
+    def limit(self) -> int:
+        if self._est is None and self._probe is not None:
+            done, _ = ray_tpu.wait([self._probe], num_returns=1, timeout=0)
+            if done:
+                try:
+                    self._est = max(1, int(ray_tpu.get(done[0])))
+                except Exception:
+                    self._est = None
+                self._probe = None
+        if self._est is None:
+            return self.window
+        return max(1, min(self.window, self.window_bytes // self._est))
+
+    def observe(self, out_ref) -> None:
+        if self._est is None and self._probe is None:
+            self._probe = _probe_nbytes.remote(out_ref)
+
+
+def _stream_fused(src: Iterator[Any], fused_fn: Callable, window: int,
+                  window_bytes: int) -> Iterator[Any]:
+    """Bounded-window transform stage: at most `limit()` tasks launched
+    ahead of what downstream has taken, yielding refs in order."""
+    bw = _ByteWindow(window, window_bytes)
     in_flight: List[Any] = []
-    src = iter(refs)
+    src = iter(src)
+    exhausted = False
+    while True:
+        while not exhausted and len(in_flight) < bw.limit():
+            try:
+                r = next(src)
+            except StopIteration:
+                exhausted = True
+                break
+            task = _run_block.remote(r, fused_fn)
+            bw.observe(task)
+            in_flight.append(task)
+        if not in_flight:
+            return
+        yield in_flight.pop(0)
+
+
+def _stream_actor_pool(src: Iterator[Any], stage: OneToOne,
+                       window: int) -> Iterator[Any]:
+    """Actor-pool stage as a streaming operator: the pool lives for the
+    stage's lifetime, a bounded submission window rides on it."""
+    strat = stage.compute
+    pool = [_PoolWorker.options(num_cpus=strat.num_cpus,
+                                num_tpus=strat.num_tpus).remote(stage.fn)
+            for _ in range(max(1, strat.size))]
+    in_flight: List[Any] = []
+    i = 0
+    src = iter(src)
+    exhausted = False
     try:
         while True:
-            while len(in_flight) < window:
+            while not exhausted and len(in_flight) < window:
                 try:
                     r = next(src)
                 except StopIteration:
+                    exhausted = True
                     break
-                in_flight.append(_run_block.remote(r, trailing))
+                in_flight.append(pool[i % len(pool)].run.remote(r))
+                i += 1
             if not in_flight:
                 return
-            yield in_flight.pop(0)
+            head = in_flight.pop(0)
+            # The result must be sealed before its producing actor can
+            # die at stage teardown.
+            ray_tpu.wait([head], num_returns=1, timeout=None,
+                         fetch_local=False)
+            yield head
     finally:
-        pass
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def iter_output_refs(plan: ExecPlan, window: int = 8,
+                     window_bytes: int = 128 << 20) -> Iterator[Any]:
+    """Streaming execution across ALL operators: every fused/actor-pool
+    segment is a bounded-window generator stage pulling from the previous
+    one, so block 0 can be in the last stage while block N is still in
+    the first — no stage launches its whole input up front.  Barriers
+    (shuffle/sort) are inherent pipeline breakers and materialize the
+    stream reaching them; everything between barriers streams.  Windows
+    are byte-aware: each stage probes its first output block's size and
+    bounds in-flight work by `window_bytes` (reference:
+    streaming_executor.py:41 resource-aware backpressure)."""
+    stream: Iterator[Any] = iter(list(plan.input_refs))
+    for kind, seg in _segments(plan.stages):
+        if kind == "fused":
+            stream = _stream_fused(stream, seg, window, window_bytes)
+        elif kind == "actor_pool":
+            stream = _stream_actor_pool(stream, seg, window)
+        else:
+            stream = iter(seg.fn(list(stream)))  # barrier: materialize
+    yield from stream

@@ -19,6 +19,17 @@ REGISTRY = {
         pb.PullObjectChunkRequest, pb.PullObjectChunkReply,
         pb.PushObjectRequest, pb.PushObjectReply,
         pb.HeartbeatRequest, pb.HeartbeatReply,
+        # Task/lease/GCS control plane (incremental migration off pickled
+        # dicts; reference: common.proto TaskSpec, node_manager.proto
+        # RequestWorkerLease, gcs_service.proto KV):
+        pb.TaskArgP, pb.InlineValueP, pb.TaskSpecP,
+        pb.PushTaskRequest, pb.PushTaskReply, pb.ReturnValueP,
+        pb.RequestWorkerLeaseRequest, pb.RequestWorkerLeaseReply,
+        pb.ReturnWorkerRequest, pb.ReturnWorkerReply,
+        pb.RegisterNodeRequest, pb.RegisterNodeReply,
+        pb.KvPutRequest, pb.KvPutReply,
+        pb.KvGetRequest, pb.KvGetReply,
+        pb.KvDelRequest, pb.KvDelReply,
     )
 }
 

@@ -1,0 +1,100 @@
+"""TaskSpec <-> TaskSpecP conversion.
+
+Reference parity: src/ray/common/task/task_spec.h wraps the TaskSpec
+proto; python builds specs through TaskSpecBuilder.  Here the runtime's
+internal dataclass (protocol.py TaskSpec) converts losslessly to the
+typed wire message, which is what a non-Python submitter (C++ client,
+future native daemons) speaks.  Inline values carry a codec tag: Python
+peers write "pickle5"; a C++ producer can submit "raw" bytes args.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.protocol import pb
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    PlacementGroupID,
+    TaskID,
+)
+from ray_tpu._private.protocol import RefArg, Resources, TaskSpec, ValueArg
+
+
+def _arg_to_proto(arg) -> pb.TaskArgP:
+    p = pb.TaskArgP()
+    if isinstance(arg, RefArg):
+        p.id = arg.id_binary
+        p.owner_address = arg.owner_address
+    else:
+        p.value.data = arg.data
+        p.value.metadata = arg.metadata or b""
+        p.value.codec = "pickle5"
+    return p
+
+
+def _arg_from_proto(p: pb.TaskArgP):
+    if p.WhichOneof("arg") == "id":
+        return RefArg(p.id, p.owner_address)
+    return ValueArg(p.value.data, p.value.metadata)
+
+
+def taskspec_to_proto(spec: TaskSpec) -> pb.TaskSpecP:
+    m = pb.TaskSpecP(
+        task_id=spec.task_id.binary(),
+        job_id=spec.job_id.binary(),
+        name=spec.name,
+        fn_key=spec.fn_key,
+        num_returns=spec.num_returns,
+        max_retries=spec.max_retries,
+        retry_exceptions=spec.retry_exceptions,
+        owner_address=spec.owner_address,
+        actor_id=spec.actor_id.binary() if spec.actor_id else b"",
+        actor_creation=spec.actor_creation,
+        method_name=spec.method_name,
+        seq_no=spec.seq_no,
+        max_concurrency=spec.max_concurrency,
+        scheduling_strategy=spec.scheduling_strategy or "DEFAULT",
+        placement_group_id=(spec.placement_group.binary()
+                            if spec.placement_group else b""),
+        bundle_index=spec.bundle_index,
+    )
+    for k, v in spec.resources.to_dict().items():
+        m.resources.amounts[k] = v
+    for a in spec.args:
+        m.args.append(_arg_to_proto(a))
+    for k, v in spec.kwargs.items():
+        m.kwargs[k].CopyFrom(_arg_to_proto(v))
+    return m
+
+
+def taskspec_from_proto(m: pb.TaskSpecP) -> TaskSpec:
+    amounts = dict(m.resources.amounts)
+    res = Resources(
+        cpu=amounts.pop("CPU", 0.0),
+        tpu=amounts.pop("TPU", 0.0),
+        memory=amounts.pop("memory", 0.0),
+        custom=amounts,
+    )
+    spec = TaskSpec(
+        task_id=TaskID(m.task_id),
+        job_id=JobID(m.job_id),
+        name=m.name,
+        fn_key=m.fn_key,
+        args=[_arg_from_proto(a) for a in m.args],
+        kwargs={k: _arg_from_proto(v) for k, v in m.kwargs.items()},
+        num_returns=m.num_returns or 1,
+        resources=res,
+        max_retries=m.max_retries,
+        retry_exceptions=m.retry_exceptions,
+        owner_address=m.owner_address,
+        actor_id=ActorID(m.actor_id) if m.actor_id else None,
+        actor_creation=m.actor_creation,
+        method_name=m.method_name,
+        max_concurrency=m.max_concurrency,
+        placement_group=(PlacementGroupID(m.placement_group_id)
+                         if m.placement_group_id else None),
+        bundle_index=m.bundle_index,
+        scheduling_strategy=m.scheduling_strategy or "DEFAULT",
+    )
+    spec.seq_no = m.seq_no
+    return spec

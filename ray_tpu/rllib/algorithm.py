@@ -41,6 +41,11 @@ class AlgorithmConfig:
         # Data-parallel learner group: a jax Mesh whose "data" axis spans
         # the learner chips (reference: LearnerGroup learner_group.py:51).
         self.learner_mesh: Any = None
+        # Multi-agent (reference: algorithm_config.py .multi_agent):
+        # policies = iterable of policy ids (None = single-agent);
+        # policy_mapping_fn: agent_id -> policy_id (default: identity).
+        self.policies: Any = None
+        self.policy_mapping_fn: Any = None
         self.extra: Dict[str, Any] = {}
 
     # fluent setters ------------------------------------------------------
@@ -76,6 +81,14 @@ class AlgorithmConfig:
             self.learner_mesh = learner_mesh
         return self
 
+    def multi_agent(self, *, policies, policy_mapping_fn=None
+                    ) -> "AlgorithmConfig":
+        """Declare the policy map (reference: algorithm_config.py
+        .multi_agent(policies=..., policy_mapping_fn=...))."""
+        self.policies = list(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
         if seed is not None:
             self.seed = seed
@@ -83,7 +96,8 @@ class AlgorithmConfig:
 
     def to_dict(self) -> Dict[str, Any]:
         d = {k: v for k, v in self.__dict__.items()
-             if k not in ("algo_class", "extra", "learner_mesh")}
+             if k not in ("algo_class", "extra", "learner_mesh",
+                          "policy_mapping_fn")}
         d.update(self.extra)
         return d
 
@@ -98,12 +112,26 @@ class Algorithm:
 
     def __init__(self, config: AlgorithmConfig):
         self.config = config
-        # Probe the env spec once, locally, to size the model.
-        probe = make_vector_env(config.env, 1, seed=config.seed)
-        self.obs_dim = probe.observation_dim
-        self.num_actions = probe.num_actions
-        self.action_dim = getattr(probe, "action_dim", 0)
-        self.continuous = self.num_actions == 0 and self.action_dim > 0
+        self.multi_agent = config.policies is not None
+        if self.multi_agent:
+            from ray_tpu.rllib.multi_agent import make_multi_agent_env
+            probe = make_multi_agent_env(config.env, 1, seed=config.seed)
+            mapping = config.policy_mapping_fn or (lambda aid: aid)
+            # Per-policy model sizing from the agents each policy serves.
+            self.policy_specs: Dict[str, tuple] = {}
+            for a in probe.agent_ids:
+                pid = mapping(a)
+                self.policy_specs[pid] = (probe.observation_dims[a],
+                                          probe.num_actions_by_agent[a])
+            self.obs_dim = self.num_actions = self.action_dim = 0
+            self.continuous = False
+        else:
+            # Probe the env spec once, locally, to size the model.
+            probe = make_vector_env(config.env, 1, seed=config.seed)
+            self.obs_dim = probe.observation_dim
+            self.num_actions = probe.num_actions
+            self.action_dim = getattr(probe, "action_dim", 0)
+            self.continuous = self.num_actions == 0 and self.action_dim > 0
         self.iteration = 0
         self.total_env_steps = 0
         self._episode_returns: collections.deque = collections.deque(

@@ -65,9 +65,10 @@ class _VTraceLearner:
         apply = self.apply
 
         def loss(params, batch):
-            obs = batch[SampleBatch.OBS]              # [T, B, D]
+            obs = batch[SampleBatch.OBS]      # [T, B, D] or [T, B, H, W, C]
             T, B = obs.shape[:2]
-            logits, values = apply(params, obs.reshape(T * B, -1))
+            logits, values = apply(
+                params, obs.reshape((T * B,) + obs.shape[2:]))
             logits = logits.reshape(T, B, -1)
             values = values.reshape(T, B)
             _, bootstrap_value = apply(params, batch["bootstrap_obs"])

@@ -36,12 +36,47 @@ class ActorCritic(nn.Module):
         return logits, jnp.squeeze(value, axis=-1)
 
 
-def make_model(obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)):
-    """Returns (init_params(rng), apply(params, obs) -> (logits, value))."""
+class ConvActorCritic(nn.Module):
+    """Nature-CNN actor-critic for image observations (the reference's
+    ModelCatalog vision_net / Atari default: conv 32x8s4, 64x4s2, 64x3s1,
+    dense 512 — one trunk, two heads).  Inputs are [B, H, W, C] in
+    [0, 255]; scaling to [0, 1] happens inside so rollout buffers can
+    stay uint8 (4x less memory/copy than float32)."""
+
+    num_actions: int
+    dense: int = 512
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = obs.astype(jnp.float32) / 255.0
+        x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4))(x))
+        x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2))(x))
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1))(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense)(x))
+        logits = nn.Dense(self.num_actions,
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        value = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0))(x)
+        return logits, jnp.squeeze(value, axis=-1)
+
+
+def make_model(obs_dim, num_actions: int, hidden: Sequence[int] = (64, 64)):
+    """Returns (init_params(rng), apply(params, obs) -> (logits, value)).
+
+    `obs_dim` int = MLP on flat observations; a shape tuple (H, W, C) =
+    Nature-CNN on images (reference: ModelCatalog dispatch by obs space)."""
+    if isinstance(obs_dim, (tuple, list)) and len(obs_dim) > 1:
+        model = ConvActorCritic(num_actions=num_actions)
+        shape = tuple(obs_dim)
+
+        def init_params(rng: jax.Array):
+            return model.init(rng, jnp.zeros((1,) + shape, jnp.float32))
+
+        return init_params, model.apply
     model = ActorCritic(num_actions=num_actions, hidden=tuple(hidden))
 
     def init_params(rng: jax.Array):
-        dummy = jnp.zeros((1, obs_dim), jnp.float32)
+        dummy = jnp.zeros((1, int(obs_dim)), jnp.float32)
         return model.init(rng, dummy)
 
     return init_params, model.apply

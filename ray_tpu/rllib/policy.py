@@ -91,7 +91,12 @@ class JaxPolicy:
                                    np.ndarray]:
         """Returns (actions, logp, vf_preds, logits) as numpy."""
         with self._ctx():
-            obs = jnp.asarray(obs, jnp.float32)
+            # uint8 image obs ship as bytes (the conv model scales them
+            # on-device); everything else is float32.
+            if getattr(obs, "dtype", None) == np.uint8:
+                obs = jnp.asarray(obs)
+            else:
+                obs = jnp.asarray(obs, jnp.float32)
             if explore:
                 self._rng, sub = jax.random.split(self._rng)
                 a, logp, v, logits = self._sample(self.params, obs, sub)

@@ -33,6 +33,26 @@ class PPOConfig(AlgorithmConfig):
 class PPO(Algorithm):
     def setup(self) -> None:
         cfg = self.config
+        if self.multi_agent:
+            from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+            self.workers = WorkerSet(
+                num_workers=cfg.num_rollout_workers,
+                num_cpus_per_worker=cfg.num_cpus_per_worker,
+                worker_cls=MultiAgentRolloutWorker,
+                worker_kwargs=dict(
+                    env=cfg.env, num_envs=cfg.num_envs_per_worker,
+                    rollout_fragment_length=cfg.rollout_fragment_length,
+                    gamma=cfg.gamma, lam=cfg.lambda_,
+                    hidden=cfg.model_hidden, seed=cfg.seed,
+                    policies=dict.fromkeys(cfg.policies),
+                    policy_mapping_fn=cfg.policy_mapping_fn))
+            # One learner per policy (reference: Learner per module in the
+            # MultiRLModule, learner_group.py).
+            self.learners = {pid: self._make_learner(spec)
+                             for pid, spec in self.policy_specs.items()}
+            self.workers.sync_weights(
+                {pid: ln.get_weights() for pid, ln in self.learners.items()})
+            return
         self.workers = WorkerSet(
             num_workers=cfg.num_rollout_workers,
             num_cpus_per_worker=cfg.num_cpus_per_worker,
@@ -44,12 +64,15 @@ class PPO(Algorithm):
         self.learner = self._make_learner()
         self.workers.sync_weights(self.learner.get_weights())
 
-    def _make_learner(self) -> JaxLearner:
+    def _make_learner(self, spec=None) -> JaxLearner:
         """Overridable learner factory (A2C swaps the loss/config here
-        without re-running worker construction or double weight syncs)."""
+        without re-running worker construction or double weight syncs).
+        `spec` = (obs_dim, num_actions) for a multi-agent policy."""
         cfg = self.config
+        obs_dim, num_actions = spec if spec else (self.obs_dim,
+                                                  self.num_actions)
         return JaxLearner(
-            self.obs_dim, self.num_actions, action_dim=self.action_dim,
+            obs_dim, num_actions, action_dim=self.action_dim,
             loss_fn=(ppo_loss_continuous if self.continuous else ppo_loss),
             config={
                 "lr": cfg.lr, "grad_clip": cfg.grad_clip,
@@ -73,8 +96,35 @@ class PPO(Algorithm):
             batches.extend(bs)
             all_metrics.extend(ms)
             rows += sum(b.count for b in bs)
-        train_batch = SampleBatch.concat_samples(batches)
         episodes = self._record_metrics(all_metrics)
+
+        if self.multi_agent:
+            from ray_tpu.rllib.multi_agent import MultiAgentBatch
+            train_batch = MultiAgentBatch.concat_samples(batches)
+            # 2. Per-policy minibatch SGD (each one jitted XLA program).
+            learner_metrics = {}
+            for pid, sub in train_batch.policy_batches.items():
+                for k, v in self.learners[pid].update(sub).items():
+                    learner_metrics[f"{pid}/{k}"] = v
+            # 3. Broadcast the whole policy map in one put.
+            self.workers.sync_weights(
+                {pid: ln.get_weights() for pid, ln in self.learners.items()})
+            # Per-policy improvement signal for multi-agent gates.
+            per_policy_returns: Dict[str, list] = {}
+            mapping = self.config.policy_mapping_fn or (lambda a: a)
+            for m in all_metrics:
+                for aid, rs in m.get("per_agent_returns", {}).items():
+                    per_policy_returns.setdefault(mapping(aid),
+                                                  []).extend(rs)
+            import numpy as _np
+            extra = {f"policy_reward_mean/{pid}": float(_np.mean(rs))
+                     for pid, rs in per_policy_returns.items() if rs}
+            return {"sampled_rows": train_batch.count,
+                    "episodes_this_iter": episodes, **extra,
+                    **{f"learner/{k}": v
+                       for k, v in learner_metrics.items()}}
+
+        train_batch = SampleBatch.concat_samples(batches)
 
         # 2. Minibatch SGD — one jitted XLA program.
         learner_metrics = self.learner.update(train_batch)
@@ -87,9 +137,19 @@ class PPO(Algorithm):
                 **{f"learner/{k}": v for k, v in learner_metrics.items()}}
 
     def save_to_dict(self) -> Dict[str, Any]:
+        if self.multi_agent:
+            return {"learner_state": {pid: ln.get_state()
+                                      for pid, ln in self.learners.items()},
+                    "config": self.config.to_dict()}
         return {"learner_state": self.learner.get_state(),
                 "config": self.config.to_dict()}
 
     def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        if self.multi_agent:
+            for pid, st in state["learner_state"].items():
+                self.learners[pid].set_state(st)
+            self.workers.sync_weights(
+                {pid: ln.get_weights() for pid, ln in self.learners.items()})
+            return
         self.learner.set_state(state["learner_state"])
         self.workers.sync_weights(self.learner.get_weights())

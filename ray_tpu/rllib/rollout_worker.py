@@ -51,7 +51,10 @@ class RolloutWorker:
                  epsilon_schedule=None,
                  policy_kind: str = "actor_critic",
                  exploration_noise: float = 0.1,
-                 random_warmup_steps: int = 0):
+                 random_warmup_steps: int = 0,
+                 exploration=None,
+                 obs_connector=None,
+                 action_connector=None):
         # In a remote worker process, force the whole jax platform to CPU
         # before the first jax use: rollout actors must not even initialize
         # the TPU runtime (one chip, many actor processes).  In the driver
@@ -75,9 +78,16 @@ class RolloutWorker:
                 "epsilon-greedy exploration requires a discrete env")
         action_low = getattr(self.env, "action_low", -1.0)
         action_high = getattr(self.env, "action_high", 1.0)
+        # An obs connector can reshape what the policy sees; size the
+        # model from a transformed sample, not the raw env spec.
+        policy_obs_dim = self.env.observation_dim
+        if obs_connector is not None:
+            probe = obs_connector(self.env.reset_all(seed))
+            policy_obs_dim = (probe.shape[1] if probe.ndim == 2
+                              else tuple(probe.shape[1:]))
         if policy_kind == "actor_critic":
             self.policy = JaxPolicy(
-                self.env.observation_dim, self.env.num_actions, hidden,
+                policy_obs_dim, self.env.num_actions, hidden,
                 seed=seed, action_dim=action_dim,
                 action_low=action_low, action_high=action_high)
         elif policy_kind == "squashed_gaussian":      # SAC behavior policy
@@ -105,6 +115,17 @@ class RolloutWorker:
         # (initial, final, decay_steps) linear schedule on env steps.
         self._epsilon_schedule = epsilon_schedule
         self._np_rng = np.random.default_rng(seed + 99)
+        # Pluggable exploration + connector pipelines (reference:
+        # rllib/utils/exploration/ and rllib/connectors/): the obs
+        # connector transforms observations INTO the policy (recorded
+        # batches hold the transformed obs, as the learner must see what
+        # the policy saw); the action connector transforms actions OUT to
+        # the env only — training stores the raw policy actions.
+        self._exploration = exploration
+        self._obs_connector = obs_connector
+        self._action_connector = action_connector
+        if self._obs_connector is not None:
+            self.obs = self._obs_connector(self.obs)
 
     # -- weights -----------------------------------------------------------
     def get_weights(self):
@@ -123,7 +144,10 @@ class RolloutWorker:
         logits (IMPALA/V-trace path).
         """
         T, B = self.fragment_length, self.num_envs
-        obs_buf = np.empty((T, B, self.env.observation_dim), np.float32)
+        # Image envs declare a shape tuple + uint8 observations; buffers
+        # follow the (possibly connector-transformed) obs the policy
+        # actually sees, at its dtype, so pixels move at 1 byte each.
+        obs_buf = np.empty((T, B) + self.obs.shape[1:], self.obs.dtype)
         if self.continuous:
             adim = self.env.action_dim
             act_buf = np.empty((T, B, adim), np.float32)
@@ -156,12 +180,20 @@ class RolloutWorker:
                 actions = self._np_rng.uniform(
                     self._action_low, self._action_high,
                     size=(B, self.env.action_dim)).astype(np.float32)
+            if self._exploration is not None:
+                actions = self._exploration.apply(
+                    actions, self._total_steps + t * B, self._np_rng)
             obs_buf[t] = obs
             act_buf[t] = actions
             logp_buf[t] = logp
             vf_buf[t] = vf
             logits_buf[t] = logits
-            obs, rew, term, trunc = self.env.step(actions)
+            env_actions = (self._action_connector(actions)
+                           if self._action_connector is not None
+                           else actions)
+            obs, rew, term, trunc = self.env.step(env_actions)
+            if self._obs_connector is not None:
+                obs = self._obs_connector(obs)
             rew_buf[t] = rew
             term_buf[t] = term
             trunc_buf[t] = trunc
@@ -211,7 +243,11 @@ class RolloutWorker:
         steps = 0
         while len(returns) < num_episodes and steps < max_steps:
             actions, _, _, _ = self.policy.compute_actions(obs, explore=False)
+            if self._action_connector is not None:
+                actions = self._action_connector(actions)
             obs, _, _, _ = self.env.step(actions)
+            if self._obs_connector is not None:
+                obs = self._obs_connector(obs)
             steps += 1
             rets, _ = self.env.drain_episode_metrics()
             returns.extend(rets)

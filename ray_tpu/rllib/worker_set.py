@@ -22,7 +22,8 @@ class WorkerSet:
     def __init__(self, *, num_workers: int, worker_kwargs: Dict[str, Any],
                  num_cpus_per_worker: float = 1,
                  restart_failed_workers: bool = True,
-                 max_failed_rounds: int = 3):
+                 max_failed_rounds: int = 3,
+                 worker_cls: type = RolloutWorker):
         # Ship registered env creators by value: remote worker processes
         # have a fresh registry, so a NAME would resolve there to whatever
         # that process's registry holds (or nothing) — shipping the
@@ -30,22 +31,26 @@ class WorkerSet:
         # a built-in name was re-registered.  (Reference ships creators
         # via tune registry + GCS KV.)
         from ray_tpu.rllib import env as env_mod
+        from ray_tpu.rllib import multi_agent as ma_mod
         env = worker_kwargs.get("env")
         if isinstance(env, str) and env in env_mod._ENV_REGISTRY:
             worker_kwargs = dict(worker_kwargs,
                                  env=env_mod._ENV_REGISTRY[env])
+        elif isinstance(env, str) and env in ma_mod._MA_REGISTRY:
+            worker_kwargs = dict(worker_kwargs,
+                                 env=ma_mod._MA_REGISTRY[env])
         self._worker_kwargs = worker_kwargs
         self._max_failed_rounds = max_failed_rounds
         self._consecutive_failed_rounds = 0
         self._num_cpus = num_cpus_per_worker
         self._restart = restart_failed_workers
         self._remote_cls = ray_tpu.remote(num_cpus=num_cpus_per_worker)(
-            RolloutWorker)
+            worker_cls)
         self._workers: List[Any] = [
             self._make_worker(i) for i in range(num_workers)]
         # The local worker evaluates and holds canonical weights alongside
         # the learner (reference: WorkerSet.local_worker()).
-        self.local_worker = RolloutWorker(**worker_kwargs)
+        self.local_worker = worker_cls(**worker_kwargs)
 
     def _make_worker(self, index: int):
         kwargs = dict(self._worker_kwargs)

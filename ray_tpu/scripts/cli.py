@@ -39,7 +39,8 @@ def cmd_start(args) -> int:
     if args.head:
         session_dir = node_mod.new_session_dir()
         group = node_mod.ProcessGroup()
-        gcs_address = node_mod.start_gcs(session_dir, group)
+        gcs_address = node_mod.start_gcs(session_dir, group,
+                                         port=args.gcs_port)
         node_mod.start_hostd(
             gcs_address, session_dir, group, num_cpus=args.num_cpus,
             num_tpus=args.num_tpus, head=True,
@@ -323,6 +324,38 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    from ray_tpu.autoscaler import launcher
+    state = launcher.create_or_update_cluster(
+        args.config, no_restart=args.no_restart)
+    print(f"cluster up; connect with "
+          f"ray_tpu.init(address={state['gcs_address']!r})")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler import launcher
+    launcher.teardown_cluster(args.config)
+    return 0
+
+
+def cmd_exec(args) -> int:
+    from ray_tpu.autoscaler import launcher
+    return launcher.exec_cluster(args.config, args.command)
+
+
+def cmd_submit(args) -> int:
+    from ray_tpu.autoscaler import launcher
+    return launcher.submit(args.config, args.script, args.script_args)
+
+
+def cmd_attach(args) -> int:
+    import os as _os
+    from ray_tpu.autoscaler import launcher
+    argv = launcher.attach_command(args.config)
+    _os.execvp(argv[0], argv)  # replaces this process
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -333,6 +366,8 @@ def main(argv=None) -> int:
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=None)
     sp.add_argument("--object-store-memory", type=int, default=256 << 20)
+    sp.add_argument("--gcs-port", type=int, default=0,
+                    help="fixed GCS port for --head (0 = ephemeral)")
     sp.add_argument("--block", action="store_true",
                     help="stay attached; ctrl-c tears the node down")
     sp.set_defaults(fn=cmd_start)
@@ -391,6 +426,28 @@ def main(argv=None) -> int:
     q.add_argument("--address", required=True)
     q.add_argument("--json", action="store_true")
     q.set_defaults(fn=cmd_list)
+
+    # Cluster launcher (reference: ray up/down/exec/submit/attach,
+    # scripts.py:1247) over the CommandRunner plane.
+    q = sub.add_parser("up", help="start a cluster from a config file")
+    q.add_argument("config")
+    q.add_argument("--no-restart", action="store_true")
+    q.set_defaults(fn=cmd_up)
+    q = sub.add_parser("down", help="tear a launched cluster down")
+    q.add_argument("config")
+    q.set_defaults(fn=cmd_down)
+    q = sub.add_parser("exec", help="run a command on the cluster head")
+    q.add_argument("config")
+    q.add_argument("command")
+    q.set_defaults(fn=cmd_exec)
+    q = sub.add_parser("submit", help="ship a script to the head and run it")
+    q.add_argument("config")
+    q.add_argument("script")
+    q.add_argument("script_args", nargs="*")
+    q.set_defaults(fn=cmd_submit)
+    q = sub.add_parser("attach", help="interactive shell on the head")
+    q.add_argument("config")
+    q.set_defaults(fn=cmd_attach)
 
     args = p.parse_args(argv)
     return args.fn(args)

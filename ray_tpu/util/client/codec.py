@@ -1,0 +1,66 @@
+"""Deep ref/handle translation for client mode.
+
+Reference parity: python/ray/util/client/ARCHITECTURE.md — the client's
+serializer walks the WHOLE object graph, converting ObjectRefs and actor
+handles wherever they appear (inside user dataclasses, closures, numpy
+object arrays...), not just in top-level containers.  Implemented with
+pickle's persistent-id machinery: a custom CloudPickler emits a tagged
+persistent id for every ref/handle it meets at any depth; the peer's
+Unpickler rebuilds the native object via a callback.  This replaces the
+r3 limitation where only plain list/dict/tuple nesting translated.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Callable, Optional, Tuple
+
+import cloudpickle
+
+REF = "__ray_tpu_ref__"
+ACTOR = "__ray_tpu_actor__"
+
+
+def dumps(obj, on_ref: Optional[Callable] = None,
+          on_actor: Optional[Callable] = None) -> bytes:
+    """Serialize, converting refs/handles at any nesting depth into
+    tagged persistent ids: (REF, id_bytes, owner) / (ACTOR, id_bytes).
+    `on_ref(ref)` / `on_actor(handle)` observe each converted object —
+    the server pins them into the session so the peer's ids stay live."""
+    from ray_tpu.api import ActorHandle
+    from ray_tpu.object_ref import ObjectRef
+
+    buf = io.BytesIO()
+
+    class _P(cloudpickle.CloudPickler):
+        def persistent_id(self, o):
+            if isinstance(o, ObjectRef):
+                if on_ref is not None:
+                    on_ref(o)
+                return (REF, o.id.binary(), o.owner_address or "")
+            if isinstance(o, ActorHandle):
+                if on_actor is not None:
+                    on_actor(o)
+                return (ACTOR, o._actor_id.binary())
+            return None
+
+    _P(buf, protocol=5).dump(obj)
+    return buf.getvalue()
+
+
+def loads(blob: bytes, *,
+          make_ref: Callable[[bytes, str], object],
+          make_actor: Callable[[bytes], object]):
+    """Deserialize, rebuilding refs/handles through the callbacks."""
+
+    class _U(pickle.Unpickler):
+        def persistent_load(self, pid: Tuple):
+            tag = pid[0]
+            if tag == REF:
+                return make_ref(pid[1], pid[2])
+            if tag == ACTOR:
+                return make_actor(pid[1])
+            raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+
+    return _U(io.BytesIO(blob)).load()

@@ -78,30 +78,35 @@ class ClientServer:
         return sess
 
     def _decode_args(self, session: _Session, blob: bytes):
-        """Client args arrive cloudpickled with ObjectRef/ActorHandle
-        placeholders; rebuild the server-side objects."""
-        from ray_tpu import api
+        """Client args arrive with refs/handles as pickle persistent ids
+        at ANY depth (codec.py); rebuild the server-side objects."""
+        from ray_tpu.util.client import codec
 
-        args, kwargs = cloudpickle.loads(blob)
+        def make_actor(actor_id: bytes):
+            handle = session.actors.get(actor_id)
+            if handle is None:
+                handle = session.actors[actor_id] = \
+                    self._foreign_handle(actor_id)
+            return handle
 
-        def fix(v):
-            if isinstance(v, dict):
-                if "__client_ref__" in v:
-                    return self._ref_fallback(session, v["__client_ref__"],
-                                              v.get("owner", ""))
-                if "__client_actor__" in v:
-                    handle = session.actors.get(v["__client_actor__"])
-                    if handle is None:
-                        handle = session.actors[v["__client_actor__"]] = \
-                            self._foreign_handle(v["__client_actor__"])
-                    return handle
-                return {k: fix(x) for k, x in v.items()}
-            if isinstance(v, (list, tuple)):
-                return type(v)(fix(x) for x in v)
-            return v
+        return codec.loads(
+            blob,
+            make_ref=lambda i, o: self._ref_fallback(session, i, o),
+            make_actor=make_actor)
 
-        return tuple(fix(a) for a in args), {k: fix(v)
-                                             for k, v in kwargs.items()}
+    def _encode_values(self, session: _Session, values) -> bytes:
+        """Results can CONTAIN refs/handles; they convert to persistent
+        ids AND pin into the session so the ids the client holds stay
+        resolvable until released."""
+        from ray_tpu.util.client import codec
+
+        def on_ref(ref):
+            session.refs.setdefault(ref.id.binary(), ref)
+
+        def on_actor(handle):
+            session.actors.setdefault(handle._actor_id.binary(), handle)
+
+        return codec.dumps(values, on_ref=on_ref, on_actor=on_actor)
 
     def _track(self, session: _Session, refs) -> list:
         out = []
@@ -131,7 +136,7 @@ class ClientServer:
                 for i, o in zip(req["ids"], owners)]
         try:
             values = ray_tpu.get(refs, timeout=req.get("timeout"))
-            return {"values": cloudpickle.dumps(values)}
+            return {"values": self._encode_values(session, values)}
         except BaseException as e:  # noqa: BLE001 - ship to client
             return {"error": cloudpickle.dumps(e)}
 

@@ -86,36 +86,33 @@ class ClientWorker:
 
     @staticmethod
     def _encode_args(args, kwargs) -> bytes:
-        """Known limitation (vs the reference client's deep serializer):
-        refs/handles are translated inside plain containers only — a ref
-        buried in a user object pickles with the client-server address as
-        owner and will not resolve cluster-side."""
+        """Deep serializer (reference: client ARCHITECTURE.md): refs and
+        actor handles convert at ANY nesting depth — inside user objects,
+        dataclasses, closures — via pickle persistent ids (codec.py)."""
+        from ray_tpu.util.client import codec
+        return codec.dumps((tuple(args), dict(kwargs)))
+
+    def _decode_values(self, blob: bytes):
+        """Results may CONTAIN refs/handles (e.g. a task returning a dict
+        of refs): rebuild them as client-side objects that route through
+        this server connection."""
         from ray_tpu.api import ActorHandle
-
-        def enc(v):
-            if isinstance(v, ObjectRef):
-                return {"__client_ref__": v.id.binary(),
-                        "owner": v.owner_address or ""}
-            if isinstance(v, ActorHandle):
-                return {"__client_actor__": v._actor_id.binary()}
-            if isinstance(v, dict):
-                return {k: enc(x) for k, x in v.items()}
-            if isinstance(v, (list, tuple)):
-                return type(v)(enc(x) for x in v)
-            return v
-
-        return cloudpickle.dumps(
-            (tuple(enc(a) for a in args),
-             {k: enc(v) for k, v in kwargs.items()}))
+        from ray_tpu.util.client import codec
+        return codec.loads(
+            blob,
+            make_ref=lambda id_b, owner: self._mkref(id_b, owner),
+            make_actor=lambda id_b: ActorHandle(
+                ActorID(id_b), "remote", None))
 
     @staticmethod
     def _fn_blob(fn) -> tuple:
         blob = cloudpickle.dumps(fn)
         return blob, hashlib.sha1(blob).hexdigest().encode()
 
-    def _mkref(self, id_binary: bytes) -> ObjectRef:
+    def _mkref(self, id_binary: bytes, owner: str = "") -> ObjectRef:
         import weakref
-        ref = ObjectRef(ObjectID(id_binary), self.address, _register=False)
+        ref = ObjectRef(ObjectID(id_binary), owner or self.address,
+                        _register=False)
         # Server-side pins release when the CLIENT ref is GC'd: ids batch
         # into the next RPC (reference: client refs release server state).
         weakref.finalize(ref, self._queue_release, id_binary)
@@ -140,7 +137,7 @@ class ClientWorker:
             timeout=(timeout + 30) if timeout else None)
         if "error" in reply:
             raise cloudpickle.loads(reply["error"])
-        values = cloudpickle.loads(reply["values"])
+        values = self._decode_values(reply["values"])
         return values[0] if single else values
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):

@@ -1,0 +1,62 @@
+"""IMPALA throughput at Atari frame shapes (reference: the role of
+rllib/tuned_examples/ppo/atari-ppo.yaml — this image has no gym/ALE, so
+the synthetic [84,84,4] env exercises the identical pixel pipeline:
+uint8 frames -> rollout actors -> object store -> async learner thread
+-> Nature-CNN V-trace SGD).  Gates on env-steps/sec, not reward.
+
+Writes PIXEL_BENCH.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu  # noqa: E402
+from ray_tpu.rllib.impala import IMPALAConfig  # noqa: E402
+
+
+def main():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 << 20)
+    cfg = (IMPALAConfig()
+           .environment("SyntheticPixel-v0")
+           .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                     rollout_fragment_length=16)
+           .training(train_batch_size=0)
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        algo.train()  # warmup: jit compiles, workers spawn
+        t0 = time.perf_counter()
+        steps0 = algo.total_env_steps
+        updates0 = algo.learner.num_updates
+        while time.perf_counter() - t0 < 20.0:
+            algo.train()
+        dt = time.perf_counter() - t0
+        steps = algo.total_env_steps - steps0
+        updates = algo.learner.num_updates - updates0
+        result = {
+            "env": "SyntheticPixel-v0 [84,84,4] uint8",
+            "env_steps_per_s": round(steps / dt, 1),
+            "learner_updates_per_s": round(updates / dt, 2),
+            "window_s": round(dt, 1),
+            "rollout_workers": 2,
+            "envs_per_worker": 8,
+        }
+        print(json.dumps(result))
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "PIXEL_BENCH.json")
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {out}")
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Scale-envelope benchmark (reference: release/benchmarks/README.md:9-31
+— the reference's published envelope is 10k simultaneous tasks / 1M queued
+tasks / 1k actors / multi-node object broadcast; this exercises the same
+shapes against this runtime and records SCALE.json).
+
+Sections
+  queued_tasks          submit a deep backlog of trivial tasks, drain it
+  concurrent_tasks_10k  10k no-op tasks in flight at once
+  actor_storm           create as many actors as the host's RAM allows
+                        (target 1k), ping them all, tear down
+  broadcast_1gib        a large object written once, pulled by every other
+                        node of a 4-hostd in-process cluster via the
+                        native shm-to-shm plane
+
+Sizes auto-scale down on small hosts (MemAvailable) — the applied size is
+recorded in SCALE.json so a degraded run is never mistaken for the full
+envelope.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu  # noqa: E402
+
+
+def mem_available_bytes() -> int:
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemAvailable:"):
+                return int(line.split()[1]) * 1024
+    return 2 << 30
+
+
+def bench_queued_tasks(results, n_queued: int):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(2000)])  # warm pool
+    time.sleep(1.0)
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n_queued)]
+    submit_s = time.perf_counter() - t0
+    ray_tpu.get(refs)
+    total_s = time.perf_counter() - t0
+    results["queued_tasks"] = {
+        "n": n_queued,
+        "submit_rate_per_s": round(n_queued / submit_s, 1),
+        "drain_rate_per_s": round(n_queued / total_s, 1),
+        "total_s": round(total_s, 2),
+    }
+    print(f"queued_tasks: {n_queued} queued, submit "
+          f"{n_queued/submit_s:,.0f}/s, end-to-end {n_queued/total_s:,.0f}/s")
+
+
+def bench_concurrent_tasks(results, n: int):
+    @ray_tpu.remote(num_cpus=0.25)
+    def hold():
+        time.sleep(0.01)
+        return None
+
+    t0 = time.perf_counter()
+    refs = [hold.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    results["concurrent_tasks_10k"] = {
+        "n": n, "total_s": round(dt, 2),
+        "rate_per_s": round(n / dt, 1),
+    }
+    print(f"concurrent_tasks: {n} x 10ms tasks in {dt:.2f}s "
+          f"({n/dt:,.0f}/s)")
+
+
+def bench_actor_storm(results, target: int):
+    # Each actor is one forked worker process; budget RAM for it.
+    budget = int(mem_available_bytes() * 0.5 // (30 << 20))
+    n = max(50, min(target, budget))
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return os.getpid()
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    create_s = time.perf_counter() - t0
+    alive = len(set(pids))
+    t1 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    ping_s = time.perf_counter() - t1
+    for a in actors:
+        ray_tpu.kill(a)
+    results["actor_storm"] = {
+        "n": n, "target": target, "distinct_workers": alive,
+        "create_and_first_ping_s": round(create_s, 2),
+        "create_rate_per_s": round(n / create_s, 1),
+        "steady_ping_rate_per_s": round(n / ping_s, 1),
+    }
+    print(f"actor_storm: {n} actors (target {target}) created+pinged in "
+          f"{create_s:.2f}s ({n/create_s:,.0f}/s), steady ping "
+          f"{n/ping_s:,.0f}/s")
+
+
+def bench_broadcast(results, size: int):
+    """1 GiB-class object written on the driver's node, pulled by every
+    other node store-to-store (native TCP plane)."""
+    import numpy as np
+    from ray_tpu.cluster_utils import Cluster
+
+    per_node = int(size * 1.5)
+    budget = int(mem_available_bytes() * 0.6)
+    nodes = 4
+    while nodes * per_node > budget and size > (64 << 20):
+        size //= 2
+        per_node = int(size * 1.5)
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 2, "object_store_memory": per_node})
+    for _ in range(nodes - 1):
+        cluster.add_node(num_cpus=2, object_store_memory=per_node)
+    cluster.connect()
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def fetch(ref_box, expect):
+            arr = ray_tpu.get(ref_box[0])
+            assert arr.nbytes == expect
+            return float(arr[0]) + float(arr[-1])
+
+        data = np.ones(size // 8, np.float64)
+        ref = ray_tpu.put(data)
+        t0 = time.perf_counter()
+        # SPREAD forces distinct nodes so every pull crosses the plane.
+        outs = ray_tpu.get([
+            fetch.options(scheduling_strategy="SPREAD").remote(
+                (ref,), data.nbytes)
+            for _ in range(nodes - 1)], timeout=600)
+        dt = time.perf_counter() - t0
+        assert all(o == 2.0 for o in outs)
+        gib = data.nbytes * (nodes - 1) / (1 << 30)
+        results["broadcast_1gib"] = {
+            "object_bytes": data.nbytes, "nodes": nodes,
+            "total_moved_gib": round(gib, 3), "total_s": round(dt, 2),
+            "gib_per_s": round(gib / dt, 3),
+        }
+        print(f"broadcast: {data.nbytes/(1<<30):.2f} GiB object to "
+              f"{nodes-1} nodes in {dt:.2f}s ({gib/dt:.2f} GiB/s moved)")
+    finally:
+        cluster.shutdown()
+
+
+def main():
+    results: dict = {"host": {
+        "cpus": os.cpu_count(),
+        "mem_available_gib": round(mem_available_bytes() / (1 << 30), 2),
+    }}
+    # Single-node sections share one local cluster; the worker-pool cap
+    # must clear the actor-storm target (default is 4x CPUs).
+    ray_tpu.init(num_cpus=8, object_store_memory=256 << 20,
+                 _system_config={"max_workers_per_node": 1200})
+    bench_queued_tasks(results, n_queued=100_000)
+    bench_concurrent_tasks(results, n=10_000)
+    bench_actor_storm(results, target=1000)
+    ray_tpu.shutdown()
+    time.sleep(2)
+    bench_broadcast(results, size=1 << 30)
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SCALE.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # Learning-regression gates (minutes each on a small host) carry
+    # @pytest.mark.slow; `-m "not slow"` is the fast iteration suite,
+    # a plain `pytest tests/` still runs everything (reference: test
+    # size tags, SURVEY §4).
+    config.addinivalue_line(
+        "markers", "slow: long learning-gate tests (deselect with "
+        "-m 'not slow')")
+
+
 @pytest.fixture
 def tmp_store(tmp_path):
     from ray_tpu._private.object_store import ObjectStore

@@ -1,0 +1,85 @@
+"""C++ embedding API test (reference: the role of cpp/ — native programs
+interoperating with the cluster; see cpp/include/ray_tpu/store_client.hpp
+for the documented scope decision): a C++ program attaches to a
+Python-created store, writes an object, and Python reads it zero-copy —
+and vice versa."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cpp_binary(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("cppbin") / "roundtrip")
+    src = str(tmp_path_factory.mktemp("cppsrc") / "roundtrip.cc")
+    with open(src, "w") as f:
+        f.write(r'''
+#include <cstdio>
+#include <cstring>
+#include <ray_tpu/store_client.hpp>
+
+// argv: <store path> <28-byte hex id to read> <28-byte hex id to write>
+static ray_tpu::ObjectId from_hex(const char* hx) {
+  std::string b;
+  for (int i = 0; i < ray_tpu::kObjectIdSize; i++) {
+    unsigned v;
+    sscanf(hx + 2 * i, "%2x", &v);
+    b.push_back(char(v));
+  }
+  return ray_tpu::ObjectId::from_binary(b);
+}
+
+int main(int argc, char** argv) {
+  auto store = ray_tpu::Store::attach(argv[1]);
+  // Read the object Python wrote; double every byte into a new object.
+  auto buf = store.get(from_hex(argv[2]), 5000);
+  auto out_id = from_hex(argv[3]);
+  uint8_t* dst = store.create(out_id, buf.size());
+  for (uint64_t i = 0; i < buf.size(); i++)
+    dst[i] = uint8_t(buf.data()[i] * 2);
+  store.seal(out_id);
+  std::printf("ok %llu\n", (unsigned long long)buf.size());
+  return 0;
+}
+''')
+    proc = subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-I", os.path.join(REPO, "cpp/include"),
+         src, os.path.join(REPO, "ray_tpu/_native/objstore.cc"),
+         "-pthread", "-o", out],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    return out
+
+
+def test_cpp_store_roundtrip(cpp_binary, tmp_path):
+    import numpy as np
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectStore
+
+    store = ObjectStore.create(str(tmp_path / "store.shm"), 32 << 20)
+    try:
+        in_id = ObjectID.from_random()
+        out_id = ObjectID.from_random()
+        payload = np.arange(100, dtype=np.uint8)
+        store.put_bytes(in_id, payload.tobytes())
+
+        proc = subprocess.run(
+            [cpp_binary, store.path, in_id.hex(), out_id.hex()],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout.startswith("ok 100")
+
+        buf = store.get(out_id, timeout_ms=5000)
+        try:
+            got = np.frombuffer(bytes(buf.data), np.uint8)
+        finally:
+            buf.release()
+        np.testing.assert_array_equal(got, (payload * 2).astype(np.uint8))
+    finally:
+        store.close()

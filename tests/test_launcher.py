@@ -1,0 +1,90 @@
+"""Cluster launcher tests (reference: ray up/down/exec/submit,
+scripts.py:1247 + autoscaler/_private/command_runner.py) — local provider
+end to end: up starts a real head + a joined worker node, exec/submit run
+against it, down stops everything."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture
+def config_path(tmp_path, monkeypatch):
+    # Keep launcher state out of the real home dir.
+    monkeypatch.setattr(
+        "ray_tpu.autoscaler.launcher._STATE_DIR", str(tmp_path / "state"))
+    cfg = {
+        "cluster_name": f"t{os.getpid()}",
+        "provider": {
+            "type": "local",
+            "head_ip": "127.0.0.1",
+            "worker_ips": ["127.0.0.1"],
+            "gcs_port": 46412,
+        },
+        "head_options": "--num-cpus 2",
+        "worker_options": "--num-cpus 2",
+        "python": sys.executable,
+        # The repo isn't pip-installed in CI; a real deployment would put
+        # this in setup_commands instead.
+        "env": {"PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))},
+    }
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(cfg))
+    yield str(p)
+    from ray_tpu.autoscaler import launcher
+    try:
+        launcher.teardown_cluster(str(p))
+    except Exception:
+        pass
+    time.sleep(1.0)
+
+
+def test_up_exec_submit_down(config_path, tmp_path):
+    from ray_tpu import state as st
+    from ray_tpu.autoscaler import launcher
+
+    cluster = launcher.create_or_update_cluster(config_path)
+    addr = cluster["gcs_address"]
+    assert addr.endswith(":46412")
+
+    # Both the head node and the joined worker node are alive.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        alive = [n for n in st.list_nodes(addr) if n["alive"]]
+        if len(alive) >= 2:
+            break
+        time.sleep(0.5)
+    assert len(alive) >= 2
+
+    # exec: command runs with RAY_TPU_ADDRESS pointing at the cluster.
+    rc = launcher.exec_cluster(config_path, "echo addr=$RAY_TPU_ADDRESS")
+    assert rc == 0
+
+    # submit: a driver script connects and runs a task on the cluster.
+    script = tmp_path / "drv.py"
+    script.write_text(
+        "import os, ray_tpu\n"
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS'])\n"
+        "@ray_tpu.remote\n"
+        "def f(): return 'from cluster'\n"
+        "assert ray_tpu.get(f.remote()).endswith('cluster')\n"
+        "print('submit-ok')\n"
+        "ray_tpu.shutdown()\n")
+    rc = launcher.submit(config_path, str(script), timeout=120)
+    assert rc == 0
+
+    launcher.teardown_cluster(config_path)
+    # GCS is gone; the state record too.
+    assert launcher.load_state(json.loads(
+        open(config_path).read())["cluster_name"]) is None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if not launcher._alive(addr):
+            break
+        time.sleep(0.5)
+    assert not launcher._alive(addr)

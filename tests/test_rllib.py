@@ -152,6 +152,7 @@ def test_custom_env_registration():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_ppo_cartpole_reaches_475(cluster):
     cfg = (PPOConfig()
            .environment("CartPole-v1")
@@ -220,6 +221,7 @@ def test_worker_set_survives_worker_kill(cluster):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_impala_smoke_learns_and_counts_updates(cluster):
     cfg = (IMPALAConfig()
            .environment("CartPole-v1")
@@ -250,6 +252,7 @@ def test_impala_smoke_learns_and_counts_updates(cluster):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_ppo_under_tune(cluster):
     from ray_tpu import tune
     from ray_tpu.rllib import PPO
@@ -267,6 +270,7 @@ def test_ppo_under_tune(cluster):
     assert not results.errors
 
 
+@pytest.mark.slow
 def test_a2c_learns_cartpole(cluster):
     """A2C (reference: rllib/algorithms/a2c) improves past the random
     floor with the shared sync-sample plumbing."""
@@ -314,6 +318,7 @@ def test_replay_buffers():
     assert s["weights"].max() == pytest.approx(1.0)
 
 
+@pytest.mark.slow
 def test_dqn_learns_cartpole(cluster):
     """DQN (reference: rllib/algorithms/dqn) with replay + target network
     + double-Q clears a CartPole learning gate."""
@@ -376,6 +381,7 @@ def test_offline_io_and_behavior_cloning(cluster, tmp_path):
     assert (pred == expert).mean() > 0.95
 
 
+@pytest.mark.slow
 def test_ppo_continuous_pendulum(cluster):
     """Continuous control: Gaussian-policy PPO improves Pendulum swing-up
     well past the random floor (~-1250) (reference: PPO over DiagGaussian
@@ -406,6 +412,7 @@ def test_ppo_continuous_pendulum(cluster):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_sac_learns_pendulum(cluster):
     """Continuous off-policy: SAC (twin soft Q + squashed-Gaussian actor +
     entropy autotuning) solves Pendulum swing-up well past the random
@@ -437,6 +444,7 @@ def test_sac_learns_pendulum(cluster):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_td3_learns_pendulum(cluster):
     """Continuous off-policy: TD3 (twin Q + delayed deterministic policy +
     target smoothing) improves Pendulum well past the random floor
@@ -478,5 +486,67 @@ def test_sac_remote_rollout_plumbing(cluster):
         r2 = algo.train()
         assert r2["buffer_size"] > r1["buffer_size"] > 0
         assert r2["learner_updates_total"] > 0
+    finally:
+        algo.stop()
+
+
+def test_conv_model_forward_shapes():
+    """Nature-CNN actor-critic on [84,84,4] frames (reference:
+    ModelCatalog vision_net; VERDICT r2 item 8)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import make_model
+
+    init, apply = make_model((84, 84, 4), 4)
+    params = init(jax.random.key(0))
+    obs = jnp.zeros((3, 84, 84, 4), jnp.uint8)
+    logits, value = apply(params, obs)
+    assert logits.shape == (3, 4) and value.shape == (3,)
+
+
+def test_pixel_env_uint8_pipeline():
+    """The synthetic Atari-shaped env keeps uint8 end to end through the
+    rollout buffers (pixels move at 1 byte each)."""
+    import numpy as np
+
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+    w = RolloutWorker("SyntheticPixel-v0", num_envs=2,
+                      rollout_fragment_length=4, postprocess=False)
+    batch, metrics = w.sample()
+    assert batch["obs"].shape == (4, 2, 84, 84, 4)
+    assert batch["obs"].dtype == np.uint8
+    assert batch["action_logits"].shape == (4, 2, 4)
+
+
+@pytest.mark.slow
+def test_impala_pixel_throughput(cluster):
+    """IMPALA on the pixel env: async rollouts feed the conv V-trace
+    learner; gate on env-steps/sec progress (not reward — the reference's
+    Atari yamls gate throughput in release tests)."""
+    import time
+
+    from ray_tpu.rllib.impala import IMPALAConfig
+
+    cfg = (IMPALAConfig()
+           .environment("SyntheticPixel-v0")
+           .rollouts(num_rollout_workers=1, num_envs_per_worker=4,
+                     rollout_fragment_length=8)
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        algo.train()  # compile + spawn
+        t0 = time.perf_counter()
+        s0, u0 = algo.total_env_steps, algo.learner.num_updates
+        while time.perf_counter() - t0 < 10.0:
+            algo.train()
+        dt = time.perf_counter() - t0
+        rate = (algo.total_env_steps - s0) / dt
+        updates = algo.learner.num_updates - u0
+        print(f"pixel IMPALA: {rate:,.0f} env-steps/s, "
+              f"{updates/dt:.1f} updates/s")
+        assert updates >= 3, "learner thread made no progress"
+        assert rate > 50, f"pixel pipeline too slow: {rate:.0f} steps/s"
     finally:
         algo.stop()

