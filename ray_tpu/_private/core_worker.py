@@ -150,6 +150,7 @@ class CoreWorker:
         self._running_tasks: dict = {}    # TaskID -> executing thread id
         self._cancel_lock = threading.Lock()
         self._renv_cache: dict = {}       # user runtime_env json -> descriptor
+        self._opts_cache: dict = {}       # id(opts) -> (opts, invariants)
         # Task timeline events, flushed to the GCS in batches (reference:
         # core_worker/task_event_buffer.h:188).
         self._task_events: list = []
@@ -570,6 +571,14 @@ class CoreWorker:
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
+        # About to block: if THIS thread holds batched native replies
+        # (worker exec threads inside a burst), ship them first — a
+        # caller elsewhere may be waiting on one of those replies to
+        # produce the very object this get polls for (batching must
+        # never introduce a cross-worker dependency deadlock).
+        rx = getattr(self, "_native_rx", None)
+        if rx is not None:
+            rx.flush_thread_batch()
         values = self.io.run(self._get_async(refs, timeout))
         return values[0] if single else values
 
@@ -787,6 +796,9 @@ class CoreWorker:
         return table
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        rx = getattr(self, "_native_rx", None)
+        if rx is not None:   # see get(): never block on held replies
+            rx.flush_thread_batch()
         return self.io.run(self._wait_async(refs, num_returns, timeout))
 
     async def _ready_probe(self, ref: ObjectRef):
@@ -902,6 +914,29 @@ class CoreWorker:
             if p is None:
                 return False
             pkwargs[k] = p
+        # Per-options invariants (resources parse, name, retry fields)
+        # compute once per RemoteFunction: the opts dict is immutable
+        # after validation and identity-stable, and the cache pins it so
+        # an id() can never be recycled by a different dict.
+        cached = self._opts_cache.get(id(opts))
+        if cached is None or cached[0] is not opts:
+            cached = (opts, {
+                "num_returns": opts.get("num_returns", 1),
+                "resources": Resources.from_options(opts),
+                "max_retries": opts.get("max_retries", 3),
+                "retry_exceptions": bool(opts.get("retry_exceptions",
+                                                  False)),
+                "scheduling_strategy": (opts.get("scheduling_strategy")
+                                        or "DEFAULT"),
+                "node_affinity": opts.get("_node_id"),
+                "placement_group": _pg_id_of(opts.get("placement_group")),
+                "bundle_index": opts.get("placement_group_bundle_index",
+                                         -1),
+            })
+            if len(self._opts_cache) > 4096:
+                self._opts_cache.clear()
+            self._opts_cache[id(opts)] = cached
+        c = cached[1]
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id or JobID.nil(),
@@ -909,15 +944,15 @@ class CoreWorker:
             fn_key=fn_key,
             args=pargs,
             kwargs=pkwargs,
-            num_returns=opts.get("num_returns", 1),
-            resources=Resources.from_options(opts),
-            max_retries=opts.get("max_retries", 3),
-            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            num_returns=c["num_returns"],
+            resources=c["resources"],
+            max_retries=c["max_retries"],
+            retry_exceptions=c["retry_exceptions"],
             owner_address=self.address,
-            scheduling_strategy=opts.get("scheduling_strategy") or "DEFAULT",
-            node_affinity=opts.get("_node_id"),
-            placement_group=_pg_id_of(opts.get("placement_group")),
-            bundle_index=opts.get("placement_group_bundle_index", -1),
+            scheduling_strategy=c["scheduling_strategy"],
+            node_affinity=c["node_affinity"],
+            placement_group=c["placement_group"],
+            bundle_index=c["bundle_index"],
             runtime_env=renv_desc,
         )
         spec.trace_ctx = tracing.current_context()
@@ -1032,20 +1067,6 @@ class CoreWorker:
             sched = self._lease_cache[key] = _KeyScheduler(
                 self, key, spec, [])
         sched.submit_nowait(spec, batches=batches)
-
-    def _push_native_cb(self, payload: bytes, lease: dict, cb) -> bool:
-        """Zero-coroutine native push: `cb(status, raw_reply)` runs on the
-        io loop when done.  Returns False when the native route to this
-        worker isn't (yet) established — caller falls back to the
-        coroutine path, which performs discovery."""
-        sub = self._native_sub
-        if not sub:
-            return False
-        naddr = self._native_addrs.get(lease["worker_address"])
-        if not naddr:
-            return False
-        sub.call_cb(naddr, payload, cb)
-        return True
 
     async def _resume_task_fast(self, task_id: TaskID, exc):
         """Apply one failure outcome to a fast-path task, then continue in
@@ -1612,13 +1633,12 @@ class CoreWorker:
             # the slow path, which computes the seq fresh per attempt.
             naddr = self._native_addrs.get(addr)
             if naddr:
+                # Always batched: the only caller is _drain_fast, which
+                # owns the burst's per-worker batch dict and flushes it.
                 cb = (lambda status, data: self._on_actor_push_done(
                     sub, task_id, addr, status, data))
-                if batches is not None:
-                    batches.setdefault(naddr, []).append(
-                        (pending.payload, cb))
-                else:
-                    self._native_sub.call_cb(naddr, pending.payload, cb)
+                batches.setdefault(naddr, []).append(
+                    (pending.payload, cb))
                 return
         asyncio.ensure_future(self._run_actor_task(sub, task_id))
 
@@ -2265,21 +2285,23 @@ class _KeyScheduler:
             self.pending_leases += 1
             asyncio.ensure_future(self._acquire_lease())
 
-    def _dispatch(self, spec, sink, lease, batches=None):
+    def _dispatch(self, spec, sink, lease, batches):
+        """Native-route dispatches accumulate into `batches` (flushed by
+        the _pump that owns the dict — one library call per worker);
+        unknown routes (fresh worker, native off) take the coroutine
+        path, which performs discovery."""
         worker = self.worker
         pending = worker.tasks.get(spec.task_id)
         if pending is not None:
             pending.worker_address = lease["worker_address"]
-        if pending is not None and pending.payload is not None:
-            cb = (lambda status, data: self._on_push_done(
-                spec, sink, lease, status, data))
-            if batches is not None and worker._native_sub:
-                naddr = worker._native_addrs.get(lease["worker_address"])
-                if naddr:
-                    batches.setdefault(naddr, []).append(
-                        (pending.payload, cb))
-                    return
-            elif worker._push_native_cb(pending.payload, lease, cb):
+        if (pending is not None and pending.payload is not None
+                and worker._native_sub):
+            naddr = worker._native_addrs.get(lease["worker_address"])
+            if naddr:
+                cb = (lambda status, data: self._on_push_done(
+                    spec, sink, lease, status, data))
+                batches.setdefault(naddr, []).append(
+                    (pending.payload, cb))
                 return
         asyncio.ensure_future(self._run_on_lease(spec, sink, lease))
 

@@ -54,11 +54,23 @@ def main():
                                        cache_root), timeout=120)
 
     hostd = RpcClient(args.hostd)
-    cw.io.run(hostd.call("NodeManager", "WorkerReady", {
-        "pid": os.getpid(),
-        "worker_id": cw.worker_id,
-        "address": cw.address,
-    }, timeout=10))
+    # Registration retries: during a creation storm (hundreds of workers
+    # booting on few cores) the daemon can miss a 10s window; a worker
+    # dying here amplifies the storm instead of riding it out.
+    last = None
+    for attempt in range(4):
+        try:
+            cw.io.run(hostd.call("NodeManager", "WorkerReady", {
+                "pid": os.getpid(),
+                "worker_id": cw.worker_id,
+                "address": cw.address,
+            }, timeout=10 * (attempt + 1)))
+            break
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.5 * (attempt + 1))
+    else:
+        raise RuntimeError(f"WorkerReady never acknowledged: {last}")
 
     parent = os.getppid()
 

@@ -55,6 +55,10 @@ def _reap(_sig, _frm):
 
 def _child(req) -> None:
     """Runs in the forked child; becomes a full worker process."""
+    # The zygote's SIGCHLD reaper must NOT survive the fork: it would
+    # steal exit statuses from subprocesses the worker itself spawns
+    # (pip installs, user tasks), making their failures read as rc=0.
+    signal.signal(signal.SIGCHLD, signal.SIG_DFL)
     os.setsid()
     out = os.open(req["stdout"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                   0o644)

@@ -181,7 +181,7 @@ def teardown_cluster(config_path: str) -> None:
     cfg = load_config(config_path)
     state = load_state(cfg["cluster_name"])
     if state and state.get("gcs_address"):
-        LocalCommandRunner()  # shutdown rides the control plane, not ssh
+        # Shutdown rides the RPC control plane, not a command runner.
         import asyncio
 
         from ray_tpu._private.rpc import RpcClient

@@ -10,10 +10,13 @@ peers write "pickle5"; a C++ producer can submit "raw" bytes args.
 
 from __future__ import annotations
 
+import json
+
 from ray_tpu.protocol import pb
 from ray_tpu._private.ids import (
     ActorID,
     JobID,
+    NodeID,
     PlacementGroupID,
     TaskID,
 )
@@ -57,6 +60,11 @@ def taskspec_to_proto(spec: TaskSpec) -> pb.TaskSpecP:
         placement_group_id=(spec.placement_group.binary()
                             if spec.placement_group else b""),
         bundle_index=spec.bundle_index,
+        runtime_env_json=(json.dumps(spec.runtime_env, sort_keys=True)
+                          if spec.runtime_env else ""),
+        node_affinity=(spec.node_affinity.binary()
+                       if spec.node_affinity else b""),
+        node_affinity_soft=spec.node_affinity_soft,
     )
     for k, v in spec.resources.to_dict().items():
         m.resources.amounts[k] = v
@@ -95,6 +103,11 @@ def taskspec_from_proto(m: pb.TaskSpecP) -> TaskSpec:
                          if m.placement_group_id else None),
         bundle_index=m.bundle_index,
         scheduling_strategy=m.scheduling_strategy or "DEFAULT",
+        runtime_env=(json.loads(m.runtime_env_json)
+                     if m.runtime_env_json else {}),
+        node_affinity=(NodeID(m.node_affinity)
+                       if m.node_affinity else None),
+        node_affinity_soft=m.node_affinity_soft,
     )
     spec.seq_no = m.seq_no
     return spec

@@ -35,7 +35,6 @@ class _Episode:
         self.logp: List[float] = []
         self.vf: List[float] = []
         self.rewards: List[float] = []
-        self.last_obs: Optional[np.ndarray] = None
         self.total_reward = 0.0
 
 
@@ -114,8 +113,6 @@ class PolicyServer:
             return {}
         if path == "/end_episode":
             with self._lock:
-                ep.last_obs = np.asarray(body.get("obs", ep.obs[-1]),
-                                         np.float32)
                 self._episodes.pop(eid, None)
             self._finish_episode(ep)
             return {}

@@ -78,9 +78,14 @@ def bench_concurrent_tasks(results, n: int):
 
 
 def bench_actor_storm(results, target: int):
-    # Each actor is one forked worker process; budget RAM for it.
+    # Each actor is one forked worker process; budget RAM for it AND
+    # CPU: worker boot costs ~100-200ms of CPU, so a 1000-actor storm
+    # belongs on a multi-core cluster (the reference's envelope host).
+    # The applied size is recorded so a host-scaled run is never
+    # mistaken for the full envelope.
     budget = int(mem_available_bytes() * 0.5 // (30 << 20))
-    n = max(50, min(target, budget))
+    cpu_budget = max(100, (os.cpu_count() or 1) * 100)
+    n = max(50, min(target, budget, cpu_budget))
 
     @ray_tpu.remote(num_cpus=0)
     class A:
@@ -89,23 +94,39 @@ def bench_actor_storm(results, target: int):
 
     t0 = time.perf_counter()
     actors = [A.remote() for _ in range(n)]
-    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    refs = [a.ping.remote() for a in actors]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=600)
+    pids, failed = [], len(not_ready)
+    ok = []
+    for a, r in zip(actors, refs):
+        if r in not_ready:
+            continue
+        try:
+            pids.append(ray_tpu.get(r, timeout=30))
+            ok.append(a)
+        except Exception:
+            failed += 1
     create_s = time.perf_counter() - t0
-    alive = len(set(pids))
     t1 = time.perf_counter()
-    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    ray_tpu.get([a.ping.remote() for a in ok], timeout=600)
     ping_s = time.perf_counter() - t1
     for a in actors:
-        ray_tpu.kill(a)
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
     results["actor_storm"] = {
-        "n": n, "target": target, "distinct_workers": alive,
+        "n": n, "target": target, "created_ok": len(pids),
+        "failed": failed, "distinct_workers": len(set(pids)),
         "create_and_first_ping_s": round(create_s, 2),
-        "create_rate_per_s": round(n / create_s, 1),
-        "steady_ping_rate_per_s": round(n / ping_s, 1),
+        "create_rate_per_s": round(len(pids) / create_s, 1),
+        "steady_ping_rate_per_s": round(max(len(ok), 1) / ping_s, 1),
     }
-    print(f"actor_storm: {n} actors (target {target}) created+pinged in "
-          f"{create_s:.2f}s ({n/create_s:,.0f}/s), steady ping "
-          f"{n/ping_s:,.0f}/s")
+    print(f"actor_storm: {len(pids)}/{n} actors (target {target}, "
+          f"{failed} failed) in {create_s:.2f}s "
+          f"({len(pids)/create_s:,.0f}/s), steady ping "
+          f"{max(len(ok),1)/ping_s:,.0f}/s")
 
 
 def bench_broadcast(results, size: int):

@@ -130,6 +130,8 @@ def test_taskspec_proto_roundtrip():
         actor_id=ActorID.of(jid), method_name="step",
         max_concurrency=4, scheduling_strategy="SPREAD",
         bundle_index=1,
+        runtime_env={"env_vars": {"A": "1"},
+                     "pip": {"packages": ["x"], "wheelhouse": "/wh"}},
     )
     spec.seq_no = 77
     m = convert.taskspec_to_proto(spec)
@@ -149,6 +151,7 @@ def test_taskspec_proto_roundtrip():
     assert back.resources.cpu == 2.0 and back.resources.tpu == 1.0
     assert back.resources.custom == {"accelerator_type:v5e": 0.001}
     assert back.scheduling_strategy == "SPREAD" and back.bundle_index == 1
+    assert back.runtime_env == spec.runtime_env
 
 
 def test_lease_and_kv_messages_roundtrip():
