@@ -1615,7 +1615,7 @@ class CoreWorker:
         self._enqueue_fast(("actor", sub, task_id))
         return True
 
-    def _fast_submit_actor(self, sub, task_id, batches=None):
+    def _fast_submit_actor(self, sub, task_id, batches):
         """Loop-side actor dispatch: straight onto the native plane when
         the actor's address and native route are already known.  With
         `batches`, the push is accumulated for a one-call-per-worker

@@ -20,6 +20,7 @@ from ray_tpu.rllib.env import (  # noqa: F401
 )
 from ray_tpu.rllib.a2c import A2C, A2CConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerThread  # noqa: F401
 from ray_tpu.rllib.learner import JaxLearner, ppo_loss  # noqa: F401
 from ray_tpu.rllib.offline import BC, BCConfig, JsonReader, JsonWriter  # noqa: F401

@@ -1,0 +1,33 @@
+"""APPO: asynchronous PPO.
+
+Reference parity: rllib/algorithms/appo/appo.py — IMPALA's async
+actor-learner architecture (rollout actors run ahead, a learner thread
+consumes fragment queues, weights broadcast back) with PPO's clipped
+importance-ratio surrogate computed on V-trace-corrected advantages,
+which tolerates the policy lag the async pipeline introduces.  The TPU
+build composes it literally: the IMPALA driver + learner thread, with
+`clip_param` switching the jitted V-trace loss to the clipped surrogate
+(impala.py _VTraceLearner).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        # PPO-side knobs (reference: appo.py defaults; lr/clip tuned on
+        # the in-tree CartPole gate — 0.3/5e-4 oscillated, 0.2/3e-4
+        # learns monotonically).
+        self.clip_param = 0.2
+        self.lr = 3e-4
+        self.entropy_coeff = 0.005
+        self.min_updates_per_step = 4
+
+
+class APPO(IMPALA):
+    """All behavior inherited: the config's clip_param engages the
+    clipped surrogate inside the V-trace learner."""

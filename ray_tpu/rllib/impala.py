@@ -62,6 +62,11 @@ class _VTraceLearner:
         vf_coeff = cfg.vf_loss_coeff
         ent_coeff = cfg.entropy_coeff
         rho_bar, c_bar = cfg.clip_rho_threshold, cfg.clip_c_threshold
+        # APPO (reference: rllib/algorithms/appo/appo.py — IMPALA's
+        # architecture with PPO's clipped surrogate on V-trace
+        # advantages): when clip_param is set, the policy loss becomes
+        # the clipped importance-ratio surrogate vs the BEHAVIOR policy.
+        clip_param = getattr(cfg, "clip_param", None)
         apply = self.apply
 
         def loss(params, batch):
@@ -85,7 +90,16 @@ class _VTraceLearner:
                         batch[SampleBatch.REWARDS], discounts, values,
                         bootstrap_value, rho_bar, c_bar)
 
-            pg_loss = -(vt.pg_advantages * target_logp).mean()
+            if clip_param is not None:
+                ratio = jnp.exp(target_logp
+                                - batch[SampleBatch.ACTION_LOGP])
+                adv = vt.pg_advantages
+                surr = jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+                pg_loss = -surr.mean()
+            else:
+                pg_loss = -(vt.pg_advantages * target_logp).mean()
             vf_loss = 0.5 * ((vt.vs - values) ** 2).mean()
             entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
             total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy

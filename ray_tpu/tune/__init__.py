@@ -19,6 +19,7 @@ from ray_tpu.tune.search import (  # noqa: F401
     Categorical,
     ConcurrencyLimiter,
     Searcher,
+    BOHBSearcher,
     TPESearcher,
     choice,
     grid_search,

@@ -156,6 +156,7 @@ class TuneController:
             t.results.append(metrics)
             if checkpoint is not None:
                 t.checkpoint = checkpoint
+            self._searcher.on_trial_result(t.trial_id, metrics)
             decision = self._scheduler.on_trial_result(t, metrics)
             if decision == sched_mod.STOP:
                 if t.explored_config is not None:

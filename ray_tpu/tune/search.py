@@ -83,6 +83,10 @@ class Searcher:
     def suggest(self, trial_id: str) -> Optional[dict]:
         raise NotImplementedError
 
+    def on_trial_result(self, trial_id: str, result: dict):
+        """Intermediate observation (multi-fidelity searchers — BOHB —
+        model per training budget; most searchers ignore these)."""
+
     def on_trial_complete(self, trial_id: str, result: Optional[dict],
                           error: bool = False):
         pass
@@ -249,6 +253,58 @@ class TPESearcher(Searcher):
         self._obs.append((cfg, value))
 
 
+class BOHBSearcher(TPESearcher):
+    """BOHB's model half: TPE conditioned on training budget.
+
+    Reference role: python/ray/tune/search/bohb/ (TuneBOHB) paired with
+    schedulers/hb_bohb.py — HyperBand decides budgets/stopping, the
+    model proposes configs from observations AT A BUDGET.  Observations
+    pool per `time_attr` value (every intermediate result is one
+    observation at its budget); suggestion models on the LARGEST budget
+    that has accumulated >= n_startup observations, falling back to
+    random until any budget qualifies.  Pair with HyperBandScheduler.
+    """
+
+    def __init__(self, space: Dict[str, Any], metric: str,
+                 mode: str = "min", n_startup: int = 8,
+                 n_candidates: int = 24, gamma: float = 0.25,
+                 seed: Optional[int] = None,
+                 time_attr: str = "training_iteration"):
+        super().__init__(space, metric, mode, n_startup=n_startup,
+                         n_candidates=n_candidates, gamma=gamma, seed=seed)
+        self._time_attr = time_attr
+        self._by_budget: Dict[int, List[tuple]] = {}
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        cfg = self._configs.get(trial_id)
+        value = result.get(self._metric)
+        budget = result.get(self._time_attr)
+        if cfg is None or value is None or budget is None:
+            return
+        v = float(value)
+        if self._mode == "max":
+            v = -v
+        self._by_budget.setdefault(int(budget), []).append((cfg, v))
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        # Select the highest budget with a modelable pool; TPESearcher's
+        # machinery then runs on that pool via self._obs.
+        pool: List[tuple] = []
+        for budget in sorted(self._by_budget, reverse=True):
+            if len(self._by_budget[budget]) >= self._n_startup:
+                pool = self._by_budget[budget]
+                break
+        self._obs = pool
+        return super().suggest(trial_id)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        # The final result already arrived via on_trial_result (the
+        # controller feeds every report); recording it again here would
+        # double-weight completed trials in the TPE pool.
+        self._configs.pop(trial_id, None)
+
+
 class ConcurrencyLimiter(Searcher):
     """Cap in-flight suggestions (reference: concurrency_limiter.py)."""
 
@@ -264,6 +320,9 @@ class ConcurrencyLimiter(Searcher):
         if cfg is not None:
             self._live.add(trial_id)
         return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)

@@ -18,6 +18,33 @@ from ray_tpu.tune.schedulers import TrialScheduler
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 
 
+class _SampleCap:
+    """Bounds a never-exhausting searcher at num_samples suggestions
+    (delegating everything else)."""
+
+    def __init__(self, searcher, limit: int):
+        self._s = searcher
+        self._left = limit
+
+    def suggest(self, trial_id):
+        if self._left <= 0:
+            return None
+        cfg = self._s.suggest(trial_id)
+        if cfg is not None:
+            self._left -= 1
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        if hasattr(self._s, "on_trial_result"):
+            self._s.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._s.on_trial_complete(trial_id, result, error)
+
+    def __getattr__(self, name):
+        return getattr(self._s, name)
+
+
 @dataclass
 class TuneConfig:
     """Reference: tune/tune_config.py."""
@@ -135,6 +162,11 @@ class Tuner:
         tc = self._tune_config
         searcher = tc.search_alg or BasicVariantGenerator(
             self._param_space, num_samples=tc.num_samples, seed=tc.seed)
+        if tc.search_alg is not None and tc.num_samples:
+            # Model-based searchers (TPE/BOHB) propose forever;
+            # num_samples is the trial budget for them too (reference:
+            # tune.run's num_samples caps any search_alg).
+            searcher = _SampleCap(searcher, tc.num_samples)
         if tc.scheduler is not None:
             tc.scheduler.set_search_properties(tc.metric, tc.mode)
         exp_path = self._experiment_path()

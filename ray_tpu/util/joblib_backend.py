@@ -1,0 +1,87 @@
+"""joblib parallel backend over the cluster.
+
+Reference parity: python/ray/util/joblib/ — `register_ray()` makes
+`joblib.parallel_backend("ray")` run scikit-learn style workloads
+(GridSearchCV, cross_val_score, any joblib.Parallel) on cluster actors
+instead of local processes.
+
+Usage:
+    import joblib
+    from ray_tpu.util.joblib_backend import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray"):
+        joblib.Parallel()(joblib.delayed(f)(x) for x in data)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+def register_ray() -> None:
+    from joblib.parallel import register_parallel_backend
+    register_parallel_backend("ray", _make_backend())
+
+
+def _make_backend():
+    """Subclass joblib's backend base so every protocol attribute
+    (nesting levels, batching hooks) comes from joblib itself; this
+    backend only redirects the pool to cluster tasks (reference:
+    util/joblib/ray_backend.py takes the same pool-redirect shape)."""
+    from joblib._parallel_backends import (
+        ParallelBackendBase,
+        PoolManagerMixin,
+    )
+
+    class _RayTpuBackend(PoolManagerMixin, ParallelBackendBase):
+        supports_timeout = True
+
+        def configure(self, n_jobs: int = 1, parallel=None,
+                      **_: Any) -> int:
+            import ray_tpu
+            from ray_tpu.util.multiprocessing import Pool
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def effective_n_jobs(self, n_jobs: int) -> int:
+            import ray_tpu
+            if n_jobs == 1:
+                return 1
+            total = int(ray_tpu.cluster_resources().get("CPU", 1)) \
+                if ray_tpu.is_initialized() else 1
+            if n_jobs in (None, -1):
+                return max(1, total)
+            return max(1, min(n_jobs, total))
+
+    return _RayTpuBackend
+
+
+def check_serializability(obj: Any, name: str = "object") -> List[str]:
+    """Diagnose why `obj` cannot cross the cluster boundary (reference:
+    ray.util.check_serialize.inspect_serializability): returns a list of
+    problem descriptions, empty when `obj` serializes cleanly."""
+    import cloudpickle
+    problems: List[str] = []
+    try:
+        cloudpickle.dumps(obj)
+        return problems
+    except Exception as root:  # noqa: BLE001
+        problems.append(f"{name}: {type(root).__name__}: {root}")
+    # Walk one level of attributes/items to localize the failure.
+    children: List[tuple] = []
+    if isinstance(obj, dict):
+        children = [(f"{name}[{k!r}]", v) for k, v in obj.items()]
+    elif isinstance(obj, (list, tuple, set)):
+        children = [(f"{name}[{i}]", v) for i, v in enumerate(obj)]
+    elif hasattr(obj, "__dict__"):
+        children = [(f"{name}.{k}", v) for k, v in vars(obj).items()]
+    for child_name, child in children:
+        try:
+            cloudpickle.dumps(child)
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"{child_name}: {type(e).__name__}: {e}")
+    return problems

@@ -14,10 +14,71 @@ from typing import Any, Callable, Iterable, List, Optional
 import ray_tpu
 
 
+class _CallbackDrainer:
+    """ONE shared thread fires every AsyncResult callback (the stdlib
+    pool's result-handler role): a thread per callbacked submission
+    would blow up under apply_async storms."""
+
+    def __init__(self):
+        import threading
+        self._entries: list = []
+        self._cv = threading.Condition()
+        self._thread = None
+
+    def register(self, result: "AsyncResult", callback, error_callback):
+        import threading
+        with self._cv:
+            self._entries.append((result, callback, error_callback))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="pool-callbacks")
+                self._thread.start()
+            self._cv.notify()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._entries:
+                    self._cv.wait()
+                entries = list(self._entries)
+            remaining = []
+            for entry in entries:
+                result, callback, error_callback = entry
+                if not result.ready():
+                    remaining.append(entry)
+                    continue
+                try:
+                    value = result.get(timeout=0)
+                except BaseException as e:  # noqa: BLE001
+                    if error_callback is not None:
+                        try:
+                            error_callback(e)
+                        except Exception:
+                            pass
+                    continue
+                if callback is not None:
+                    try:
+                        callback(value)
+                    except Exception:
+                        pass
+            with self._cv:
+                done = set(map(id, entries)) - set(map(id, remaining))
+                self._entries = [e for e in self._entries
+                                 if id(e) not in done]
+            import time as _time
+            _time.sleep(0.02)
+
+
+_drainer = _CallbackDrainer()
+
+
 class AsyncResult:
-    def __init__(self, refs, single: bool):
+    def __init__(self, refs, single: bool, callback=None,
+                 error_callback=None):
         self._refs = refs
         self._single = single
+        if callback is not None or error_callback is not None:
+            _drainer.register(self, callback, error_callback)
 
     def get(self, timeout: Optional[float] = None):
         results = ray_tpu.get(self._refs, timeout=timeout)
@@ -82,30 +143,40 @@ class Pool:
     def apply(self, fn: Callable, args=(), kwds=None):
         return self.apply_async(fn, args, kwds).get()
 
-    def apply_async(self, fn: Callable, args=(), kwds=None) -> AsyncResult:
+    def apply_async(self, fn: Callable, args=(), kwds=None,
+                    callback=None, error_callback=None) -> AsyncResult:
         self._check_open()
         kwds = kwds or {}
         ref = self._run_chunk.remote(
             lambda *a: fn(*a, **kwds), [tuple(args)], True)
-        return AsyncResult([ref], single=True)
+        return AsyncResult([ref], single=True, callback=callback,
+                           error_callback=error_callback)
 
     def map(self, fn: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
         return self.map_async(fn, iterable, chunksize).get()
 
     def map_async(self, fn: Callable, iterable: Iterable,
-                  chunksize: Optional[int] = None) -> AsyncResult:
+                  chunksize: Optional[int] = None,
+                  callback=None, error_callback=None) -> AsyncResult:
         self._check_open()
         refs = [self._run_chunk.remote(fn, chunk, False)
                 for chunk in self._chunks(iterable, chunksize)]
-        return AsyncResult(refs, single=False)
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
 
     def starmap(self, fn: Callable, iterable: Iterable,
                 chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn: Callable, iterable: Iterable,
+                      chunksize: Optional[int] = None,
+                      callback=None, error_callback=None) -> AsyncResult:
         self._check_open()
         refs = [self._run_chunk.remote(fn, chunk, True)
                 for chunk in self._chunks(iterable, chunksize)]
-        return AsyncResult(refs, single=False).get()
+        return AsyncResult(refs, single=False, callback=callback,
+                           error_callback=error_callback)
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):

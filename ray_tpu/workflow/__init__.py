@@ -19,3 +19,4 @@ from ray_tpu.workflow.api import (  # noqa: F401
 
 __all__ = ["get_output", "get_status", "init", "list_all", "resume",
            "run", "run_async"]
+from ray_tpu.workflow import events  # noqa: F401,E402

@@ -1,0 +1,167 @@
+"""Workflow event providers: durable waits on external events.
+
+Reference parity: python/ray/workflow/ — `workflow.wait_for_event` with
+pluggable `EventListener`s and the HTTP event provider
+(http_event_provider.py): a workflow step blocks until an external
+system delivers an event, the event's payload is CHECKPOINTED with the
+step, and a resumed workflow replays the recorded payload instead of
+waiting again (exactly-once event consumption).
+
+Built-ins:
+  - EventListener: the plugin interface (async poll_for_event).
+  - TimerListener: fires after a duration (reference: workflow timers).
+  - HTTPEventProvider: a tiny HTTP endpoint; an external POST to
+    /event/<key> delivers the payload to any step waiting on that key.
+
+Usage:
+    from ray_tpu import workflow
+    from ray_tpu.workflow.events import HTTPEventProvider, wait_for_event
+
+    provider = HTTPEventProvider(port=0)   # share provider.address
+    dag = step2.bind(wait_for_event.bind(provider.listener("approval")))
+    workflow.run(dag, workflow_id="w1")    # blocks at the event step
+    # elsewhere: POST {"ok": true} to http://host:port/event/approval
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict
+
+import ray_tpu
+
+
+class EventListener:
+    """Plugin interface (reference: workflow/event_listener.py)."""
+
+    async def poll_for_event(self) -> Any:
+        """Block until the event arrives; the return value is the event
+        payload (checkpointed by the event step)."""
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    async def poll_for_event(self) -> Any:
+        await asyncio.sleep(self.seconds)
+        return {"fired_after_s": self.seconds}
+
+
+@ray_tpu.remote(num_cpus=0)
+class _EventMailbox:
+    """Named actor holding delivered events per key (the durable
+    rendezvous between external posters and waiting steps)."""
+
+    def __init__(self):
+        self._events: Dict[str, Any] = {}
+        self._waiters: Dict[str, asyncio.Event] = {}
+
+    async def deliver(self, key: str, payload) -> bool:
+        self._events[key] = payload
+        ev = self._waiters.pop(key, None)
+        if ev is not None:
+            ev.set()
+        return True
+
+    async def wait(self, key: str):
+        while key not in self._events:
+            ev = self._waiters.get(key)
+            if ev is None:
+                ev = self._waiters[key] = asyncio.Event()
+            try:
+                await asyncio.wait_for(ev.wait(), 5.0)
+            except asyncio.TimeoutError:
+                pass
+        return self._events[key]
+
+    async def peek(self, key: str):
+        return self._events.get(key)
+
+
+class HTTPEventProvider:
+    """HTTP ingress for events (reference: http_event_provider.py):
+    POST /event/<key> with a JSON body delivers that payload to waiting
+    workflow steps; GET /event/<key> shows whether it was delivered."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "wf_event_mailbox"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        self._mailbox = _EventMailbox.options(
+            name=name, get_if_exists=True, lifetime="detached").remote()
+        mailbox = self._mailbox
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                if not self.path.startswith("/event/"):
+                    return self._reply(404, {"error": "unknown path"})
+                key = self.path[len("/event/"):]
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(n) or b"null")
+                except ValueError:
+                    return self._reply(400, {"error": "bad json"})
+                ray_tpu.get(mailbox.deliver.remote(key, payload))
+                self._reply(200, {"delivered": key})
+
+            def do_GET(self):
+                if not self.path.startswith("/event/"):
+                    return self._reply(404, {"error": "unknown path"})
+                key = self.path[len("/event/"):]
+                got = ray_tpu.get(mailbox.peek.remote(key))
+                self._reply(200, {"key": key, "delivered": got is not None})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.address = f"http://{host}:{self.port}"
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="wf-events").start()
+
+    def listener(self, key: str) -> "MailboxListener":
+        return MailboxListener(key,
+                               mailbox_name="wf_event_mailbox")
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+class MailboxListener(EventListener):
+    """Waits on a key in the named mailbox actor (picklable: steps
+    resolve the actor by name wherever they execute)."""
+
+    def __init__(self, key: str, mailbox_name: str = "wf_event_mailbox"):
+        self.key = key
+        self.mailbox_name = mailbox_name
+
+    async def poll_for_event(self) -> Any:
+        mailbox = ray_tpu.get_actor(self.mailbox_name)
+        ref = mailbox.wait.remote(self.key)
+        # Drive the blocking get off the loop.
+        return await asyncio.get_event_loop().run_in_executor(
+            None, lambda: ray_tpu.get(ref, timeout=None))
+
+
+def wait_for_event(listener: EventListener) -> Any:
+    """The event STEP body: bind `event_step` into a workflow DAG; the
+    return value (the event payload) checkpoints like any step result,
+    so a resumed workflow replays it instead of waiting again
+    (reference: workflow.wait_for_event exactly-once semantics)."""
+    return asyncio.run(listener.poll_for_event())
+
+
+# Bindable step: dag = consumer.bind(events.event_step.bind(listener))
+event_step = ray_tpu.remote(wait_for_event)

@@ -833,3 +833,63 @@ def test_runtime_env_pip_requires_wheelhouse(cluster, monkeypatch):
         def f():
             return 1
         f.remote()
+
+
+def test_joblib_backend_and_check_serialize(cluster):
+    """joblib.parallel_backend('ray') runs joblib workloads on cluster
+    tasks (reference: util/joblib register_ray), and the
+    serializability inspector localizes unpicklable members (reference:
+    util/check_serialize)."""
+    import joblib
+
+    from ray_tpu.util.joblib_backend import (
+        check_serializability,
+        register_ray,
+    )
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(lambda x: x * x)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
+
+    assert check_serializability({"fine": [1, 2, 3]}) == []
+    import threading
+    problems = check_serializability({"bad": threading.Lock()})
+    assert problems and any("bad" in p for p in problems)
+
+
+def test_pool_async_callbacks(cluster):
+    """stdlib parity: apply_async/map_async/starmap_async fire
+    callback/error_callback (one shared drainer thread, not one thread
+    per submission)."""
+    import threading
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    got, errs = [], []
+    done = threading.Event()
+    with Pool(processes=2) as pool:
+        pool.apply_async(lambda x: x + 1, (41,),
+                         callback=lambda v: (got.append(v), done.set()))
+        assert done.wait(60)
+        assert got == [42]
+
+        done2 = threading.Event()
+        pool.map_async(lambda x: x * 2, [1, 2, 3],
+                       callback=lambda v: (got.append(v), done2.set()))
+        assert done2.wait(60)
+        assert got[-1] == [2, 4, 6]
+
+        done3 = threading.Event()
+
+        def boom(_):
+            raise RuntimeError("pool-cb-error")
+
+        pool.apply_async(boom, (0,),
+                         error_callback=lambda e: (errs.append(str(e)),
+                                                   done3.set()))
+        assert done3.wait(60)
+        assert errs and "pool-cb-error" in errs[0]
+
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]

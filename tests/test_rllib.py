@@ -550,3 +550,29 @@ def test_impala_pixel_throughput(cluster):
         assert rate > 50, f"pixel pipeline too slow: {rate:.0f} steps/s"
     finally:
         algo.stop()
+
+
+@pytest.mark.slow
+def test_appo_learns_cartpole(cluster):
+    """APPO (reference: rllib/algorithms/appo) — IMPALA's async pipeline
+    with PPO's clipped surrogate on V-trace advantages; smoke gate like
+    IMPALA's: clear learning within a bounded budget."""
+    from ray_tpu.rllib.appo import APPOConfig
+
+    cfg = (APPOConfig()
+           .environment("CartPole-v1")
+           .rollouts(num_rollout_workers=1, num_envs_per_worker=8,
+                     rollout_fragment_length=32)
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        best = 0.0
+        for _ in range(40):
+            r = algo.train()
+            best = max(best, r["episode_reward_mean"])
+            if best >= 50.0:
+                break
+        assert best >= 50.0, f"APPO failed to learn: best={best}"
+        assert algo.learner.num_updates > 0
+    finally:
+        algo.stop()

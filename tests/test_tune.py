@@ -263,3 +263,42 @@ def test_hyperband_scheduler_stops_bad_trials():
     assert stopped  # bad trials got cut before max_t
     # The best trial of bracket 0 survived to max_t.
     assert decisions[trials[0].trial_id] == STOP  # via t >= max_t
+
+
+def test_bohb_searcher_with_hyperband(cluster):
+    """BOHB = HyperBand budgets + TPE conditioned per budget (reference:
+    tune/search/bohb + schedulers/hb_bohb.py roles): on a deterministic
+    objective it must beat random search under the same trial budget."""
+    from ray_tpu import tune
+    from ray_tpu.tune.search import BasicVariantGenerator, BOHBSearcher
+
+    space = {"x": tune.uniform(-4.0, 4.0), "y": tune.uniform(-4.0, 4.0)}
+
+    def objective(config):
+        # Iterative so HyperBand has rungs to cut on.
+        for i in range(9):
+            loss = (config["x"] - 1.2) ** 2 + (config["y"] + 0.7) ** 2
+            tune.report({"loss": loss})
+
+    def best_with(searcher, scheduler=None):
+        tuner = tune.Tuner(
+            objective,
+            tune_config=tune.TuneConfig(
+                metric="loss", mode="min", num_samples=40,
+                search_alg=searcher, scheduler=scheduler,
+                max_concurrent_trials=4),
+        )
+        grid = tuner.fit()
+        return grid.get_best_result(metric="loss", mode="min") \
+            .metrics["loss"]
+
+    bohb = best_with(
+        BOHBSearcher(space, metric="loss", mode="min", n_startup=6,
+                     seed=5),
+        tune.HyperBandScheduler(metric="loss", mode="min", max_t=9,
+                                reduction_factor=3))
+    rnd = best_with(BasicVariantGenerator(space, num_samples=40, seed=5))
+    # The model must find a clearly better optimum than random under the
+    # same budget (deterministic objective, fixed seeds).
+    assert bohb <= rnd * 1.05, (bohb, rnd)
+    assert bohb < 1.0, bohb
