@@ -324,6 +324,109 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_local_dump(args) -> int:
+    """Collect this host's session logs + cluster state into a tarball
+    (reference: scripts.py local_dump — the ops artifact attached to bug
+    reports)."""
+    import glob
+    import json as _json
+    import os
+    import tarfile
+    import tempfile
+    import time as _time
+
+    out = args.out or f"ray_tpu_dump_{int(_time.time())}.tar.gz"
+    if args.session_dir:
+        sessions = [args.session_dir]
+    else:
+        if args.sessions <= 0:
+            print("--sessions must be >= 1", file=sys.stderr)
+            return 2
+
+        def _mtime(p):  # a session dir can vanish between glob and sort
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+
+        sessions = sorted(glob.glob(os.path.join(
+            tempfile.gettempdir(), "ray_tpu", "session_*")), key=_mtime)
+        sessions = sessions[-args.sessions:]
+    with tarfile.open(out, "w:gz") as tar:
+        for sess in sessions:
+            logs = os.path.join(sess, "logs")
+            if os.path.isdir(logs):
+                tar.add(logs, arcname=os.path.join(
+                    os.path.basename(sess), "logs"))
+        if args.address:
+            try:
+                from ray_tpu import state
+                snap = {
+                    "nodes": state.list_nodes(args.address),
+                    "actors": state.list_actors(args.address),
+                    "workers": state.list_workers(args.address),
+                    "summary": state.summarize_cluster(args.address),
+                }
+                blob = _json.dumps(snap, indent=2, default=str).encode()
+                import io as _io
+                info = tarfile.TarInfo("cluster_state.json")
+                info.size = len(blob)
+                tar.addfile(info, _io.BytesIO(blob))
+            except Exception as e:  # noqa: BLE001
+                print(f"warning: no cluster state captured: {e}",
+                      file=sys.stderr)
+    print(f"wrote {out} ({len(sessions)} session(s))")
+    return 0
+
+
+def cmd_global_gc(args) -> int:
+    """Trigger gc.collect() in every worker in the cluster (reference:
+    scripts.py global_gc / ray._private.internal_api.global_gc): frees
+    cyclic garbage holding ObjectRefs so their objects can release."""
+    import ray_tpu
+    ray_tpu.init(address=args.address)
+
+    @ray_tpu.remote(num_cpus=0)
+    def _gc():
+        import gc
+        import os
+        return os.getpid(), gc.collect()
+
+    try:
+        from ray_tpu import state
+        workers = [w for w in state.list_workers(args.address)
+                   if w.get("alive")]
+        # Best effort: tasks land wherever the scheduler places them, so
+        # over-subscribe and report the DISTINCT workers actually hit
+        # (the reference broadcasts a core-worker RPC instead).
+        n = max(4, 2 * len(workers))
+        outs = ray_tpu.get([_gc.remote() for _ in range(n)], timeout=120)
+        pids = {pid for pid, _ in outs}
+        print(f"gc.collect() ran in {len(pids)} worker(s) "
+              f"({n} tasks; cycles collected: "
+              f"{sum(c for _, c in outs)})")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    """Core-runtime microbenchmarks (reference: `ray microbenchmark`)."""
+    import importlib.util
+    import os
+    repo_script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "scripts", "microbench.py")
+    if not os.path.exists(repo_script):
+        print("scripts/microbench.py not found", file=sys.stderr)
+        return 2
+    spec = importlib.util.spec_from_file_location("microbench", repo_script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    return 0
+
+
 def cmd_up(args) -> int:
     from ray_tpu.autoscaler import launcher
     state = launcher.create_or_update_cluster(
@@ -448,6 +551,23 @@ def main(argv=None) -> int:
     q = sub.add_parser("attach", help="interactive shell on the head")
     q.add_argument("config")
     q.set_defaults(fn=cmd_attach)
+
+    q = sub.add_parser("local-dump",
+                       help="tar up session logs + cluster state")
+    q.add_argument("--address", default=None)
+    q.add_argument("--out", default=None)
+    q.add_argument("--sessions", type=int, default=1,
+                   help="how many recent sessions to include")
+    q.add_argument("--session-dir", default=None,
+                   help="dump exactly this session directory")
+    q.set_defaults(fn=cmd_local_dump)
+    q = sub.add_parser("global-gc",
+                       help="run gc.collect() across the cluster")
+    q.add_argument("--address", required=True)
+    q.set_defaults(fn=cmd_global_gc)
+    q = sub.add_parser("microbenchmark",
+                       help="core-runtime microbenchmarks")
+    q.set_defaults(fn=cmd_microbenchmark)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -122,3 +122,32 @@ def test_duplicate_submission_id_rejected(dash):
     client.wait_until_finished(sub_id, timeout=120)
     with pytest.raises(JobSubmissionError):
         client.submit_job(entrypoint="echo two", submission_id="fixed-id-1")
+
+
+def test_cli_local_dump_and_global_gc(dash, tmp_path):
+    """Ops commands (reference: scripts.py local_dump / global_gc)."""
+    import io
+    import tarfile
+    from contextlib import redirect_stdout
+
+    from ray_tpu.scripts import cli
+
+    cluster, _client, _port = dash
+    out = str(tmp_path / "dump.tar.gz")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        # Pin the dump to THIS cluster's session: mtime ordering over
+        # /tmp is racy when other sessions churn concurrently.
+        rc = cli.main(["local-dump", "--address", cluster.address,
+                       "--out", out, "--session-dir",
+                       cluster.session_dir])
+    assert rc == 0
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+    assert any("cluster_state.json" in n for n in names)
+    assert any("logs" in n for n in names)
+
+    with redirect_stdout(buf):
+        rc = cli.main(["global-gc", "--address", cluster.address])
+    assert rc == 0
+    assert "gc.collect() ran" in buf.getvalue()
