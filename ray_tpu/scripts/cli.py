@@ -427,6 +427,86 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+_RLLIB_ALGOS = {
+    "PPO": ("ray_tpu.rllib.ppo", "PPOConfig"),
+    "APPO": ("ray_tpu.rllib.appo", "APPOConfig"),
+    "IMPALA": ("ray_tpu.rllib.impala", "IMPALAConfig"),
+    "A2C": ("ray_tpu.rllib.a2c", "A2CConfig"),
+    "DQN": ("ray_tpu.rllib.dqn", "DQNConfig"),
+    "SAC": ("ray_tpu.rllib.sac", "SACConfig"),
+    "TD3": ("ray_tpu.rllib.td3", "TD3Config"),
+}
+
+
+def cmd_rllib_train(args) -> int:
+    """Train an algorithm from the command line (reference:
+    rllib/train.py — `rllib train --algo PPO --env CartPole-v1`)."""
+    import importlib
+    import json as _json
+
+    import ray_tpu
+    mod_name, cfg_name = _RLLIB_ALGOS[args.algo]
+    cfg_cls = getattr(importlib.import_module(mod_name), cfg_name)
+    ray_tpu.init()
+    cfg = (cfg_cls().environment(args.env)
+           .rollouts(num_rollout_workers=args.num_workers)
+           .debugging(seed=args.seed))
+    if args.config:
+        cfg.training(**_json.loads(args.config))
+    algo = cfg.build()
+    try:
+        for i in range(args.stop_iters):
+            r = algo.train()
+            mean = r.get("episode_reward_mean")
+            print(f"iter {r['training_iteration']}: "
+                  f"reward_mean={mean:.1f} steps={r['timesteps_total']}")
+            if args.stop_reward is not None and mean == mean \
+                    and mean >= args.stop_reward:
+                print(f"stop-reward {args.stop_reward} reached")
+                break
+        if args.out:
+            ckpt = algo.save()
+            ckpt.to_directory(args.out)
+            print(f"checkpoint written to {args.out}")
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_rllib_evaluate(args) -> int:
+    """Greedy-policy evaluation of a saved checkpoint (reference:
+    rllib/evaluate.py)."""
+    import importlib
+
+    import ray_tpu
+    from ray_tpu.air.checkpoint import Checkpoint
+    mod_name, cfg_name = _RLLIB_ALGOS[args.algo]
+    cfg_cls = getattr(importlib.import_module(mod_name), cfg_name)
+    ray_tpu.init()
+    cfg = (cfg_cls().environment(args.env)
+           .rollouts(num_rollout_workers=0)
+           .debugging(seed=args.seed))
+    algo = cfg.build()
+    try:
+        algo.restore(Checkpoint.from_directory(args.checkpoint))
+        # Scale the step budget to the request: the default 1000-step
+        # cap would silently truncate long-episode envs.
+        stats = algo.workers.local_worker.evaluate(
+            num_episodes=args.episodes, max_steps=args.episodes * 1000)
+        rets = stats["episode_returns"]
+        if rets:
+            import statistics
+            print(f"{len(rets)} episodes: mean={statistics.fmean(rets):.1f} "
+                  f"min={min(rets):.1f} max={max(rets):.1f}")
+        else:
+            print("no episodes completed")
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+    return 0
+
+
 def cmd_up(args) -> int:
     from ray_tpu.autoscaler import launcher
     state = launcher.create_or_update_cluster(
@@ -568,6 +648,28 @@ def main(argv=None) -> int:
     q = sub.add_parser("microbenchmark",
                        help="core-runtime microbenchmarks")
     q.set_defaults(fn=cmd_microbenchmark)
+
+    q = sub.add_parser("rllib", help="train/evaluate RL algorithms")
+    rsub = q.add_subparsers(dest="rllib_cmd", required=True)
+    rt = rsub.add_parser("train")
+    rt.add_argument("--algo", choices=sorted(_RLLIB_ALGOS), default="PPO")
+    rt.add_argument("--env", default="CartPole-v1")
+    rt.add_argument("--num-workers", type=int, default=1)
+    rt.add_argument("--stop-iters", type=int, default=50)
+    rt.add_argument("--stop-reward", type=float, default=None)
+    rt.add_argument("--seed", type=int, default=0)
+    rt.add_argument("--config", default=None,
+                    help="JSON of extra .training(...) overrides")
+    rt.add_argument("--out", default=None,
+                    help="write a checkpoint directory on finish")
+    rt.set_defaults(fn=cmd_rllib_train)
+    re_ = rsub.add_parser("evaluate")
+    re_.add_argument("checkpoint")
+    re_.add_argument("--algo", choices=sorted(_RLLIB_ALGOS), default="PPO")
+    re_.add_argument("--env", default="CartPole-v1")
+    re_.add_argument("--episodes", type=int, default=10)
+    re_.add_argument("--seed", type=int, default=0)
+    re_.set_defaults(fn=cmd_rllib_evaluate)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -318,6 +318,18 @@ def exp_batch24():
 EXPS["batch24"] = exp_batch24
 
 
+def exp_batch32():
+    exp_batch(32)
+
+
+def exp_batch48():
+    exp_batch(48)
+
+
+EXPS["batch32"] = exp_batch32
+EXPS["batch48"] = exp_batch48
+
+
 
 if __name__ == "__main__":
     names = sys.argv[1:] or list(EXPS)

@@ -1,0 +1,28 @@
+"""rllib CLI entry points (reference: rllib/train.py, rllib/evaluate.py)."""
+import io
+from contextlib import redirect_stdout
+
+import pytest
+
+
+@pytest.mark.slow
+def test_rllib_cli_train_and_evaluate(tmp_path):
+    from ray_tpu.scripts import cli
+
+    out_dir = str(tmp_path / "ckpt")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["rllib", "train", "--algo", "PPO",
+                       "--env", "CartPole-v1", "--num-workers", "1",
+                       "--stop-iters", "3", "--config",
+                       '{"train_batch_size": 512, "num_sgd_iter": 2}',
+                       "--out", out_dir])
+    assert rc == 0
+    assert "iter" in buf.getvalue() and "checkpoint written" in buf.getvalue()
+
+    buf2 = io.StringIO()
+    with redirect_stdout(buf2):
+        rc = cli.main(["rllib", "evaluate", out_dir, "--algo", "PPO",
+                       "--env", "CartPole-v1", "--episodes", "3"])
+    assert rc == 0
+    assert "episodes: mean=" in buf2.getvalue()

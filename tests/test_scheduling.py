@@ -90,3 +90,34 @@ def test_multiprocessing_pool_shim(cluster):
         assert list(pool.imap(lambda x: x + 1, range(5))) == [1, 2, 3, 4, 5]
     with pytest.raises(ValueError):
         pool.map(lambda x: x, [1])
+
+
+def test_dependency_chain_on_cold_workers_no_deadlock(cluster):
+    """Dependency-gated dispatch (reference: raylet dependency manager):
+    a consumer whose producer is still pending must not be pushed into a
+    worker FIFO ahead of that producer — with inline per-worker
+    execution that ordering deadlocked both tasks.  Exercise many
+    producer->consumer chains submitted back-to-back so cold-worker
+    discovery races would have scrambled dispatch order."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def produce(n):
+        return np.ones(n)
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(np.asarray(arr).sum())
+
+    chains = [consume.remote(produce.remote(10 * (i + 1)))
+              for i in range(12)]
+    # Deep chain too: each stage depends on the previous.
+    x = produce.remote(7)
+    for _ in range(5):
+        # consume(scalar) sums a 0-d array: value stays 7.0 while each
+        # stage depends on the previous one's pending output.
+        x = consume.remote(x)
+    deep = consume.remote(x)
+    out = ray_tpu.get(chains + [deep], timeout=120)
+    assert out[:12] == [10.0 * (i + 1) for i in range(12)]
+    assert out[-1] == 7.0
