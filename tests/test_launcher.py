@@ -88,3 +88,40 @@ def test_up_exec_submit_down(config_path, tmp_path):
             break
         time.sleep(0.5)
     assert not launcher._alive(addr)
+
+
+def test_ssh_command_runner_argv_construction():
+    """No sshd in the CI image, so pin the ssh/scp argv the runner
+    builds (reference: command_runner.py SSHCommandRunner options incl.
+    ControlMaster multiplexing)."""
+    from ray_tpu.autoscaler.command_runner import SSHCommandRunner
+
+    r = SSHCommandRunner("10.0.0.9", user="ubuntu",
+                         key_path="~/.ssh/k.pem", port=2222)
+    base = r._base()
+    assert base[0] == "ssh"
+    assert "-o" in base and "StrictHostKeyChecking=no" in base
+    assert "ControlMaster=auto" in base
+    i = base.index("-i")
+    assert base[i + 1].endswith("/.ssh/k.pem")  # ~ expanded
+    assert base[base.index("-p") + 1] == "2222"
+    assert r._target() == "ubuntu@10.0.0.9"
+
+    scp = r._base(scp=True)
+    assert scp[0] == "scp" and scp[scp.index("-P") + 1] == "2222"
+
+    # run() env vars are shell-quoted ahead of the command.
+    import unittest.mock as mock
+    with mock.patch("subprocess.run") as run:
+        run.return_value = mock.Mock(returncode=0, stdout="")
+        r.run("echo hi", env={"A": "x y"})
+        argv = run.call_args[0][0]
+        assert argv[-1] == "A='x y' echo hi"
+        assert argv[-2] == "ubuntu@10.0.0.9"
+
+    with mock.patch("subprocess.run") as run:
+        run.return_value = mock.Mock(returncode=0)
+        r.run_detached("sleep 5", "/tmp/x/log.txt")
+        argv = run.call_args[0][0]
+        assert "nohup sleep 5 > /tmp/x/log.txt 2>&1 < /dev/null &" \
+            in argv[-1]
