@@ -1,0 +1,30 @@
+"""Train PPO on CartPole with the RL stack (reference: rllib quickstart)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu
+from ray_tpu.rllib.ppo import PPOConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=8)
+            .training(train_batch_size=2048)
+            .debugging(seed=0)
+            .build())
+    try:
+        for _ in range(10):
+            r = algo.train()
+            print(f"iter {r['training_iteration']}: "
+                  f"reward_mean={r['episode_reward_mean']:.1f}")
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

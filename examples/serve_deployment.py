@@ -1,0 +1,43 @@
+"""Serve a model behind HTTP (reference: serve quickstart)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2)
+class Scorer:
+    def __init__(self, scale: float):
+        self.scale = scale
+
+    async def __call__(self, payload):
+        # async handlers overlap on the replica's persistent event loop
+        return {"score": self.scale * float(payload["value"])}
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    handle = serve.run(Scorer.bind(2.5))
+    port = serve.start(with_proxy=True)
+
+    # Python-handle path:
+    print(handle.remote({"value": 4.0}).result(timeout=30))
+
+    # HTTP path (route = deployment name):
+    import json
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/Scorer",
+        data=json.dumps({"value": 10}).encode(),
+        headers={"Content-Type": "application/json"})
+    print(json.loads(urllib.request.urlopen(req, timeout=30).read()))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""A Tune sweep over a training loop (reference: tune quickstart).
+Swap the toy objective for a JaxTrainer to sweep real model training."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu
+from ray_tpu import tune
+
+
+def train_fn(config):
+    # Stand-in for a model training loop reporting per-epoch metrics.
+    w = 0.0
+    for epoch in range(8):
+        w += config["lr"] * (1.0 - w)           # converges toward 1
+        loss = (1.0 - w) ** 2 + 0.01 / config["batch"]
+        tune.report({"loss": loss, "epoch": epoch})
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    tuner = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.loguniform(1e-3, 1.0),
+                     "batch": tune.choice([16, 32, 64])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=12,
+            scheduler=tune.ASHAScheduler(max_t=8, grace_period=2)),
+    )
+    best = tuner.fit().get_best_result()
+    print("best loss:", best.metrics["loss"],
+          "config:", best.metrics.get("config"))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
