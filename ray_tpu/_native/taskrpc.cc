@@ -265,6 +265,11 @@ struct Endpoint {
 struct Client : Endpoint {
   std::unordered_map<uint64_t, uint64_t> inflight;  // req_id -> conn tag
                                                     // (guarded by mu)
+  // Spec-codec state (guarded by mu): immutable once registered, so
+  // references handed out under the lock stay valid (unordered_map
+  // mapped values are rehash-stable).
+  std::unordered_map<uint64_t, std::vector<uint8_t>> templates;
+  std::vector<uint8_t> caller_id;
   std::mutex cmu;
   std::condition_variable ccv;
   std::deque<Record> completions;
@@ -433,6 +438,123 @@ struct Server : Endpoint {
 }  // namespace
 
 // ---------------------------------------------------------------------------
+// TaskSpec codec (the native encode half of the §2.1 hot path).
+//
+// Reference parity: src/ray/common/task/task_spec.h — the reference's
+// TaskSpecBuilder produces the TaskSpec protobuf in C++; submission never
+// serializes through Python.  Here Python registers a per-(fn, options)
+// "template": the serialized constant fields of a TaskSpecP
+// (protocol/raytpu.proto).  Per task it packs a flat binary descriptor
+// (ids + args + seq) and the library splices template + varying fields
+// into PushTaskRequest wire bytes — proto3 fields may appear in any
+// order, so appending the varying fields after the template is a valid
+// encoding.  One library call frames a whole dispatch burst.
+//
+// Packed descriptor stream (little-endian), one record per task:
+//   u64 req_id | u64 tpl_id | u64 seq_no | u64 wire_seq
+//   u8 tid_len | tid | u8 flags(bit0: trace present)
+//   [u32 trace_len | trace]
+//   u16 n_args, then per arg:
+//     u8 kind (0 inline pickle5, 1 ref, 2 inline raw)
+//     u16 name_len | name            (>0 marks a kwargs entry)
+//     kind 0/2: u32 data_len | data | u32 meta_len | meta
+//     kind 1:   u8 id_len | id | u16 owner_len | owner
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Proto field tags (raytpu.proto): TaskSpecP{task_id=1, args=5, kwargs=6,
+// seq_no=15, trace_ctx=23}; TaskArgP{id=1, value=2, owner_address=3};
+// InlineValueP{data=1, metadata=2, codec=3}; PushTaskRequest{spec=1,
+// caller_id=2, wire_seq=3}; map entry{key=1, value=2}.
+
+size_t vlen(uint64_t v) {
+  size_t n = 1;
+  while (v >= 128) { v >>= 7; n++; }
+  return n;
+}
+
+uint64_t zigzag(int64_t v) {
+  return (uint64_t(v) << 1) ^ uint64_t(v >> 63);
+}
+
+void put_varint(std::vector<uint8_t>& o, uint64_t v) {
+  while (v >= 128) { o.push_back(uint8_t(v) | 0x80); v >>= 7; }
+  o.push_back(uint8_t(v));
+}
+
+void put_tag(std::vector<uint8_t>& o, uint32_t field, uint32_t wt) {
+  put_varint(o, (uint64_t(field) << 3) | wt);
+}
+
+void put_bytes_field(std::vector<uint8_t>& o, uint32_t field,
+                     const uint8_t* p, uint64_t n) {
+  put_tag(o, field, 2);
+  put_varint(o, n);
+  o.insert(o.end(), p, p + n);
+}
+
+struct SpecReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  template <typename T>
+  T num() {
+    if (!ok || size_t(end - p) < sizeof(T)) { ok = false; return T(0); }
+    T v;
+    memcpy(&v, p, sizeof(T));
+    p += sizeof(T);
+    return v;
+  }
+  const uint8_t* blob(uint64_t n) {
+    if (!ok || uint64_t(end - p) < n) { ok = false; return nullptr; }
+    const uint8_t* r = p;
+    p += n;
+    return r;
+  }
+};
+
+struct ArgView {
+  uint8_t kind;
+  const uint8_t* name; uint16_t name_len;
+  const uint8_t* a; uint64_t alen;    // data / id
+  const uint8_t* b; uint64_t blen;    // metadata / owner
+};
+
+constexpr const char kPickle5[] = "pickle5";
+constexpr const char kRaw[] = "raw";
+
+// Serialized size of one TaskArgP body for `v`.
+uint64_t arg_body_len(const ArgView& v) {
+  if (v.kind == 1)
+    return 1 + vlen(v.alen) + v.alen + 1 + vlen(v.blen) + v.blen;
+  uint64_t clen = (v.kind == 2) ? 3 : 7;
+  uint64_t iv = 1 + vlen(v.alen) + v.alen + 1 + vlen(clen) + clen;
+  if (v.blen) iv += 1 + vlen(v.blen) + v.blen;
+  return 1 + vlen(iv) + iv;
+}
+
+void put_arg_body(std::vector<uint8_t>& o, const ArgView& v) {
+  if (v.kind == 1) {
+    put_bytes_field(o, 1, v.a, v.alen);            // TaskArgP.id
+    put_bytes_field(o, 3, v.b, v.blen);            // TaskArgP.owner_address
+    return;
+  }
+  const char* codec = (v.kind == 2) ? kRaw : kPickle5;
+  uint64_t clen = (v.kind == 2) ? 3 : 7;
+  uint64_t iv = 1 + vlen(v.alen) + v.alen + 1 + vlen(clen) + clen;
+  if (v.blen) iv += 1 + vlen(v.blen) + v.blen;
+  put_tag(o, 2, 2);                                // TaskArgP.value
+  put_varint(o, iv);
+  put_bytes_field(o, 1, v.a, v.alen);              // InlineValueP.data
+  if (v.blen) put_bytes_field(o, 2, v.b, v.blen);  // InlineValueP.metadata
+  put_bytes_field(o, 3, reinterpret_cast<const uint8_t*>(codec), clen);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
 // C API
 // ---------------------------------------------------------------------------
 
@@ -536,6 +658,172 @@ int tpt_send_raw(void* h, uint64_t conn_tag, const uint8_t* framed,
     Buf b;
     b.data.assign(framed, framed + len);
     c->wq.push_back(std::move(b));
+  }
+  if (!cl->wake_pending.exchange(true)) wake_fd(cl->wakefd);
+  return TPT_OK;
+}
+
+int tpt_set_caller(void* h, const uint8_t* data, uint64_t len) {
+  Client* cl = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(cl->mu);
+  cl->caller_id.assign(data, data + len);
+  return TPT_OK;
+}
+
+int tpt_register_template(void* h, uint64_t tpl_id, const uint8_t* data,
+                          uint64_t len) {
+  Client* cl = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(cl->mu);
+  cl->templates[tpl_id].assign(data, data + len);
+  return TPT_OK;
+}
+
+int tpt_send_specs(void* h, uint64_t conn_tag, const uint8_t* packed,
+                   uint64_t len) {
+  // Encode a burst of task descriptors into PushTaskRequest frames and
+  // enqueue them in ONE buffer append + one io wakeup.  Validate-then-
+  // commit like tpt_send_raw: a malformed later record must not leave
+  // earlier req_ids registered for frames never sent.
+  Client* cl = static_cast<Client*>(h);
+
+  struct Rec {
+    uint64_t req_id, seq_no;
+    int64_t wire_seq;
+    const uint8_t* tid; uint8_t tid_len;
+    const uint8_t* trace; uint64_t trace_len;
+    const std::vector<uint8_t>* tpl;
+    size_t arg_begin, arg_end;     // into `args`
+    uint64_t spec_len, body_len;
+  };
+  std::vector<Rec> recs;
+  std::vector<ArgView> args;
+  uint64_t caller_len;
+  uint64_t total = 0;
+  {
+    std::lock_guard<std::mutex> g(cl->mu);
+    caller_len = cl->caller_id.size();
+    SpecReader r{packed, packed + len};
+    while (r.ok && r.p < r.end) {
+      Rec rec{};
+      rec.req_id = r.num<uint64_t>();
+      uint64_t tpl_id = r.num<uint64_t>();
+      rec.seq_no = r.num<uint64_t>();
+      rec.wire_seq = r.num<int64_t>();
+      rec.tid_len = r.num<uint8_t>();
+      rec.tid = r.blob(rec.tid_len);
+      uint8_t flags = r.num<uint8_t>();
+      if (flags & 1) {
+        rec.trace_len = r.num<uint32_t>();
+        rec.trace = r.blob(rec.trace_len);
+      }
+      uint16_t n_args = r.num<uint16_t>();
+      rec.arg_begin = args.size();
+      for (uint16_t i = 0; r.ok && i < n_args; i++) {
+        ArgView v{};
+        v.kind = r.num<uint8_t>();
+        v.name_len = r.num<uint16_t>();
+        v.name = r.blob(v.name_len);
+        if (v.kind == 1) {
+          v.alen = r.num<uint8_t>();
+          v.a = r.blob(v.alen);
+          v.blen = r.num<uint16_t>();
+          v.b = r.blob(v.blen);
+        } else if (v.kind == 0 || v.kind == 2) {
+          v.alen = r.num<uint32_t>();
+          v.a = r.blob(v.alen);
+          v.blen = r.num<uint32_t>();
+          v.b = r.blob(v.blen);
+        } else {
+          r.ok = false;
+        }
+        args.push_back(v);
+      }
+      rec.arg_end = args.size();
+      if (!r.ok) break;
+      auto it = cl->templates.find(tpl_id);
+      if (it == cl->templates.end()) return TPT_EARG;
+      rec.tpl = &it->second;
+
+      uint64_t spec = rec.tpl->size();
+      spec += 1 + vlen(rec.tid_len) + rec.tid_len;          // task_id (1)
+      for (size_t a = rec.arg_begin; a < rec.arg_end; a++) {
+        const ArgView& v = args[a];
+        uint64_t body = arg_body_len(v);
+        if (v.name_len) {                                   // kwargs (6)
+          uint64_t entry = 1 + vlen(v.name_len) + v.name_len
+                         + 1 + vlen(body) + body;
+          spec += 1 + vlen(entry) + entry;
+        } else {                                            // args (5)
+          spec += 1 + vlen(body) + body;
+        }
+      }
+      if (rec.seq_no) spec += 1 + vlen(rec.seq_no);         // seq_no (15)
+      if (rec.trace_len)
+        spec += 2 + vlen(rec.trace_len) + rec.trace_len;    // trace_ctx (23)
+      rec.spec_len = spec;
+
+      uint64_t body = 1 + vlen(spec) + spec;                // spec (1)
+      if (caller_len) body += 1 + vlen(caller_len) + caller_len;
+      if (rec.wire_seq)                                     // wire_seq (3)
+        body += 1 + vlen(zigzag(rec.wire_seq));
+      rec.body_len = body;
+      total += 4 + 8 + body;                                // frame hdr
+      recs.push_back(rec);
+    }
+    if (!r.ok || r.p != r.end) return TPT_EARG;
+  }
+  if (recs.empty()) return TPT_OK;
+
+  Buf out;
+  out.data.reserve(total);
+  std::vector<uint8_t>& o = out.data;
+  {
+    // caller_id is only mutated before the first send; read without the
+    // lock is safe for the lifetime of this call (same for templates).
+    for (const Rec& rec : recs) {
+      uint32_t flen = uint32_t(8 + rec.body_len);
+      o.insert(o.end(), reinterpret_cast<uint8_t*>(&flen),
+               reinterpret_cast<uint8_t*>(&flen) + 4);
+      o.insert(o.end(), reinterpret_cast<const uint8_t*>(&rec.req_id),
+               reinterpret_cast<const uint8_t*>(&rec.req_id) + 8);
+      put_tag(o, 1, 2);                                     // spec
+      put_varint(o, rec.spec_len);
+      o.insert(o.end(), rec.tpl->begin(), rec.tpl->end());
+      put_bytes_field(o, 1, rec.tid, rec.tid_len);
+      for (size_t a = rec.arg_begin; a < rec.arg_end; a++) {
+        const ArgView& v = args[a];
+        uint64_t body = arg_body_len(v);
+        if (v.name_len) {
+          uint64_t entry = 1 + vlen(v.name_len) + v.name_len
+                         + 1 + vlen(body) + body;
+          put_tag(o, 6, 2);
+          put_varint(o, entry);
+          put_bytes_field(o, 1, v.name, v.name_len);
+          put_tag(o, 2, 2);
+          put_varint(o, body);
+          put_arg_body(o, v);
+        } else {
+          put_tag(o, 5, 2);
+          put_varint(o, body);
+          put_arg_body(o, v);
+        }
+      }
+      if (rec.seq_no) { put_tag(o, 15, 0); put_varint(o, rec.seq_no); }
+      if (rec.trace_len) put_bytes_field(o, 23, rec.trace, rec.trace_len);
+      if (caller_len)
+        put_bytes_field(o, 2, cl->caller_id.data(), caller_len);
+      if (rec.wire_seq) {
+        put_tag(o, 3, 0);
+        put_varint(o, zigzag(rec.wire_seq));
+      }
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(cl->mu);
+    auto it = cl->conns.find(conn_tag);
+    if (it == cl->conns.end() || it->second->closing) return TPT_ECONN;
+    for (const Rec& rec : recs) cl->inflight[rec.req_id] = conn_tag;
+    it->second->wq.push_back(std::move(out));
   }
   if (!cl->wake_pending.exchange(true)) wake_fd(cl->wakefd);
   return TPT_OK;

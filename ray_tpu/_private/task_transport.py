@@ -31,6 +31,7 @@ logger = logging.getLogger(__name__)
 
 _REC_HDR = struct.Struct("<QQiQ")  # tag, req_id, status, payload_len
 _FRAME_HDR = struct.Struct("<IQ")  # frame_len, req_id (wire framing)
+_U64 = struct.Struct("<Q")
 
 TPT_OK = 0
 TPT_ECONN = -1
@@ -57,6 +58,13 @@ class _Lib:
                                   ctypes.c_uint64]
         fast.tpt_send_raw.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                       ctypes.c_char_p, ctypes.c_uint64]
+        fast.tpt_set_caller.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64]
+        fast.tpt_register_template.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
+        fast.tpt_send_specs.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                        ctypes.c_char_p, ctypes.c_uint64]
         fast.tpt_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         blocking.tpt_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_uint64,
@@ -81,6 +89,9 @@ class _Lib:
         self.tpt_connect = fast.tpt_connect
         self.tpt_send = fast.tpt_send
         self.tpt_send_raw = fast.tpt_send_raw
+        self.tpt_set_caller = fast.tpt_set_caller
+        self.tpt_register_template = fast.tpt_register_template
+        self.tpt_send_specs = fast.tpt_send_specs
         self.tpt_close_conn = fast.tpt_close_conn
         self.tpt_poll = blocking.tpt_poll
         self.tpt_client_close = blocking.tpt_client_close
@@ -145,6 +156,7 @@ class NativeSubmitter:
         self._h = h
         self._conns: dict[str, int] = {}
         self._cbs: dict[int, object] = {}   # req_id -> cb(status, payload)
+        self._tpl_ids: set[int] = set()     # templates pushed to C
         self._req_iter = itertools.count(1)
         self._mu = threading.Lock()
         self._closed = False
@@ -201,31 +213,49 @@ class NativeSubmitter:
             self.invalidate(addr)
             self._loop.call_soon(cb, TPT_ECONN, b"")
 
-    def call_cb_batch(self, addr: str, items) -> None:
-        """Push a burst of requests to one worker in a single library
-        call: frames are built in Python (struct.pack + join) and handed
-        to C pre-framed — one queue append, one io wakeup for the whole
-        batch.  `items` is a sequence of (payload, cb)."""
+    def set_caller(self, caller_id: bytes) -> None:
+        """Bake the submitting worker's id into every encoded
+        PushTaskRequest (PushTaskRequest.caller_id)."""
+        self._l.tpt_set_caller(self._h, caller_id, len(caller_id))
+
+    def register_template(self, tpl_id: int, tpl: bytes) -> None:
+        """Register the serialized constant-field TaskSpecP prefix for
+        `tpl_id` (idempotent; cold path — once per (fn, options))."""
+        if tpl_id in self._tpl_ids:
+            return
+        self._l.tpt_register_template(self._h, tpl_id, tpl, len(tpl))
+        self._tpl_ids.add(tpl_id)
+
+    def call_spec_batch(self, addr: str, items) -> None:
+        """Push a burst of task descriptors to one worker: the library
+        splices each descriptor with its registered template into
+        TaskSpecP/PushTaskRequest wire bytes (taskrpc.cc codec) — no
+        Python serialization of the spec at all.  `items` is a sequence
+        of (desc_bytes, template, cb) where `template` is (tpl_id,
+        tpl_bytes)."""
         try:
             tag = self.connect(addr)
         except ConnectionError:
-            for _p, cb in items:   # deferred: see call_cb
+            for _d, _t, cb in items:   # deferred: see call_cb
                 self._loop.call_soon(cb, TPT_ECONN, b"")
             return
         cbs = self._cbs
         parts = []
         ids = []
-        for payload, cb in items:
+        pack = _U64.pack
+        for desc, tpl, cb in items:
+            if tpl[0] not in self._tpl_ids:
+                self.register_template(*tpl)
             req_id = next(self._req_iter)
             cbs[req_id] = cb
             ids.append(req_id)
-            parts.append(_FRAME_HDR.pack(8 + len(payload), req_id))
-            parts.append(payload)
+            parts.append(pack(req_id))
+            parts.append(desc)
         blob = b"".join(parts)
-        rc = self._l.tpt_send_raw(self._h, tag, blob, len(blob))
+        rc = self._l.tpt_send_specs(self._h, tag, blob, len(blob))
         if rc != 0:
             self.invalidate(addr)
-            for req_id, (_p, cb) in zip(ids, items):
+            for req_id, (_d, _t, cb) in zip(ids, items):
                 if cbs.pop(req_id, None) is not None:
                     self._loop.call_soon(cb, TPT_ECONN, b"")
 
@@ -368,6 +398,23 @@ class NativeReceiver:
             self._l.tpt_server_reply_raw(self._h, tag, blob, len(blob))
 
     def _exec_loop(self):
+        import os
+        prof_dir = os.environ.get("RAY_TPU_PROFILE_EXEC")
+        if prof_dir:
+            # Debug aid: profile the execution thread, dumping stats
+            # every ~5s (workers exit via os._exit, so atexit never runs).
+            import cProfile
+            pr = cProfile.Profile()
+            path = f"{prof_dir}/exec-{os.getpid()}.prof"
+            last = [time.monotonic()]
+
+            def maybe_dump():
+                if time.monotonic() - last[0] > 5.0:
+                    last[0] = time.monotonic()
+                    pr.dump_stats(path)
+            pr.enable()
+        else:
+            maybe_dump = None
         cap = self.POP_BUF
         buf = ctypes.create_string_buffer(cap)
         used = ctypes.c_uint64()
@@ -381,6 +428,8 @@ class NativeReceiver:
             if n <= 0:
                 continue
             raw = ctypes.string_at(buf, used.value)
+            if maybe_dump is not None:
+                maybe_dump()
             with self.batch_scope():
                 for tag, req_id, _status, payload in _unpack_records(
                         raw, used.value):
