@@ -106,6 +106,11 @@ struct Conn {
   std::deque<Buf> wq;          // guarded by endpoint mu
   bool want_write = false;     // io thread only
   bool closing = false;        // guarded by endpoint mu
+  // Exactly one thread may write the socket at a time (both guarded by
+  // endpoint mu): an enqueuer doing an inline write, or the io thread
+  // flushing with the lock released.
+  bool inline_writing = false;
+  bool io_writing = false;
 };
 
 void frame_into(std::vector<uint8_t>& out, uint64_t req_id,
@@ -208,10 +213,73 @@ struct Endpoint {
     epoll_ctl(epfd, EPOLL_CTL_MOD, c->fd, &ev);
   }
 
-  // io thread only.  Caller must NOT hold mu.
+  // Enqueue `b` on `conn_tag`, writing the socket INLINE from the calling
+  // thread when the connection is idle (no queued frames, no io-thread
+  // write pending): on a one-core host the eventfd wake costs a context
+  // switch per hop, and the submitting thread writing its own burst
+  // removes it.  Falls back to queue + wake whenever the io thread (or
+  // another enqueuer) owns the socket.  Returns TPT_ECONN if the conn is
+  // gone; sets *wake if the io thread must be woken.
+  int enqueue_or_write(uint64_t conn_tag, Buf&& b, bool* wake) {
+    Conn* c;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = conns.find(conn_tag);
+      if (it == conns.end() || it->second->closing) return TPT_ECONN;
+      c = it->second;
+      if (!c->wq.empty() || c->want_write || c->inline_writing
+          || c->io_writing) {
+        c->wq.push_back(std::move(b));
+        *wake = true;
+        return TPT_OK;
+      }
+      c->inline_writing = true;
+    }
+    std::deque<Buf> q;
+    q.push_back(std::move(b));
+    bool blocked = false;
+    bool ok = flush_bufs(c->fd, q, &blocked);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      c->inline_writing = false;
+      if (!ok) {
+        // Socket error: let the io thread run its failure path (it owns
+        // conn teardown and in-flight accounting).
+        c->closing = true;
+        *wake = true;
+        return TPT_OK;
+      }
+      if (!q.empty()) {
+        // Partial write: remainder goes to the FRONT (frames enqueued
+        // while we were writing must stay behind it); the io thread
+        // retries and arms EPOLLOUT on its own EAGAIN.
+        for (auto qit = q.rbegin(); qit != q.rend(); ++qit)
+          c->wq.push_front(std::move(*qit));
+        *wake = true;
+      } else if (!c->wq.empty()) {
+        *wake = true;  // someone enqueued behind us while we wrote
+      }
+      if (c->closing) {
+        // The io thread saw a read error mid-write and deferred the
+        // teardown to us: wake it so the conn is reaped promptly.
+        *wake = true;
+      }
+    }
+    return TPT_OK;
+  }
+
+  // io thread only.  Caller must NOT hold mu.  If an inline writer owns
+  // the socket right now, the conn is only MARKED closing — closing the
+  // fd / freeing the Conn under a concurrent writev would be a
+  // use-after-free; a later flush_all pass (the writer wakes us) reaps
+  // it once the writer is out.
   void destroy(Conn* c) {
     {
       std::lock_guard<std::mutex> g(mu);
+      if (c->inline_writing) {
+        c->closing = true;
+        return;
+      }
       conns.erase(c->tag);
     }
     epoll_ctl(epfd, EPOLL_CTL_DEL, c->fd, nullptr);
@@ -229,8 +297,12 @@ struct Endpoint {
       std::lock_guard<std::mutex> g(mu);
       for (auto& kv : conns) {
         Conn* c = kv.second;
+        // Skip BEFORE the closing check: a conn marked closing while an
+        // inline writer holds the socket is reaped on a later pass.
+        if (c->inline_writing) continue;
         if (c->closing) { dead.push_back(c); continue; }
         if (!c->wq.empty()) {
+          c->io_writing = true;
           work.emplace_back(c, std::move(c->wq));
           c->wq.clear();
         }
@@ -239,20 +311,27 @@ struct Endpoint {
     for (auto& wc : work) {
       Conn* c = wc.first;
       bool blocked = false;
-      if (!flush_bufs(c->fd, wc.second, &blocked)) {
+      bool ok = flush_bufs(c->fd, wc.second, &blocked);
+      bool changed = false;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        c->io_writing = false;
+        if (ok && !wc.second.empty()) {
+          // Unsent remainder goes back to the FRONT (frames enqueued by
+          // Python while we were flushing must stay behind it).
+          for (auto it = wc.second.rbegin(); it != wc.second.rend(); ++it)
+            c->wq.push_front(std::move(*it));
+        }
+        if (ok) {
+          changed = (c->want_write != blocked);
+          c->want_write = blocked;   // under mu: inline writers read it
+        }
+      }
+      if (!ok) {
         dead.push_back(c);
         continue;
       }
-      if (!wc.second.empty()) {
-        // Unsent remainder goes back to the FRONT (frames enqueued by
-        // Python while we were flushing must stay behind it).
-        std::lock_guard<std::mutex> g(mu);
-        for (auto it = wc.second.rbegin(); it != wc.second.rend(); ++it)
-          c->wq.push_front(std::move(*it));
-      }
-      bool was = c->want_write;
-      c->want_write = blocked;
-      if (blocked != was) rearm(c);
+      if (changed) rearm(c);
     }
     return dead;
   }
@@ -273,6 +352,16 @@ struct Client : Endpoint {
   std::mutex cmu;
   std::condition_variable ccv;
   std::deque<Record> completions;
+  // Completion signal consumable by an event loop (counting eventfd):
+  // written once per delivered batch so a Python asyncio loop can
+  // add_reader() it and drain completions with NO intermediate poller
+  // thread (one fewer context switch per batch).
+  int cfd = -1;
+
+  void signal_completions() {
+    ccv.notify_one();
+    if (cfd >= 0) wake_fd(cfd);
+  }
 
   void push_completion(uint64_t req_id, int32_t status, const uint8_t* p,
                        uint64_t len) {
@@ -284,7 +373,7 @@ struct Client : Endpoint {
       std::lock_guard<std::mutex> g(cmu);
       completions.push_back(std::move(r));
     }
-    ccv.notify_one();
+    signal_completions();
   }
 
   // io thread only, mu NOT held.
@@ -346,7 +435,7 @@ struct Client : Endpoint {
             std::lock_guard<std::mutex> g(cmu);
             for (auto& r : got) completions.push_back(std::move(r));
           }
-          ccv.notify_one();
+          signal_completions();
         }
         if (!ok) fail_conn(c);
       }
@@ -564,7 +653,8 @@ int tpt_client_new(void** out) {
   Client* c = new Client;
   c->epfd = epoll_create1(0);
   c->wakefd = eventfd(0, EFD_NONBLOCK);
-  if (c->epfd < 0 || c->wakefd < 0) { delete c; return TPT_ESYS; }
+  c->cfd = eventfd(0, EFD_NONBLOCK);
+  if (c->epfd < 0 || c->wakefd < 0 || c->cfd < 0) { delete c; return TPT_ESYS; }
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.u64 = 0;
@@ -661,6 +751,10 @@ int tpt_send_raw(void* h, uint64_t conn_tag, const uint8_t* framed,
   }
   if (!cl->wake_pending.exchange(true)) wake_fd(cl->wakefd);
   return TPT_OK;
+}
+
+int tpt_completion_fd(void* h) {
+  return static_cast<Client*>(h)->cfd;
 }
 
 int tpt_set_caller(void* h, const uint8_t* data, uint64_t len) {
@@ -819,13 +913,21 @@ int tpt_send_specs(void* h, uint64_t conn_tag, const uint8_t* packed,
     }
   }
   {
+    // Register in-flight BEFORE the frame can hit the wire: a reply that
+    // raced an inline write would otherwise leave a stale entry.
     std::lock_guard<std::mutex> g(cl->mu);
     auto it = cl->conns.find(conn_tag);
     if (it == cl->conns.end() || it->second->closing) return TPT_ECONN;
     for (const Rec& rec : recs) cl->inflight[rec.req_id] = conn_tag;
-    it->second->wq.push_back(std::move(out));
   }
-  if (!cl->wake_pending.exchange(true)) wake_fd(cl->wakefd);
+  bool wake = false;
+  int rc = cl->enqueue_or_write(conn_tag, std::move(out), &wake);
+  if (rc != TPT_OK) {
+    std::lock_guard<std::mutex> g(cl->mu);
+    for (const Rec& rec : recs) cl->inflight.erase(rec.req_id);
+    return rc;
+  }
+  if (wake && !cl->wake_pending.exchange(true)) wake_fd(cl->wakefd);
   return TPT_OK;
 }
 
@@ -876,6 +978,7 @@ void tpt_client_close(void* h) {
   }
   close(cl->epfd);
   close(cl->wakefd);
+  if (cl->cfd >= 0) close(cl->cfd);
   delete cl;
 }
 
@@ -949,19 +1052,17 @@ int tpt_server_reply(void* h, uint64_t conn_tag, uint64_t req_id,
 
 int tpt_server_reply_raw(void* h, uint64_t conn_tag, const uint8_t* framed,
                          uint64_t len) {
-  // Batched replies: one library call, one queue append and one io wakeup
-  // for every reply produced by an execution batch (the per-reply eventfd
-  // write costs a context switch on small hosts).
+  // Batched replies: one library call for every reply produced by an
+  // execution batch, written inline by the executor thread when the
+  // connection is idle (eventfd wake + io-thread handoff costs a context
+  // switch per batch on small hosts).
   Server* s = static_cast<Server*>(h);
-  {
-    std::lock_guard<std::mutex> g(s->mu);
-    auto it = s->conns.find(conn_tag);
-    if (it == s->conns.end() || it->second->closing) return TPT_ECONN;
-    Buf b;
-    b.data.assign(framed, framed + len);
-    it->second->wq.push_back(std::move(b));
-  }
-  if (!s->wake_pending.exchange(true)) wake_fd(s->wakefd);
+  Buf b;
+  b.data.assign(framed, framed + len);
+  bool wake = false;
+  int rc = s->enqueue_or_write(conn_tag, std::move(b), &wake);
+  if (rc != TPT_OK) return rc;
+  if (wake && !s->wake_pending.exchange(true)) wake_fd(s->wakefd);
   return TPT_OK;
 }
 

@@ -89,18 +89,18 @@ class _ObjectState:
 
 class _BatchWaiter:
     """One shared completion waiter for a bulk get(): counts outstanding
-    objects and wakes once — instead of a coroutine + timer per ref.  An
-    errored object wakes the waiter early.  `done` may fire from the
-    event loop (task completions) or a user thread (put publications),
-    hence the lock and the thread-aware wake."""
+    objects and wakes the BLOCKED CALLER THREAD directly — no coroutine,
+    no timer, no loop wake to start or finish a wait.  An errored object
+    wakes the waiter early.  `done` may fire from the event loop (task
+    completions) or a user thread (put publications); threading.Event is
+    safe from both."""
 
-    __slots__ = ("remaining", "error", "event", "io", "lock")
+    __slots__ = ("remaining", "error", "event", "lock")
 
-    def __init__(self, io):
+    def __init__(self):
         self.remaining = 0
         self.error: BaseException | None = None
-        self.event = asyncio.Event()
-        self.io = io
+        self.event = threading.Event()
         self.lock = threading.Lock()
 
     def done(self, st: "_ObjectState"):
@@ -110,10 +110,7 @@ class _BatchWaiter:
                 self.error = st.error
             fire = self.remaining <= 0 or st.error is not None
         if fire:
-            if threading.get_ident() == self.io.ident:
-                self.event.set()
-            else:
-                self.io.loop.call_soon_threadsafe(self.event.set)
+            self.event.set()
 
 
 @dataclass
@@ -597,9 +594,12 @@ class CoreWorker:
             self.store.seal(oid)
             st.locations.add(self.node_id.hex())
         # Publication order: value/locations first, THEN pending=False —
-        # the caller-thread get() fast path reads states without the loop,
-        # so `pending` is the publish flag (GIL store ordering suffices).
-        st.pending = False
+        # the caller-thread get() fast path reads states without the
+        # loop, so `pending` is the publish flag.  The flip is under
+        # _obj_lock: _wait_owned registration checks pending under the
+        # same lock (see _signal_ready).
+        with self._obj_lock:
+            st.pending = False
         self._signal_ready(oid, st)
 
     def _signal_ready(self, oid: ObjectID, st: _ObjectState):
@@ -610,9 +610,16 @@ class CoreWorker:
                 st.event.set()
             else:
                 self.io.loop.call_soon_threadsafe(st.event.set)
-        ws = st.waiters
+        ws = None
+        if st.waiters:
+            # Pop under the same lock that guards registration: a get()
+            # on another thread is either already in the list (we
+            # deliver) or will see pending=False under the lock and
+            # self-deliver — exactly once either way.
+            with self._obj_lock:
+                ws = st.waiters
+                st.waiters = None
         if ws:
-            st.waiters = None
             for w in ws:
                 w.done(st)
 
@@ -644,7 +651,7 @@ class CoreWorker:
                 if st is not None and st.pending:
                     pending_refs.append(r)
         if pending_refs:
-            self.io.run(self._wait_owned(pending_refs, timeout))
+            self._wait_owned(pending_refs, deadline)
         values = []
         slow: list = []          # (index, ref) pairs for the general path
         for r in refs:
@@ -665,47 +672,58 @@ class CoreWorker:
                 values[i] = v
         return values[0] if single else values
 
-    async def _wait_owned(self, refs, timeout):
-        """Block until every owned ref in `refs` has completed (value,
-        location, or error — resolution happens on the calling thread).
-        One shared waiter serves the whole batch; an errored object
-        wakes it early so a failed task surfaces before stragglers
-        finish."""
-        waiter = _BatchWaiter(self.io)
+    def _wait_owned(self, refs, deadline):
+        """Block the CALLING thread until every owned ref in `refs` has
+        completed (value, location, or error — resolution happens back
+        in get()).  One shared waiter serves the whole batch;
+        registration races with completions (loop thread, put threads)
+        are settled by the remove-to-deliver dance below.  An errored
+        object wakes the waiter early so a failed task surfaces before
+        stragglers finish."""
+        waiter = _BatchWaiter()
+        registered = []
         for r in refs:
             st = self.objects.get(r.id)
             if st is None or not st.pending:
                 continue
             with waiter.lock:
                 waiter.remaining += 1
-            if st.waiters is None:
-                st.waiters = []
-            st.waiters.append(waiter)
-            if not st.pending:
-                # Raced with a caller-thread publication (put path): make
-                # the notification exactly-once — whoever removes the
-                # waiter from the list delivers it.
-                try:
-                    st.waiters.remove(waiter)
-                except (ValueError, AttributeError, TypeError):
-                    pass     # _signal_ready already took the list
-                else:
-                    waiter.done(st)
-        if waiter.remaining <= 0 and waiter.error is None:
-            return
-        deadline = None if timeout is None else \
-            asyncio.get_running_loop().time() + timeout
-        while waiter.remaining > 0 and waiter.error is None:
-            wait = None if deadline is None else \
-                deadline - asyncio.get_running_loop().time()
-            if wait is not None and wait <= 0:
-                raise RayTpuTimeoutError("get() timed out")
-            try:
-                await asyncio.wait_for(waiter.event.wait(),
-                                       None if wait is None else wait)
-            except asyncio.TimeoutError:
-                raise RayTpuTimeoutError("get() timed out") from None
-            waiter.event.clear()
+            # Registration is atomic with the pending check under
+            # _obj_lock: publication flips `pending` and pops the list
+            # under the same lock, so the waiter is either delivered by
+            # the publisher or self-delivered here — never both, never
+            # neither.
+            with self._obj_lock:
+                if st.pending:
+                    if st.waiters is None:
+                        st.waiters = []
+                    st.waiters.append(waiter)
+                    registered.append(st)
+                    continue
+            waiter.done(st)   # completed before we got in
+        try:
+            while waiter.remaining > 0 and waiter.error is None:
+                waiter.event.clear()
+                if waiter.remaining <= 0 or waiter.error is not None:
+                    break        # fired between the checks and the clear
+                left = None if deadline is None else \
+                    deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    raise RayTpuTimeoutError("get() timed out")
+                if not waiter.event.wait(left):
+                    raise RayTpuTimeoutError("get() timed out")
+        finally:
+            if waiter.remaining > 0:
+                # Timed out (or errored early) with objects still
+                # pending: unregister so a polling caller doesn't leak a
+                # waiter per attempt into long-lived object states.
+                with self._obj_lock:
+                    for st in registered:
+                        if st.waiters:
+                            try:
+                                st.waiters.remove(waiter)
+                            except ValueError:
+                                pass
         # An early error stops the wait; the caller-thread resolution
         # (or the per-ref fallback path) raises it in ref order.
 
@@ -1128,6 +1146,14 @@ class CoreWorker:
                 tpl[1][0], 0, 0, task_id.binary(), trace_blob,
                 pargs, pkwargs)
         self.tasks[task_id] = pending
+        # Zero-hop dispatch: a dependency-free task whose scheduling key
+        # already holds a lease with a free slot goes to the wire from
+        # THIS thread — no event-loop wake on submit (the dominant cost
+        # of a sync round trip on a one-core host).
+        if pending.payload is not None and not pins and self._native_sub:
+            sched = self._lease_cache.get(pending.sched_key)
+            if sched is not None and sched.try_direct(pending, spec):
+                return True
         self._enqueue_fast(("task", task_id))
         return True
 
@@ -1564,7 +1590,8 @@ class CoreWorker:
                     st.inline = (payload, meta)
                 else:  # "location"
                     st.locations.add(payload)
-            st.pending = False   # publish flag: set last (see get())
+            with self._obj_lock:
+                st.pending = False   # publish flag: set last (see get())
             self._signal_ready(oid, st)
         self._release_arg_pins(spec)
 
@@ -1573,7 +1600,8 @@ class CoreWorker:
             oid = ObjectID.for_return(spec.task_id, i)
             st = self.objects.setdefault(oid, _ObjectState())
             st.error = exc
-            st.pending = False   # publish flag: set last (see get())
+            with self._obj_lock:
+                st.pending = False   # publish flag: set last (see get())
             self._signal_ready(oid, st)
         self._release_arg_pins(spec)
 
@@ -2412,6 +2440,11 @@ class _KeyScheduler:
         self.leases: list = []           # granted leases (dicts)
         self.pending_leases = 0          # in-flight LeaseWorker RPCs
         self._reaper = None
+        # Guards lease membership + inflight counts: the submitting
+        # thread may claim a slot directly (try_direct) while the loop
+        # dispatches/reaps.  Loop-side sections are short and
+        # uncontended in the common case.
+        self.tlock = threading.Lock()
 
     @property
     def held(self):
@@ -2433,12 +2466,47 @@ class _KeyScheduler:
         self.queue.append((spec, None, False))
         self._pump(batches)
 
+    def try_direct(self, pending, spec) -> bool:
+        """Caller-thread dispatch for a dependency-free native task:
+        claim a free lease slot under tlock and write the frame from
+        THIS thread (the C layer writevs inline on an idle connection)
+        — the submit never touches the event loop.  Safe because a task
+        with no ref args can never wait on anything, so putting it
+        ahead of still-queued submissions cannot create a waits-on
+        cycle (see _pump's dependency-safety sketch)."""
+        worker = self.worker
+        sub = worker._native_sub
+        if not sub:
+            return False
+        with self.tlock:
+            if self.queue:
+                return False     # loop-side work queued: keep FIFO
+            best = None
+            for lease in self.leases:
+                if lease["inflight"] < self.DEPTH and (
+                        best is None
+                        or lease["inflight"] < best["inflight"]):
+                    best = lease
+            if best is None:
+                return False
+            naddr = worker._native_addrs.get(best["worker_address"])
+            if not naddr:
+                return False
+            best["inflight"] += 1
+        pending.worker_address = best["worker_address"]
+        cb = (lambda status, data: self._on_push_done(
+            spec, None, best, status, data))
+        sub.call_spec_batch(naddr, [(pending.payload, pending.template,
+                                     cb)])
+        return True
+
     async def drain(self):
         if self._reaper is not None:
             self._reaper.cancel()
             await asyncio.gather(self._reaper, return_exceptions=True)
             self._reaper = None
-        leases, self.leases = self.leases, []
+        with self.tlock:
+            leases, self.leases = self.leases, []
         for lease in leases:
             await self.worker._return_lease(lease)
 
@@ -2463,16 +2531,17 @@ class _KeyScheduler:
         while self.queue:
             spec, sink, exclusive = self.queue[0]
             cap = 1 if exclusive else self.DEPTH
-            best = None
-            for lease in self.leases:
-                if lease["inflight"] < cap and (
-                        best is None
-                        or lease["inflight"] < best["inflight"]):
-                    best = lease
-            if best is None or (exclusive and best["inflight"] > 0):
-                break
+            with self.tlock:
+                best = None
+                for lease in self.leases:
+                    if lease["inflight"] < cap and (
+                            best is None
+                            or lease["inflight"] < best["inflight"]):
+                        best = lease
+                if best is None or (exclusive and best["inflight"] > 0):
+                    break
+                best["inflight"] += 1
             self.queue.popleft()
-            best["inflight"] += 1
             self._dispatch(spec, sink, best, batches)
         if flush_here and batches:
             sub = self.worker._native_sub
@@ -2518,8 +2587,11 @@ class _KeyScheduler:
         worker = self.worker
         if status != 0:
             worker.pool.invalidate(lease["worker_address"])
-            if lease in self.leases:
-                self.leases.remove(lease)
+            with self.tlock:
+                dead = lease in self.leases
+                if dead:
+                    self.leases.remove(lease)
+            if dead:
                 asyncio.ensure_future(
                     worker._return_lease(lease, kill=True))
             self._deliver(spec, sink, None, _RetryableSubmitError(
@@ -2527,9 +2599,10 @@ class _KeyScheduler:
                 lease.get("node_id")))
             self._pump()
             return
-        lease["inflight"] -= 1
-        if lease["inflight"] == 0:
-            lease["idle_since"] = time.monotonic()
+        with self.tlock:
+            lease["inflight"] -= 1
+            if lease["inflight"] == 0:
+                lease["idle_since"] = time.monotonic()
         try:
             reply = spec_codec.reply_from_wire(data)
         except BaseException as e:  # noqa: BLE001
@@ -2599,16 +2672,20 @@ class _KeyScheduler:
             reply = await self.worker._push_on_lease(spec, lease)
         except Exception as e:
             self.worker.pool.invalidate(lease["worker_address"])
-            if lease in self.leases:
-                self.leases.remove(lease)
+            with self.tlock:
+                dead = lease in self.leases
+                if dead:
+                    self.leases.remove(lease)
+            if dead:
                 await self.worker._return_lease(lease, kill=True)
             self._deliver(spec, sink, None, _RetryableSubmitError(
                 f"worker died: {e}", lease.get("node_id")))
             self._pump()
             return
-        lease["inflight"] -= 1
-        if lease["inflight"] == 0:
-            lease["idle_since"] = time.monotonic()
+        with self.tlock:
+            lease["inflight"] -= 1
+            if lease["inflight"] == 0:
+                lease["idle_since"] = time.monotonic()
         self._deliver(spec, sink, reply, None)
         if self._reaper is None:
             self._reaper = asyncio.ensure_future(self._reap_idle())
@@ -2702,7 +2779,8 @@ class _KeyScheduler:
         lease["node_id"] = node.node_id
         lease["idle_since"] = time.monotonic()
         lease["inflight"] = 0
-        self.leases.append(lease)
+        with self.tlock:
+            self.leases.append(lease)
         if self._reaper is None:
             self._reaper = asyncio.ensure_future(self._reap_idle())
         self._pump()
@@ -2712,11 +2790,15 @@ class _KeyScheduler:
             while True:
                 await asyncio.sleep(self.IDLE_TTL / 2)
                 now = time.monotonic()
-                expire = [l for l in self.leases
-                          if l["inflight"] == 0
-                          and now - l["idle_since"] > self.IDLE_TTL]
+                with self.tlock:
+                    # Remove under the lock BEFORE returning: a direct
+                    # dispatcher must never claim a lease being reaped.
+                    expire = [l for l in self.leases
+                              if l["inflight"] == 0
+                              and now - l["idle_since"] > self.IDLE_TTL]
+                    for lease in expire:
+                        self.leases.remove(lease)
                 for lease in expire:
-                    self.leases.remove(lease)
                     await self.worker._return_lease(lease)
                 if not self.leases and not self.queue \
                         and not self.pending_leases:

@@ -35,7 +35,7 @@ def _rand_bytes(n: int) -> bytes:
 
 class BaseID:
     SIZE = 20
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, binary: bytes):
         if not isinstance(binary, bytes) or len(binary) != self.SIZE:
@@ -43,6 +43,9 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {binary!r}"
             )
         self._bytes = binary
+        # IDs key every hot dict (object table, pending tasks); caching
+        # the hash skips a hash(bytes) call per lookup.
+        self._hash = hash(binary)
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -66,7 +69,7 @@ class BaseID:
         return self._bytes == b"\x00" * self.SIZE
 
     def __hash__(self):
-        return hash(self._bytes)
+        return self._hash
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

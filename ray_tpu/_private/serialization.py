@@ -102,10 +102,15 @@ def _fast_picklable(v, depth: int = 4) -> bool:
             return all(_fast_picklable(k, d) and _fast_picklable(x, d)
                        for k, x in v.items())
     mod = getattr(t, "__module__", "")
-    if mod.split(".", 1)[0] in ("numpy", "jaxlib", "jax"):
-        # numpy/jax arrays and scalars live in importable modules and
+    if mod.split(".", 1)[0] in ("numpy", "jaxlib"):
+        # numpy/jaxlib arrays and scalars live in importable modules and
         # pickle by reference + raw buffers under both picklers —
-        # except object-dtype arrays, whose ELEMENTS are arbitrary.
+        # except object-dtype arrays (elements are arbitrary) and
+        # callable wrappers like np.vectorize, whose CONTENTS cloudpickle
+        # ships by value but C pickle would ship as a dangling
+        # by-reference to the driver's __main__.
+        if callable(v):
+            return False
         dt = getattr(v, "dtype", None)
         if dt is not None and getattr(dt, "hasobject", False):
             return False

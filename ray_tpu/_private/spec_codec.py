@@ -45,6 +45,9 @@ _BHB = struct.Struct("<BHB")     # kind, name_len(0), id_len
 _NO_TRACE = b"\x00"
 _HAS_TRACE = b"\x01"
 _EMPTY_U32 = _U32.pack(0)
+# Shared immutable-by-convention instance for the default {CPU: 1} demand
+# (the worker only READS spec.resources).
+_ONE_CPU = Resources(cpu=1.0, tpu=0.0, memory=0.0, custom={})
 
 
 def pack_desc(tpl_id: int, seq_no: int, wire_seq: int, tid: bytes,
@@ -164,10 +167,14 @@ def push_request_from_wire(payload: bytes):
     kw = s.kwargs
     d["kwargs"] = ({k: _arg_fast(v) for k, v in kw.items()} if kw else {})
     d["num_returns"] = s.num_returns or 1
-    amounts = dict(s.resources.amounts)
-    d["resources"] = Resources(
-        cpu=amounts.pop("CPU", 0.0), tpu=amounts.pop("TPU", 0.0),
-        memory=amounts.pop("memory", 0.0), custom=amounts)
+    amounts = s.resources.amounts
+    if len(amounts) == 1 and amounts.get("CPU") == 1.0:
+        d["resources"] = _ONE_CPU    # the overwhelmingly common demand
+    else:
+        amounts = dict(amounts)
+        d["resources"] = Resources(
+            cpu=amounts.pop("CPU", 0.0), tpu=amounts.pop("TPU", 0.0),
+            memory=amounts.pop("memory", 0.0), custom=amounts)
     d["max_retries"] = s.max_retries
     d["retry_exceptions"] = s.retry_exceptions
     d["owner_address"] = s.owner_address

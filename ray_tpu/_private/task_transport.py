@@ -22,6 +22,7 @@ from __future__ import annotations
 import contextlib
 import ctypes
 import logging
+import os
 import struct
 import threading
 import time
@@ -70,6 +71,8 @@ class _Lib:
                                       ctypes.c_uint64,
                                       ctypes.POINTER(ctypes.c_uint64),
                                       ctypes.c_int]
+        fast.tpt_completion_fd.argtypes = [ctypes.c_void_p]
+        fast.tpt_completion_fd.restype = ctypes.c_int
         blocking.tpt_client_close.argtypes = [ctypes.c_void_p]
         fast.tpt_server_new.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                         ctypes.POINTER(ctypes.c_void_p),
@@ -90,6 +93,7 @@ class _Lib:
         self.tpt_send = fast.tpt_send
         self.tpt_send_raw = fast.tpt_send_raw
         self.tpt_set_caller = fast.tpt_set_caller
+        self.tpt_completion_fd = fast.tpt_completion_fd
         self.tpt_register_template = fast.tpt_register_template
         self.tpt_send_specs = fast.tpt_send_specs
         self.tpt_close_conn = fast.tpt_close_conn
@@ -160,9 +164,16 @@ class NativeSubmitter:
         self._req_iter = itertools.count(1)
         self._mu = threading.Lock()
         self._closed = False
-        self._poller = threading.Thread(
-            target=self._poll_loop, daemon=True, name="tpt-poll")
-        self._poller.start()
+        # Completion delivery: the loop watches the library's completion
+        # eventfd directly and drains batches inline — no poller thread,
+        # no call_soon_threadsafe handoff (one fewer context switch per
+        # completion batch on a one-core host).
+        self._cap = self.POLL_BUF
+        self._buf = ctypes.create_string_buffer(self._cap)
+        self._used = ctypes.c_uint64()
+        self._cfd = self._l.tpt_completion_fd(self._h)
+        loop.call_soon_threadsafe(
+            loop.add_reader, self._cfd, self._drain_completions)
 
     # -- connection management -------------------------------------------
 
@@ -232,12 +243,14 @@ class NativeSubmitter:
         TaskSpecP/PushTaskRequest wire bytes (taskrpc.cc codec) — no
         Python serialization of the spec at all.  `items` is a sequence
         of (desc_bytes, template, cb) where `template` is (tpl_id,
-        tpl_bytes)."""
+        tpl_bytes).  Callable from the loop OR a submitting thread
+        (zero-hop dispatch); failure callbacks land on the loop either
+        way."""
         try:
             tag = self.connect(addr)
         except ConnectionError:
             for _d, _t, cb in items:   # deferred: see call_cb
-                self._loop.call_soon(cb, TPT_ECONN, b"")
+                self._loop.call_soon_threadsafe(cb, TPT_ECONN, b"")
             return
         cbs = self._cbs
         parts = []
@@ -257,7 +270,7 @@ class NativeSubmitter:
             self.invalidate(addr)
             for req_id, (_d, _t, cb) in zip(ids, items):
                 if cbs.pop(req_id, None) is not None:
-                    self._loop.call_soon(cb, TPT_ECONN, b"")
+                    self._loop.call_soon_threadsafe(cb, TPT_ECONN, b"")
 
     def call(self, addr: str, payload: bytes):
         """Awaitable variant: returns an asyncio future on the owning
@@ -277,48 +290,61 @@ class NativeSubmitter:
 
     # -- completion pump --------------------------------------------------
 
-    def _poll_loop(self):
-        cap = self.POLL_BUF
-        buf = ctypes.create_string_buffer(cap)
-        used = ctypes.c_uint64()
-        while not self._closed:
-            n = self._l.tpt_poll(self._h, buf, cap,
-                                 ctypes.byref(used), 200)
+    def _drain_completions(self):
+        """add_reader callback: drain every queued completion batch and
+        run callbacks inline (we ARE on the owning loop)."""
+        try:
+            os.read(self._cfd, 8)   # clear the counting eventfd
+        except (BlockingIOError, OSError):
+            pass
+        pops = self._cbs.pop
+        while not self._closed and self._h is not None:
+            n = self._l.tpt_poll(self._h, self._buf, self._cap,
+                                 ctypes.byref(self._used), 0)
             if n == TPT_EBUF:
                 # Oversized head record: grow and retry (the bigger
                 # buffer sticks, so growth is amortized).
-                cap = max(cap * 2, int(used.value))
-                buf = ctypes.create_string_buffer(cap)
+                self._cap = max(self._cap * 2, int(self._used.value))
+                self._buf = ctypes.create_string_buffer(self._cap)
                 continue
             if n <= 0:
-                continue
-            batch = []
+                return
             # string_at copies only the used prefix (buf.raw would copy
             # the whole 4MB buffer per batch).
-            raw = ctypes.string_at(buf, used.value)
+            raw = ctypes.string_at(self._buf, self._used.value)
             for tag, _rid, status, payload in _unpack_records(
-                    raw, used.value):
-                cb = self._cbs.pop(tag, None)
+                    raw, self._used.value):
+                cb = pops(tag, None)
                 if cb is not None:
-                    batch.append((cb, status, payload))
-            if batch:
-                try:
-                    self._loop.call_soon_threadsafe(self._resolve, batch)
-                except RuntimeError:
-                    return  # loop closed during shutdown
-
-    @staticmethod
-    def _resolve(batch):
-        for cb, status, payload in batch:
-            try:
-                cb(status, payload)
-            except Exception:
-                logger.exception("native completion callback failed")
+                    try:
+                        cb(status, payload)
+                    except Exception:
+                        logger.exception(
+                            "native completion callback failed")
 
     def close(self):
+        """Tear down from any thread.  The reader must be detached ON
+        the loop (and any in-flight _drain_completions finished — the
+        loop is single-threaded, so once _detach has run no drain can be
+        executing) BEFORE the C client is freed, else the loop races a
+        use-after-free."""
         self._closed = True
-        if self._poller.is_alive():
-            self._poller.join(timeout=1.0)
+        detached = threading.Event()
+
+        def _detach():
+            try:
+                self._loop.remove_reader(self._cfd)
+            except Exception:
+                pass
+            detached.set()
+        try:
+            if self._loop.is_closed():
+                detached.set()
+            else:
+                self._loop.call_soon_threadsafe(_detach)
+        except RuntimeError:
+            detached.set()   # loop already closed: no reader can run
+        detached.wait(2.0)
         self._l.tpt_client_close(self._h)
         self._h = None
 
