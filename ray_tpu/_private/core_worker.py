@@ -187,6 +187,7 @@ class CoreWorker:
         self._opts_cache: dict = {}       # id(opts) -> (opts, invariants)
         self._tpl_ids = itertools.count(1)  # native spec-template ids
         self._tpl_content: dict = {}      # template bytes -> (id, bytes)
+        self._pending_actor_reg: set = set()  # async registrations in flight
         # Loop-tick dispatch coalescing: pumps triggered by a completion
         # batch share one native flush per worker per tick.
         self._tick_batches: dict = {}
@@ -394,7 +395,14 @@ class CoreWorker:
         try:
             spec, caller, wire_seq = spec_codec.push_request_from_wire(
                 payload)
-            if spec.actor_id is not None and not spec.actor_creation:
+            if spec.actor_creation:
+                # Creation runs on the MAIN exec thread like the RPC path
+                # (actor __init__ and methods must share a thread —
+                # user code may keep thread-local state).
+                self.actor_id = spec.actor_id
+                self.exec_queue.put(
+                    (spec, self._native_done_sink(reply), None))
+            elif spec.actor_id is not None:
                 self._enqueue_actor_native(spec, caller, wire_seq, reply)
             else:
                 self._run_one_native(spec, reply)
@@ -1651,10 +1659,31 @@ class CoreWorker:
 
     def create_actor(self, cls, args, kwargs, opts) -> ActorID:
         actor_id = ActorID.of(self.job_id or JobID.nil())
-        # May differ from actor_id when get_if_exists resolves to an
-        # existing named actor.
-        return self.io.run(
-            self._create_actor_async(actor_id, cls, args, kwargs, opts))
+        if opts.get("name") or opts.get("get_if_exists"):
+            # Named actors need the registration reply (it may resolve to
+            # an existing actor's id).
+            return self.io.run(
+                self._create_actor_async(actor_id, cls, args, kwargs, opts))
+        # Anonymous actors register ASYNCHRONOUSLY (reference:
+        # core_worker actor creation is non-blocking; an actor storm must
+        # pipeline registrations, not serialize on one GCS round trip per
+        # handle).  The handle is immediately usable: method submission
+        # waits in _resolve_actor while the id is in _pending_actor_reg.
+        self._pending_actor_reg.add(actor_id)
+        asyncio.run_coroutine_threadsafe(
+            self._register_actor_bg(actor_id, cls, args, kwargs, opts),
+            self.io.loop)
+        return actor_id
+
+    async def _register_actor_bg(self, actor_id, cls, args, kwargs, opts):
+        try:
+            await self._create_actor_async(actor_id, cls, args, kwargs,
+                                           opts)
+        except Exception:
+            # Surfaces as ActorDiedError("unknown actor") at first use.
+            logger.exception("background actor registration failed")
+        finally:
+            self._pending_actor_reg.discard(actor_id)
 
     async def _create_actor_async(self, actor_id, cls, args, kwargs, opts):
         from ray_tpu._private.protocol import ActorInfo
@@ -1962,17 +1991,30 @@ class CoreWorker:
     async def _resolve_actor(self, sub: _ActorSubmitter) -> str:
         if sub.address:
             return sub.address
-        deadline = asyncio.get_running_loop().time() + 120
+        # Reference semantics: calls on a PENDING actor wait for it (a
+        # storm's last actors can legitimately take minutes to schedule
+        # on a saturated cluster); the cap only guards true losses.
+        deadline = asyncio.get_running_loop().time() + 600
         while asyncio.get_running_loop().time() < deadline:
             reply = await self.gcs.call(
                 "Gcs", "get_actor_info",
                 {"actor_id": sub.actor_id, "wait_s": 5.0})
             info = reply["info"]
             if info is None:
+                if sub.actor_id in self._pending_actor_reg:
+                    # Our own registration is still in flight.
+                    await asyncio.sleep(0.02)
+                    continue
                 raise ActorDiedError(sub.actor_id, "unknown actor")
             if info.state == "ALIVE":
                 sub.address = info.address
                 sub.version = info.version
+                port = getattr(info, "native_port", 0)
+                if port and info.address not in self._native_addrs:
+                    # The actor record carries the native route: skip the
+                    # per-worker NativePort discovery RPC.
+                    self._native_addrs[info.address] = (
+                        f"{info.address.rsplit(':', 1)[0]}:{port}")
                 return info.address
             if info.state == "DEAD":
                 raise ActorDiedError(sub.actor_id, info.death_cause)
@@ -2779,6 +2821,14 @@ class _KeyScheduler:
         lease["node_id"] = node.node_id
         lease["idle_since"] = time.monotonic()
         lease["inflight"] = 0
+        port = lease.get("native_port", 0)
+        waddr = lease.get("worker_address", "")
+        if port and waddr and waddr not in worker._native_addrs:
+            # The grant carries the worker's native route: the FIRST push
+            # to a fresh worker already goes over the native plane (no
+            # NativePort discovery RPC, no coroutine detour).
+            worker._native_addrs[waddr] = (
+                f"{waddr.rsplit(':', 1)[0]}:{port}")
         with self.tlock:
             self.leases.append(lease)
         if self._reaper is None:

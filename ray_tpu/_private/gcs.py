@@ -216,6 +216,7 @@ class GcsServer:
         self.named_actors: dict[tuple[str, str], ActorID] = {}
         self.placement_groups: dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.pool = ClientPool()
+        self._native_sub = None   # lazy framed-TCP pusher (taskrpc.cc)
         self.server = RpcServer(host)
         self.next_job = 0
         self._job_lock = asyncio.Lock()
@@ -225,6 +226,7 @@ class GcsServer:
         # so long-polls and scheduler retries wake immediately instead of
         # sleep-polling (reference: pubsub/publisher.h long-poll channels).
         self._change_event = asyncio.Event()
+        self._actor_events: dict = {}   # ActorID -> Event (targeted polls)
 
     def _bump(self, tab: str | None = None, key=None):
         """Record a state change and wake every waiter.  With (tab, key)
@@ -235,6 +237,14 @@ class GcsServer:
         ev = self._change_event
         self._change_event = asyncio.Event()
         ev.set()
+        if tab == "actors" and key is not None:
+            # Targeted wake for per-actor long-polls: during an actor
+            # storm, hundreds of get_actor_info polls are parked, and
+            # waking ALL of them on EVERY cluster change is an O(n^2)
+            # coroutine stampede.
+            aev = self._actor_events.pop(key, None)
+            if aev is not None:
+                aev.set()
         if tab is not None:
             self._dirty.add((tab, key))
             self._schedule_persist()
@@ -655,24 +665,35 @@ class GcsServer:
                      "runtime_env": getattr(info.creation_spec,
                                             "runtime_env", None)
                      if info.creation_spec is not None else None},
-                    timeout=30)
+                    timeout=45)  # > the hostd's 30s lease queue window
             except Exception as e:
                 logger.info("lease on %s failed: %s", node.address, e)
                 tried.add(node.node_id)
-                if pg_id is not None:  # fixed target: back off, don't spin
-                    await self._wait_change(0.2)
+                # Back off on transport errors too: a spin here burns the
+                # attempt budget in seconds when the sole node's daemon
+                # is briefly unreachable (storm overload, restart).
+                await self._wait_change(0.2)
                 continue
             if not lease.get("granted"):
-                tried.add(node.node_id)
-                if pg_id is not None:
+                if lease.get("reason") in ("busy", "resources"):
+                    # Saturation is not a placement failure: the node
+                    # queued us for its whole lease window and is still
+                    # full.  Actors PEND until capacity exists
+                    # (reference: GCS actor scheduler retries leases
+                    # indefinitely while the raylet queues) — don't burn
+                    # the attempt budget, don't spin.
+                    attempt -= 1
                     await self._wait_change(0.2)
+                else:
+                    tried.add(node.node_id)
+                    if pg_id is not None:
+                        await self._wait_change(0.2)
                 continue
             worker_addr = lease["worker_address"]
             try:
-                reply = await self.pool.get(worker_addr).call(
-                    "CoreWorker", "CreateActor",
-                    {"spec": info.creation_spec, "actor_id": info.actor_id},
-                    timeout=120)
+                reply = await self._push_create(
+                    worker_addr, lease.get("native_port", 0),
+                    info.creation_spec)
             except Exception as e:
                 logger.warning("actor %s creation push failed: %s",
                                info.actor_id.hex()[:8], e)
@@ -697,6 +718,7 @@ class GcsServer:
                 return
             info.state = "ALIVE"
             info.address = worker_addr
+            info.native_port = lease.get("native_port", 0)
             info.node_id = node.node_id
             info.version += 1
             _metrics()["actors_created"].inc()
@@ -708,6 +730,42 @@ class GcsServer:
         info.death_cause = "scheduling failed after 100 attempts"
         info.version += 1
         self._bump("actors", info.actor_id)
+
+    async def _push_create(self, worker_addr: str, native_port: int,
+                           spec):
+        """Push the creation task to the freshly leased worker over the
+        native plane when it advertises one (a PushTaskRequest proto,
+        spec_codec — the same typed wire contract task submission
+        speaks; no per-worker gRPC channel in the GCS), falling back to
+        the CreateActor RPC."""
+        if native_port:
+            from ray_tpu._private import spec_codec
+            from ray_tpu._private.task_transport import (
+                ConnClosedError,
+                NativeSubmitter,
+            )
+            try:
+                if self._native_sub is None:
+                    self._native_sub = NativeSubmitter(
+                        asyncio.get_running_loop())
+                    self._native_sub.set_caller(b"gcs")
+                naddr = (f"{worker_addr.rsplit(':', 1)[0]}:{native_port}")
+                payload = spec_codec.push_request_to_wire(spec, b"gcs", 0)
+                data = await asyncio.wait_for(
+                    self._native_sub.call(naddr, payload), 120)
+                return spec_codec.reply_from_wire(data)
+            except (ConnClosedError, ConnectionError):
+                # The worker never (completely) received the push: safe
+                # to fall back to the RPC path on the same worker.
+                logger.info("native creation push connection failed; "
+                            "falling back to RPC")
+            # Any other failure (timeout included) may have DELIVERED the
+            # creation — a same-worker fallback would run __init__ twice
+            # in one process.  Surface it; the scheduler retries on a
+            # different worker like a failed RPC.
+        return await self.pool.get(worker_addr).call(
+            "CoreWorker", "CreateActor",
+            {"spec": spec, "actor_id": spec.actor_id}, timeout=120)
 
     async def _on_actor_interrupted(self, actor: ActorInfo, reason: str):
         if actor.num_restarts < actor.max_restarts or actor.max_restarts == -1:
@@ -750,13 +808,35 @@ class GcsServer:
         return {"ok": True}
 
     async def get_actor_info(self, req):
-        actor = self.actors.get(req["actor_id"])
-        # Long-poll: while the actor is pending/restarting, hold the request
-        # briefly so callers don't spin (reference: pubsub long-poll).
+        aid = req["actor_id"]
+        actor = self.actors.get(aid)
+        # Long-poll: while the actor is pending/restarting — or not yet
+        # registered at all (registration is async; a handle can be
+        # resolved by a borrower before the owner's register lands) —
+        # hold the request briefly so callers don't spin (reference:
+        # pubsub long-poll).  Parked on a PER-ACTOR event: unrelated
+        # cluster changes must not wake every parked poll.
         deadline = time.monotonic() + req.get("wait_s", 0)
-        while actor is not None and actor.state in ("PENDING", "RESTARTING") \
-                and time.monotonic() < deadline:
-            await self._wait_change(min(0.5, deadline - time.monotonic()))
+        try:
+            while (actor is None
+                   or actor.state in ("PENDING", "RESTARTING")) \
+                    and time.monotonic() < deadline:
+                ev = self._actor_events.get(aid)
+                if ev is None:
+                    ev = self._actor_events[aid] = asyncio.Event()
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(), min(0.5, deadline - time.monotonic()))
+                except asyncio.TimeoutError:
+                    pass
+                actor = self.actors.get(aid)
+        finally:
+            if self.actors.get(aid) is None:
+                # Never-registered id: no _bump will ever pop the entry;
+                # drop it so stale/garbage ids can't grow the dict.
+                # Concurrent pollers of the same id just re-create it on
+                # their next loop iteration.
+                self._actor_events.pop(aid, None)
         return {"info": actor}
 
     async def get_named_actor(self, req):
@@ -1091,6 +1171,11 @@ class GcsServer:
         await asyncio.sleep(2 * HEARTBEAT_INTERVAL_S)  # let hostds see it
         await self.server.stop()
         await self.pool.close_all()
+        if self._native_sub is not None:
+            try:
+                self._native_sub.close()
+            except Exception:
+                pass
 
 
 def main():
@@ -1122,6 +1207,9 @@ def main():
 
         threading.Thread(target=_watch, daemon=True,
                          name="driver-watch").start()
+
+    from ray_tpu._private.profiling import start_periodic_profile
+    start_periodic_profile("RAY_TPU_PROFILE_GCS", "gcs")
 
     async def run():
         gcs = GcsServer(args.host)

@@ -20,6 +20,7 @@ import os
 import subprocess
 import sys
 import time
+from collections import deque
 
 from ray_tpu._private import gcs as gcs_mod
 from ray_tpu._private.ids import NodeID, WorkerID
@@ -213,6 +214,7 @@ class WorkerHandle:
         self.tpu = tpu           # spawned with TPU runtime access
         self.worker_id: WorkerID | None = None
         self.address: str = ""
+        self.native_port: int = 0  # worker's framed-TCP plane (taskrpc.cc)
         self.state = "starting"  # starting/idle/claimed/leased/actor
         self.reserved = False    # pinned for the lease that spawned it
         self.lease_id: str | None = None
@@ -270,7 +272,17 @@ class NodeDaemon:
         # ready, spawns fall back to the classic Popen path.
         self._zygote: _Zygote | None = None
         self._zygote_exits: dict = {}   # pid -> exit code (reap reports)
+        # Process creation runs off-loop (see _spawn_worker); _spawning
+        # counts in-executor spawns for the startup throttle.
+        from concurrent.futures import ThreadPoolExecutor
+        self._spawn_exec = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="spawn")
+        self._spawning = 0
+        self._spawn_seq = 0
         self._capacity_freed: asyncio.Event | None = None  # made on start()
+        # Parked lease waiters, FIFO: capacity events hand off to ONE
+        # waiter (see _notify_capacity).
+        self._worker_waiters: deque = deque()
         # Object spilling (reference: raylet LocalObjectManager
         # local_object_manager.h:41 + _private/external_storage.py:246
         # FileSystemStorage).  With spilling on, LRU eviction is disabled:
@@ -286,12 +298,18 @@ class NodeDaemon:
 
     # ---------------- worker pool ----------------
 
-    def _spawn_worker(self, job_id: int,
-                      runtime_env: dict | None = None,
-                      tpu: bool = False) -> WorkerHandle:
+    async def _spawn_worker(self, job_id: int,
+                            runtime_env: dict | None = None,
+                            tpu: bool = False) -> WorkerHandle:
+        """Spawn a worker WITHOUT blocking the event loop: the zygote
+        pipe round trip (or cold Popen) costs ~10ms of wall — measured
+        at 12ms/spawn of loop stall during an actor storm — so the
+        process-creation step runs in a small thread pool while the
+        loop keeps serving leases, heartbeats and WorkerReady RPCs."""
         from ray_tpu._private import runtime_env as renv
         log_base = os.path.join(self.session_dir, "logs",
-                                f"worker-{len(self.workers)}-{os.getpid()}")
+                                f"worker-{self._spawn_seq}-{os.getpid()}")
+        self._spawn_seq += 1
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         if not tpu:
@@ -317,6 +335,25 @@ class NodeDaemon:
                 "--store", self.store_path,
                 "--node-id", self.node_id.hex(),
                 "--job-id", str(job_id)]
+        self._spawning += 1
+        try:
+            proc = await asyncio.get_running_loop().run_in_executor(
+                self._spawn_exec, self._make_proc, argv, env, log_base,
+                tpu)
+        finally:
+            self._spawning -= 1
+        handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env), tpu)
+        handle.log_paths = {"stdout": log_base + ".out",
+                            "stderr": log_base + ".err"}
+        handle.log_offsets = {"stdout": 0, "stderr": 0}
+        _metrics()["workers_spawned"].inc()
+        self.workers[proc.pid] = handle
+        logger.info("spawned worker pid=%d job=%d env=%s", proc.pid, job_id,
+                    handle.env_hash or "-")
+        return handle
+
+    def _make_proc(self, argv, env, log_base, tpu):
+        """Blocking process creation — runs on the spawn thread pool."""
         proc = None
         if not tpu and _cfg().worker_zygote:
             # Fast path: fork the pre-imported template (~1-2ms vs ~300ms
@@ -340,15 +377,7 @@ class NodeDaemon:
             out = open(log_base + ".out", "ab")
             err = open(log_base + ".err", "ab")
             proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
-        handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env), tpu)
-        handle.log_paths = {"stdout": log_base + ".out",
-                            "stderr": log_base + ".err"}
-        handle.log_offsets = {"stdout": 0, "stderr": 0}
-        _metrics()["workers_spawned"].inc()
-        self.workers[proc.pid] = handle
-        logger.info("spawned worker pid=%d job=%d env=%s", proc.pid, job_id,
-                    handle.env_hash or "-")
-        return handle
+        return proc
 
     def _zygote_spawn(self, argv, env, out_path, err_path) -> int | None:
         """Fork via the prestarted zygote; None while it's still warming
@@ -390,6 +419,7 @@ class NodeDaemon:
             return {"ok": False}
         handle.worker_id = req["worker_id"]
         handle.address = req["address"]
+        handle.native_port = req.get("native_port", 0)
         handle.state = "idle"
         handle.idle_since = time.monotonic()
         handle.ready.set()
@@ -414,8 +444,15 @@ class NodeDaemon:
                         and handle.tpu == tpu:
                     handle.state = "claimed"
                     return handle
-            live = [w for w in self.workers.values() if w.proc.poll() is None]
-            starting = sum(1 for w in live if w.state == "starting")
+            # No liveness syscalls here: this scan runs hundreds of times
+            # per storm, and a kill(pid, 0) per handle per pass measured
+            # ~4ms/actor.  `returncode` is refreshed by the reaper sweep
+            # (and by anyone who polls); a just-died worker counts live
+            # for <1 sweep, which only makes the throttle conservative.
+            live = [w for w in self.workers.values()
+                    if w.proc.returncode is None]
+            starting = sum(1 for w in live if w.state == "starting") \
+                + self._spawning
             # Forked (zygote) spawns skip the interpreter+import cost, so
             # the anti-thundering-herd throttle — which exists because
             # cold spawns contend for cores — opens up for them.  Only
@@ -431,7 +468,7 @@ class NodeDaemon:
                 remaining = deadline - asyncio.get_event_loop().time()
                 if remaining <= 0:
                     return None
-                await self._wait_capacity(min(remaining, 0.25))
+                await self._wait_worker_slot(remaining)
                 continue
             if len(live) >= self.max_workers:
                 # Evict an idle worker that can't serve this lease — other
@@ -448,7 +485,7 @@ class NodeDaemon:
             # Spawn a worker pinned to this lease (reserved=True) so another
             # lease cannot steal it the moment it boots — stealing cascades
             # into one extra spawn per steal.
-            handle = self._spawn_worker(job_id, runtime_env, tpu)
+            handle = await self._spawn_worker(job_id, runtime_env, tpu)
             handle.reserved = True
             try:
                 await asyncio.wait_for(
@@ -489,6 +526,15 @@ class NodeDaemon:
         if self._capacity_freed is not None:
             self._capacity_freed.set()
             self._capacity_freed = asyncio.Event()
+        # Hand one freed worker/slot to ONE parked lease: broadcasting to
+        # every parked waiter is O(waiters x workers) per event — the
+        # measured collapse mode of a 1,000-actor storm (each ready wakes
+        # 1,000 leases, each rescanning 1,000 handles).
+        while self._worker_waiters:
+            fut = self._worker_waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                break
 
     async def _wait_capacity(self, timeout: float):
         if self._capacity_freed is None:
@@ -498,6 +544,23 @@ class NodeDaemon:
             await asyncio.wait_for(ev.wait(), timeout)
         except asyncio.TimeoutError:
             pass
+
+    async def _wait_worker_slot(self, timeout: float):
+        """Park until ONE capacity event is handed to us (FIFO), with a
+        bounded nap as a backstop — both for lost wakeups and for the
+        baton landing on a waiter that can't use the freed slot (a
+        tpu/runtime-env mismatch re-parks without passing it on; the
+        1s cap bounds that added latency).  Callers re-check their
+        condition in a loop either way."""
+        fut = asyncio.get_running_loop().create_future()
+        self._worker_waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, min(timeout, 1.0))
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            if not fut.done():
+                fut.cancel()
 
     def _bundle_reserve(self, bundle_key: tuple, demand: dict) -> bool:
         """Charge a lease against a committed bundle's remaining capacity."""
@@ -563,7 +626,10 @@ class NodeDaemon:
             remaining = deadline - loop.time()
             if remaining <= 0:
                 return {"granted": False, "reason": "busy"}
-            await self._wait_capacity(min(remaining, 0.5))
+            await self._wait_worker_slot(remaining)
+        # Chain wake: capacity may remain (fractional demand) — pass the
+        # baton to the next parked lease instead of broadcasting.
+        self._notify_capacity()
         self._lease_seq += 1
         _metrics()["leases_granted"].inc()
         lease_id = f"{self.node_id.hex()[:8]}-{self._lease_seq}"
@@ -574,6 +640,7 @@ class NodeDaemon:
         handle.lease_resources = demand
         handle.lease_bundle = bundle
         return {"granted": True, "worker_address": handle.address,
+                "native_port": handle.native_port,
                 "lease_id": lease_id, "node_id": self.node_id}
 
     async def return_worker(self, req):
@@ -591,29 +658,47 @@ class NodeDaemon:
         return {"ok": False}
 
     async def lease_worker_for_actor(self, req):
-        """Dedicated worker for an actor (reference: GcsActorScheduler leases
-        via the same raylet path, gcs_actor_scheduler.h:111)."""
+        """Dedicated worker for an actor (reference: GcsActorScheduler
+        leases via the same raylet path, gcs_actor_scheduler.h:111).
+
+        QUEUES while the node is saturated, like lease_worker: an actor
+        storm must drain at worker-spawn speed, not convert transient
+        saturation into rejections the GCS spins its placement-attempt
+        budget against (reference: leases wait in the raylet's dispatch
+        queue until resources and a worker exist)."""
         demand = req.get("resources", {})
         bundle = tuple(req["bundle"]) if req.get("bundle") else None
-        if bundle:
-            if not self._bundle_reserve(bundle, demand):
-                return {"granted": False, "reason": "resources"}
-        elif not self._reserve(demand):
-            return {"granted": False, "reason": "resources"}
-        handle = await self._get_worker(
-            req.get("job_id", 0), runtime_env=req.get("runtime_env"),
-            tpu=_wants_tpu(demand))
-        if handle is None:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + req.get("queue_timeout", 30.0)
+        while True:
             if bundle:
-                self._bundle_unreserve(bundle, demand)
+                reserved = self._bundle_reserve(bundle, demand)
+                if not reserved and bundle not in self.bundles:
+                    return {"granted": False, "reason": "no_bundle"}
             else:
-                self._unreserve(demand)
-            return {"granted": False, "reason": "no_worker"}
+                reserved = self._reserve(demand)
+            if reserved:
+                handle = await self._get_worker(
+                    req.get("job_id", 0),
+                    runtime_env=req.get("runtime_env"),
+                    tpu=_wants_tpu(demand))
+                if handle is not None:
+                    break
+                if bundle:
+                    self._bundle_unreserve(bundle, demand)
+                else:
+                    self._unreserve(demand)
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return {"granted": False, "reason": "busy"}
+            await self._wait_worker_slot(remaining)
+        self._notify_capacity()   # chain wake: see lease_worker
         handle.state = "actor"
         handle.actor_id = req["actor_id"]
         handle.lease_resources = demand
         handle.lease_bundle = bundle
         return {"granted": True, "worker_address": handle.address,
+                "native_port": handle.native_port,
                 "node_id": self.node_id}
 
     # ---------------- placement-group bundles (2PC) ----------------
@@ -1135,7 +1220,7 @@ class NodeDaemon:
 
     async def _heartbeat_loop(self):
         from ray_tpu import protocol
-        misses = 0
+        last_ok = time.monotonic()
         while not self._shutdown.is_set():
             try:
                 hb = protocol.pb.HeartbeatRequest(
@@ -1143,17 +1228,22 @@ class NodeDaemon:
                 for k, v in self.resources_available.items():
                     hb.available.amounts[k] = v
                 reply = await self.gcs.call("Gcs", "heartbeat", hb,
-                                            timeout=2)
-                misses = 0
+                                            timeout=5)
+                last_ok = time.monotonic()
                 if reply.shutdown:
                     self._shutdown.set()
                 if reply.reregister:
                     await self.gcs.call("Gcs", "register_node",
                                         {"info": self.node_info()})
             except Exception:
-                misses += 1
-                if misses > 10:
-                    logger.error("GCS unreachable; hostd exiting")
+                # Slow is not dead: a saturated single-core GCS (actor
+                # storm, bulk submissions) can stall past any single RPC
+                # timeout; a hostd suicide then cascades into hundreds of
+                # "connection refused" failures.  Exit only after a
+                # sustained silent window — real GCS death also trips the
+                # driver/launcher watchdogs.
+                if time.monotonic() - last_ok > 90.0:
+                    logger.error("GCS unreachable for 90s; hostd exiting")
                     self._shutdown.set()
             await asyncio.sleep(gcs_mod.HEARTBEAT_INTERVAL_S)
 
@@ -1316,6 +1406,9 @@ def main():
     for kv in filter(None, args.resources.split(",")):
         k, v = kv.split("=")
         resources[k] = float(v)
+
+    from ray_tpu._private.profiling import start_periodic_profile
+    start_periodic_profile("RAY_TPU_PROFILE_HOSTD", "hostd")
 
     async def run():
         daemon = NodeDaemon(args.gcs, resources, args.store_capacity,

@@ -1,0 +1,37 @@
+"""Env-gated cProfile scaffolding for long-running runtime processes.
+
+One helper behind every RAY_TPU_PROFILE_* / RAY_TPU_BOOT_PROFILE knob:
+daemons exit via signals or os._exit, so profiles dump PERIODICALLY from
+a background thread rather than relying on atexit.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+def start_periodic_profile(env_var: str, tag: str, interval_s: float = 5.0):
+    """If `env_var` names a directory, enable cProfile on the CALLING
+    thread and dump `<dir>/<tag>-<pid>.prof` every `interval_s`.
+    Returns the Profile (or None when disabled)."""
+    prof_dir = os.environ.get(env_var)
+    if not prof_dir:
+        return None
+    import cProfile
+    pr = cProfile.Profile()
+    pr.enable()
+    path = os.path.join(prof_dir, f"{tag}-{os.getpid()}.prof")
+
+    def _dumper():
+        while True:
+            time.sleep(interval_s)
+            try:
+                pr.dump_stats(path)
+            except Exception:
+                pass
+
+    threading.Thread(target=_dumper, daemon=True,
+                     name=f"profile-{tag}").start()
+    return pr

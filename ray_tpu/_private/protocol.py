@@ -151,6 +151,7 @@ class ActorInfo:
     class_name: str = ""
     state: str = "PENDING"  # PENDING/ALIVE/RESTARTING/DEAD
     address: str = ""       # worker RPC address when ALIVE
+    native_port: int = 0    # worker's framed-TCP task plane, 0 = none
     node_id: Optional[NodeID] = None
     owner_address: str = ""
     max_restarts: int = 0

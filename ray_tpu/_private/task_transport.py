@@ -19,6 +19,7 @@ through the C++ writer.
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import ctypes
 import logging
@@ -147,7 +148,10 @@ class NativeSubmitter:
     `dict.pop` and hands the batch to the loop in ONE wakeup.  `_mu`
     guards only the (cold) connection map."""
 
-    POLL_BUF = 4 << 20
+    # Initial completion-batch buffer; TPT_EBUF grows it on demand.
+    # Small start matters: create_string_buffer zeroes the allocation,
+    # and every forked worker pays it at boot.
+    POLL_BUF = 256 << 10
 
     def __init__(self, loop):
         import itertools
@@ -164,6 +168,11 @@ class NativeSubmitter:
         self._req_iter = itertools.count(1)
         self._mu = threading.Lock()
         self._closed = False
+        # In-flight sender count: zero-hop dispatch sends from arbitrary
+        # submitting threads, so close() must not free the C client
+        # under a live tpt_send_specs call.
+        self._users = 0
+        self._users_mu = threading.Lock()
         # Completion delivery: the loop watches the library's completion
         # eventfd directly and drains batches inline — no poller thread,
         # no call_soon_threadsafe handoff (one fewer context switch per
@@ -246,31 +255,44 @@ class NativeSubmitter:
         tpl_bytes).  Callable from the loop OR a submitting thread
         (zero-hop dispatch); failure callbacks land on the loop either
         way."""
+        with self._users_mu:
+            if self._closed:
+                for _d, _t, cb in items:
+                    try:
+                        self._loop.call_soon_threadsafe(cb, TPT_ECONN, b"")
+                    except RuntimeError:
+                        pass
+                return
+            self._users += 1
         try:
-            tag = self.connect(addr)
-        except ConnectionError:
-            for _d, _t, cb in items:   # deferred: see call_cb
-                self._loop.call_soon_threadsafe(cb, TPT_ECONN, b"")
-            return
-        cbs = self._cbs
-        parts = []
-        ids = []
-        pack = _U64.pack
-        for desc, tpl, cb in items:
-            if tpl[0] not in self._tpl_ids:
-                self.register_template(*tpl)
-            req_id = next(self._req_iter)
-            cbs[req_id] = cb
-            ids.append(req_id)
-            parts.append(pack(req_id))
-            parts.append(desc)
-        blob = b"".join(parts)
-        rc = self._l.tpt_send_specs(self._h, tag, blob, len(blob))
-        if rc != 0:
-            self.invalidate(addr)
-            for req_id, (_d, _t, cb) in zip(ids, items):
-                if cbs.pop(req_id, None) is not None:
+            try:
+                tag = self.connect(addr)
+            except ConnectionError:
+                for _d, _t, cb in items:   # deferred: see call_cb
                     self._loop.call_soon_threadsafe(cb, TPT_ECONN, b"")
+                return
+            cbs = self._cbs
+            parts = []
+            ids = []
+            pack = _U64.pack
+            for desc, tpl, cb in items:
+                if tpl[0] not in self._tpl_ids:
+                    self.register_template(*tpl)
+                req_id = next(self._req_iter)
+                cbs[req_id] = cb
+                ids.append(req_id)
+                parts.append(pack(req_id))
+                parts.append(desc)
+            blob = b"".join(parts)
+            rc = self._l.tpt_send_specs(self._h, tag, blob, len(blob))
+            if rc != 0:
+                self.invalidate(addr)
+                for req_id, (_d, _t, cb) in zip(ids, items):
+                    if cbs.pop(req_id, None) is not None:
+                        self._loop.call_soon_threadsafe(cb, TPT_ECONN, b"")
+        finally:
+            with self._users_mu:
+                self._users -= 1
 
     def call(self, addr: str, payload: bytes):
         """Awaitable variant: returns an asyncio future on the owning
@@ -328,23 +350,57 @@ class NativeSubmitter:
         loop is single-threaded, so once _detach has run no drain can be
         executing) BEFORE the C client is freed, else the loop races a
         use-after-free."""
-        self._closed = True
-        detached = threading.Event()
-
-        def _detach():
+        with self._users_mu:       # new senders bounce off the flag
+            self._closed = True
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop:
+            # Called on the owning loop: no drain can be concurrently
+            # executing (single-threaded loop) — detach inline.
             try:
                 self._loop.remove_reader(self._cfd)
             except Exception:
                 pass
-            detached.set()
-        try:
-            if self._loop.is_closed():
+        else:
+            detached = threading.Event()
+
+            def _detach():
+                try:
+                    self._loop.remove_reader(self._cfd)
+                except Exception:
+                    pass
                 detached.set()
-            else:
-                self._loop.call_soon_threadsafe(_detach)
-        except RuntimeError:
-            detached.set()   # loop already closed: no reader can run
-        detached.wait(2.0)
+            try:
+                if self._loop.is_closed():
+                    detached.set()
+                else:
+                    self._loop.call_soon_threadsafe(_detach)
+            except RuntimeError:
+                detached.set()   # loop already closed: no reader can run
+            if not detached.wait(5.0):
+                # The loop is wedged (storm overload): freeing the C
+                # client now risks a use-after-free if the reader fires
+                # later.  Leak it — close() only runs at process
+                # teardown.
+                logger.warning("completion reader still attached; "
+                               "leaking native client")
+                self._h = None
+                return
+        # Wait out in-flight senders (zero-hop threads inside
+        # tpt_send_specs); new ones bounce off _closed.
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._users_mu:
+                if self._users == 0:
+                    break
+            time.sleep(0.005)
+        else:
+            logger.warning("senders still in flight; leaking native "
+                           "client")
+            self._h = None
+            return
         self._l.tpt_client_close(self._h)
         self._h = None
 
@@ -365,7 +421,7 @@ class NativeReceiver:
     actors) go out immediately via the classic per-reply path.
     """
 
-    POP_BUF = 4 << 20
+    POP_BUF = 256 << 10   # grows on TPT_EBUF, like POLL_BUF
 
     def __init__(self, handler: Callable, host: str = "127.0.0.1"):
         self._l = lib()
@@ -424,23 +480,8 @@ class NativeReceiver:
             self._l.tpt_server_reply_raw(self._h, tag, blob, len(blob))
 
     def _exec_loop(self):
-        import os
-        prof_dir = os.environ.get("RAY_TPU_PROFILE_EXEC")
-        if prof_dir:
-            # Debug aid: profile the execution thread, dumping stats
-            # every ~5s (workers exit via os._exit, so atexit never runs).
-            import cProfile
-            pr = cProfile.Profile()
-            path = f"{prof_dir}/exec-{os.getpid()}.prof"
-            last = [time.monotonic()]
-
-            def maybe_dump():
-                if time.monotonic() - last[0] > 5.0:
-                    last[0] = time.monotonic()
-                    pr.dump_stats(path)
-            pr.enable()
-        else:
-            maybe_dump = None
+        from ray_tpu._private.profiling import start_periodic_profile
+        start_periodic_profile("RAY_TPU_PROFILE_EXEC", "exec")
         cap = self.POP_BUF
         buf = ctypes.create_string_buffer(cap)
         used = ctypes.c_uint64()
@@ -454,8 +495,6 @@ class NativeReceiver:
             if n <= 0:
                 continue
             raw = ctypes.string_at(buf, used.value)
-            if maybe_dump is not None:
-                maybe_dump()
             with self.batch_scope():
                 for tag, req_id, _status, payload in _unpack_records(
                         raw, used.value):

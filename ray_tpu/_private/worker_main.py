@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import sys
 import threading
 import time
 
@@ -24,10 +25,15 @@ def main():
     parser.add_argument("--job-id", type=int, default=0)
     args = parser.parse_args()
     logging.basicConfig(level=os.environ.get("RAY_TPU_LOGLEVEL", "INFO"))
+    boot_trace = os.environ.get("RAY_TPU_BOOT_TRACE")
+    from ray_tpu._private.profiling import start_periodic_profile
+    pr = start_periodic_profile("RAY_TPU_BOOT_PROFILE", "boot")
+    t0 = time.perf_counter()
 
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.ids import JobID, NodeID
     from ray_tpu._private.rpc import RpcClient
+    t_imports = time.perf_counter() - t0
 
     cw = CoreWorker(
         mode="worker",
@@ -37,6 +43,7 @@ def main():
         hostd_address=args.hostd,
         job_id=JobID(args.job_id.to_bytes(4, "little")),
     )
+    t_core = time.perf_counter() - t0
 
     # Tasks call ray_tpu.get/put/remote through the process-global worker.
     from ray_tpu import api
@@ -64,6 +71,10 @@ def main():
                 "pid": os.getpid(),
                 "worker_id": cw.worker_id,
                 "address": cw.address,
+                # Piggybacked so leases/actor records carry the native
+                # route — peers skip the per-worker NativePort RPC.
+                "native_port": (cw._native_rx.port
+                                if cw._native_rx else 0),
             }, timeout=10 * (attempt + 1)))
             break
         except Exception as e:  # noqa: BLE001
@@ -71,6 +82,16 @@ def main():
             time.sleep(0.5 * (attempt + 1))
     else:
         raise RuntimeError(f"WorkerReady never acknowledged: {last}")
+    if boot_trace:
+        print(f"[boot-trace] imports={t_imports*1e3:.1f}ms "
+              f"core_worker={(t_core - t_imports)*1e3:.1f}ms "
+              f"ready_rpc={(time.perf_counter() - t0 - t_core)*1e3:.1f}ms "
+              f"total={(time.perf_counter() - t0)*1e3:.1f}ms",
+              file=sys.stderr, flush=True)
+    if pr is not None:
+        pr.disable()
+        pr.dump_stats(os.path.join(
+            os.environ["RAY_TPU_BOOT_PROFILE"], f"boot-{os.getpid()}.prof"))
 
     parent = os.getppid()
 

@@ -94,9 +94,11 @@ def main() -> None:
     from ray_tpu import api  # noqa: F401
     from ray_tpu._private import core_worker  # noqa: F401
     from ray_tpu._private import rpc  # noqa: F401
+    from ray_tpu._private import runtime_env  # noqa: F401
     from ray_tpu._private import serialization  # noqa: F401
     from ray_tpu._private import task_transport  # noqa: F401
     from ray_tpu._private import worker_main  # noqa: F401
+    from ray_tpu.util import metrics  # noqa: F401
 
     signal.signal(signal.SIGCHLD, _reap)
 

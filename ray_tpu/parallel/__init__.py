@@ -7,8 +7,10 @@ from ray_tpu.parallel.mesh import (  # noqa: F401
     AXES,
     MeshConfig,
     create_mesh,
+    create_two_level_mesh,
     mesh_axis_size,
     single_device_mesh,
+    slice_index_of,
 )
 from ray_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,

@@ -79,6 +79,71 @@ def create_mesh(config: Optional[MeshConfig] = None,
     return Mesh(dev_array, axis_names)
 
 
+def create_two_level_mesh(
+        ici: Optional[MeshConfig] = None,
+        dcn: Optional[MeshConfig] = None,
+        n_slices: int = 1,
+        devices: Optional[Sequence[jax.Device]] = None,
+        axis_names: Sequence[str] = AXES) -> Mesh:
+    """Multi-slice (pod-to-pod) mesh: every logical axis is the product
+    of a DCN part (across slices) and an ICI part (within a slice), with
+    the DCN part slowest-varying — so walking any axis stays inside one
+    slice until its ICI block is exhausted (SURVEY §2.5 "DCN collectives
+    between slices", §7 P7).
+
+    Lay DP (and optionally FSDP) on the DCN axes and keep TP/SP/EP
+    strictly ICI: per-step DCN traffic is then one gradient
+    reduce-scatter/all-gather, while the bandwidth-hungry activation
+    collectives ride ICI.  XLA lowers a collective over a combined axis
+    hierarchically when the device assignment is slice-contiguous (the
+    megascale path on real multi-slice jobs; on the CPU simulator the
+    topology is emulated but the assignment invariants are identical and
+    are what the tests check).
+
+    `devices` are grouped into `n_slices` equal contiguous blocks in
+    order — matching jax.devices(), which sorts by (slice_index,
+    on-slice coordinates) on real multi-slice TPU.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_slices <= 0 or len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices")
+    per_slice = len(devices) // n_slices
+    ici_sizes = (ici or MeshConfig()).resolve(per_slice)
+    dcn_sizes = (dcn or MeshConfig(data=n_slices)).resolve(n_slices)
+    for a in axis_names:
+        if a in ("tensor", "seq", "expert") and dcn_sizes[a] > 1:
+            raise ValueError(
+                f"axis {a!r} must stay inside a slice (ICI): per-step "
+                f"activation collectives over DCN would dominate the "
+                f"step; shard it with the ici config instead")
+    n_ax = len(axis_names)
+    dev = np.asarray(devices).reshape(
+        [dcn_sizes[a] for a in axis_names]
+        + [ici_sizes[a] for a in axis_names])
+    # Interleave (dcn_a, ici_a) per axis and merge: combined axis a has
+    # the DCN part as the high-order digits.
+    order = [i for pair in zip(range(n_ax), range(n_ax, 2 * n_ax))
+             for i in pair]
+    dev = dev.transpose(order).reshape(
+        [dcn_sizes[a] * ici_sizes[a] for a in axis_names])
+    return Mesh(dev, axis_names)
+
+
+def slice_index_of(mesh: Mesh, n_slices: int) -> np.ndarray:
+    """Map each mesh position to its slice id — the topology oracle the
+    tests assert against: moving along an ICI-only axis must never
+    change slice.  Real multi-slice TPUs expose device.slice_index; the
+    simulator falls back to contiguous id blocks (the grouping
+    create_two_level_mesh used)."""
+    devs = np.asarray(mesh.devices)
+    first = devs.reshape(-1)[0]
+    if getattr(first, "slice_index", None) is not None:
+        return np.vectorize(lambda d: d.slice_index)(devs)
+    per_slice = devs.size // n_slices
+    return np.vectorize(lambda d: d.id // per_slice)(devs)
+
+
 def single_device_mesh() -> Mesh:
     """A 1-chip mesh with all axes size 1 — lets one jitted program serve
     both single-chip and pod runs without branching."""

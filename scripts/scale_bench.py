@@ -79,12 +79,17 @@ def bench_concurrent_tasks(results, n: int):
 
 def bench_actor_storm(results, target: int):
     # Each actor is one forked worker process; budget RAM for it AND
-    # CPU: worker boot costs ~100-200ms of CPU, so a 1000-actor storm
-    # belongs on a multi-core cluster (the reference's envelope host).
-    # The applied size is recorded so a host-scaled run is never
-    # mistaken for the full envelope.
+    # CPU.  Measured child-side floor on the CI host (PERF.md round-5):
+    # zygote fork ~6ms + worker boot ~10ms CPU + creation ~2ms — on ONE
+    # core that alone caps any storm near ~55/s, and past ~500 live
+    # worker processes the shared gRPC/kernel layers destabilize
+    # (observed cygrpc event-engine segfaults).  400 is the validated
+    # stable envelope here; a 1000-actor storm belongs on a multi-core
+    # cluster (the reference's envelope host).  The applied size is
+    # recorded so a host-scaled run is never mistaken for the full
+    # envelope.
     budget = int(mem_available_bytes() * 0.5 // (30 << 20))
-    cpu_budget = max(100, (os.cpu_count() or 1) * 100)
+    cpu_budget = max(400, (os.cpu_count() or 1) * 100)
     n = max(50, min(target, budget, cpu_budget))
 
     @ray_tpu.remote(num_cpus=0)

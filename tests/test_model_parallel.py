@@ -394,3 +394,79 @@ def test_gpt_mesh_loss_uses_spmd_fused_ce(monkeypatch):
             params, batch)
     assert np.isfinite(float(loss))
     assert called.get("hit")
+
+
+# ---------------------------------------------------------------------------
+# Multi-slice (two-level dcn x ici) meshes — SURVEY §2.5 DCN mapping, §7 P7.
+# ---------------------------------------------------------------------------
+
+
+def test_two_level_mesh_topology():
+    """Walking an ICI-only axis must stay inside one slice; the data
+    axis is the only one allowed to cross the DCN boundary."""
+    from ray_tpu.parallel import (
+        MeshConfig, create_two_level_mesh, slice_index_of)
+    mesh = create_two_level_mesh(
+        ici=MeshConfig(data=1, fsdp=2, tensor=2), dcn=MeshConfig(data=2),
+        n_slices=2, devices=jax.devices()[:8])
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2 and mesh.shape["tensor"] == 2
+    slc = slice_index_of(mesh, 2)
+    names = list(mesh.axis_names)
+    for ax in ("fsdp", "tensor"):
+        assert (np.diff(slc, axis=names.index(ax)) == 0).all(), \
+            f"ICI axis {ax} crosses a slice boundary"
+    # data axis DOES cross: both slices appear along it.
+    d = names.index("data")
+    moved = np.moveaxis(slc, d, 0).reshape(2, -1)
+    assert (moved[0] != moved[1]).all()
+
+
+def test_two_level_mesh_data_split_across_both():
+    """data = dcn_part x ici_part: high-order digits cross slices,
+    low-order stay inside."""
+    from ray_tpu.parallel import (
+        MeshConfig, create_two_level_mesh, slice_index_of)
+    mesh = create_two_level_mesh(
+        ici=MeshConfig(data=2, tensor=2), dcn=MeshConfig(data=2),
+        n_slices=2, devices=jax.devices()[:8])
+    assert mesh.shape["data"] == 4
+    slc = slice_index_of(mesh, 2)
+    names = list(mesh.axis_names)
+    along = np.moveaxis(slc, names.index("data"), 0).reshape(4, -1)
+    # positions 0,1 = slice A's ici block; 2,3 = slice B's.
+    assert (along[0] == along[1]).all()
+    assert (along[2] == along[3]).all()
+    assert (along[0] != along[2]).all()
+
+
+def test_two_level_mesh_rejects_tensor_over_dcn():
+    from ray_tpu.parallel import MeshConfig, create_two_level_mesh
+    with pytest.raises(ValueError, match="inside a slice"):
+        create_two_level_mesh(
+            ici=MeshConfig(data=4), dcn=MeshConfig(data=1, tensor=2),
+            n_slices=2, devices=jax.devices()[:8])
+
+
+def test_two_level_mesh_numerics_match_flat():
+    """Same logical dp2/fsdp2/tp2 sharding on a two-level mesh must
+    produce the same loss as the flat mesh (only the device->position
+    assignment differs)."""
+    from ray_tpu.parallel import MeshConfig, create_two_level_mesh
+    cfg = gpt.CONFIGS["nano"]
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                                cfg.vocab_size)
+
+    def loss_on(mesh):
+        init, step = gpt.make_train_step(cfg, optax.adamw(1e-3), mesh)
+        state = init(jax.random.key(0))
+        _state, metrics = jax.jit(step, donate_argnums=0)(
+            state, shard_batch(mesh, {"tokens": tokens}))
+        return float(metrics["loss"])
+
+    flat = loss_on(create_mesh(MeshConfig(data=2, fsdp=2, tensor=2),
+                               devices=jax.devices()[:8]))
+    two = loss_on(create_two_level_mesh(
+        ici=MeshConfig(data=1, fsdp=2, tensor=2), dcn=MeshConfig(data=2),
+        n_slices=2, devices=jax.devices()[:8]))
+    assert abs(flat - two) < 1e-4
