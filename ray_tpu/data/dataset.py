@@ -191,7 +191,8 @@ class Dataset:
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         def do(block):
             return blk.rows_to_block([fn(r) for r in blk.block_rows(block)])
-        return self._with_one_to_one(do, "map")
+        return Dataset(self._plan.with_stage(OneToOne(
+            do, "map", row_preserving=True)))
 
     def flat_map(self, fn: Callable[[dict], list]) -> "Dataset":
         def do(block):
@@ -254,7 +255,9 @@ class Dataset:
     def select_columns(self, cols: List[str]) -> "Dataset":
         def do(block):
             return block.select(cols)
-        return self._with_one_to_one(do, "select_columns")
+        return Dataset(self._plan.with_stage(OneToOne(
+            do, "select_columns", row_preserving=True,
+            projection=list(cols))))
 
     # ------------------------- all-to-all ---------------------------
 
@@ -320,7 +323,8 @@ class Dataset:
                 out.append(ray_tpu.put(blk.slice_block(b, 0, take)))
                 seen += take
             return out
-        return Dataset(self._plan.with_stage(AllToAll(do, "limit")))
+        return Dataset(self._plan.with_stage(
+            AllToAll(do, "limit", limit_rows=n)))
 
     def union(self, *others: "Dataset") -> "Dataset":
         refs = list(self._execute())
@@ -554,8 +558,17 @@ class Dataset:
                     for row in b.to_pylist():
                         f.write(json.dumps(row) + "\n")
 
+    def explain(self) -> str:
+        """The logical plan + the optimizer's pushdown decisions, without
+        executing anything (reference: logical-plan inspection)."""
+        from ray_tpu.data import logical
+        return logical.explain(self._plan)
+
     def __repr__(self):
-        return (f"Dataset(num_blocks={len(self._plan.input_refs)}+, "
+        src = self._plan.source
+        head = (f"source={src.describe()}" if src is not None
+                else f"num_blocks={len(self._plan.input_refs)}+")
+        return (f"Dataset({head}, "
                 f"stages={[getattr(s, 'name', '?') for s in self._plan.stages]})")
 
 
@@ -698,8 +711,9 @@ class DatasetPipeline:
         count = 0
         while self._times is None or count < self._times:
             # Fresh plan execution per epoch: no cached materialization.
-            yield Dataset(ExecPlan(list(self._dataset._plan.input_refs),
-                                   list(self._dataset._plan.stages)))
+            p = self._dataset._plan
+            yield Dataset(ExecPlan(list(p.input_refs), list(p.stages),
+                                   p.source))
             count += 1
 
     def iter_windows(self) -> Iterator["Dataset"]:
@@ -710,7 +724,9 @@ class DatasetPipeline:
         # the window size is in OUTPUT blocks.  Consequence: upstream
         # stages materialize in full — for bounded memory put window()
         # directly after the source and map over the windows.
-        refs = (self._dataset._execute() if self._dataset._plan.stages
+        refs = (self._dataset._execute()
+                if self._dataset._plan.stages
+                or self._dataset._plan.source is not None
                 else list(self._dataset._plan.input_refs))
         k = max(1, self._blocks_per_window)
         for lo in range(0, len(refs), k):

@@ -47,11 +47,16 @@ class _PoolWorker:
 
 @dataclass
 class OneToOne:
-    """A fusable per-block transform."""
+    """A fusable per-block transform.  The logical tags feed the plan
+    optimizer (logical.py): `row_preserving` stages let a downstream
+    limit push into the read; `projection` marks a pure column-select
+    that can move into a columnar file reader."""
 
     fn: Callable  # block -> block
     name: str
     compute: Optional[ActorPoolStrategy] = None
+    row_preserving: bool = False
+    projection: Optional[List[str]] = None
 
 
 @dataclass
@@ -60,17 +65,26 @@ class AllToAll:
 
     fn: Callable  # (list[ref], ctx) -> list[ref]
     name: str
+    limit_rows: Optional[int] = None   # set on limit stages (optimizer)
 
 
 @dataclass
 class ExecPlan:
-    """Input block refs + stage list (logical plan)."""
+    """Input block refs (or a LAZY source, see logical.py) + stages."""
 
     input_refs: List[Any]
     stages: List[Any] = field(default_factory=list)
+    source: Optional[Any] = None       # logical.LazyRead | None
 
     def with_stage(self, stage) -> "ExecPlan":
-        return ExecPlan(list(self.input_refs), self.stages + [stage])
+        return ExecPlan(list(self.input_refs), self.stages + [stage],
+                        self.source)
+
+    def resolve(self):
+        """(input_refs, stages) after the read-side optimizer rules;
+        launches the lazy source."""
+        from ray_tpu.data import logical
+        return logical.resolve(self)
 
 
 def _fuse(chain: List[OneToOne]) -> Callable:
@@ -135,8 +149,8 @@ def _run_actor_pool(refs: List[Any], stage: OneToOne) -> List[Any]:
 
 def execute(plan: ExecPlan, window: int = 16) -> List[Any]:
     """Materialize: returns the final block refs."""
-    refs = list(plan.input_refs)
-    for kind, seg in _segments(plan.stages):
+    refs, stages = plan.resolve()
+    for kind, seg in _segments(stages):
         if kind == "fused":
             out = []
             pending = {}
@@ -277,8 +291,9 @@ def iter_output_refs(plan: ExecPlan, window: int = 8,
     are byte-aware: each stage probes its first output block's size and
     bounds in-flight work by `window_bytes` (reference:
     streaming_executor.py:41 resource-aware backpressure)."""
-    stream: Iterator[Any] = iter(list(plan.input_refs))
-    for kind, seg in _segments(plan.stages):
+    refs, stages = plan.resolve()
+    stream: Iterator[Any] = iter(refs)
+    for kind, seg in _segments(stages):
         if kind == "fused":
             stream = _stream_fused(stream, seg, window, window_bytes)
         elif kind == "actor_pool":

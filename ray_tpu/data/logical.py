@@ -1,0 +1,159 @@
+"""Logical plan layer: lazy sources + a rule-based optimizer.
+
+Reference parity: python/ray/data/_internal/logical/ — operators are
+recorded declaratively and `optimizers.py` rewrites the plan before
+execution (projection/limit pushdown into reads, operator fusion, read
+parallelism).  Here the physical fusion already lives in executor.py;
+this layer adds the READ-side rules, which need a source that has not
+launched yet:
+
+  * **Projection pushdown**: `read_parquet(...).select_columns(cols)`
+    reads only `cols` from disk (Parquet is columnar — the projection
+    happens in the file reader, not after materialization).
+  * **Limit pushdown**: `read_parquet(...).limit(n)` consults per-file
+    row-count METADATA (no data IO) and launches read tasks for only
+    the file prefix covering n rows.  Row-preserving stages (map,
+    select_columns) between the read and the limit keep the rule valid;
+    a filter/flat_map/map_batches blocks it.
+  * **Read parallelism hints**: `read_parquet(paths, parallelism=k)`
+    groups files into k read tasks instead of one per file.
+
+Eager plans (from_items, non-parquet readers) resolve trivially.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+
+@dataclass
+class LazyRead:
+    """A not-yet-launched read: the optimizer may narrow `paths` (limit
+    pushdown), set `columns` (projection pushdown) and group paths
+    (parallelism) before `loader` fires."""
+
+    paths: List[str]
+    # loader(path_group, columns) -> block ref
+    loader: Callable[[List[str], Optional[List[str]]], Any]
+    columns: Optional[List[str]] = None
+    parallelism: Optional[int] = None
+    # count_rows(path) -> row count from file METADATA (None = unknown,
+    # which disables limit pushdown for safety).
+    count_rows: Optional[Callable[[str], Optional[int]]] = None
+    name: str = "read"
+
+    def __post_init__(self):
+        # Launch cache keyed by (paths, columns): re-iterating the same
+        # Dataset (or a derived plan resolving to the same read) reuses
+        # the object-store blocks instead of re-reading files — matching
+        # the eager readers' semantics.  Bounded: one entry per distinct
+        # pushdown outcome.
+        self._launched: dict = {}
+
+    def describe(self) -> str:
+        bits = [f"{self.name}[{len(self.paths)} files"]
+        if self.columns is not None:
+            bits.append(f", columns={self.columns}")
+        if self.parallelism:
+            bits.append(f", parallelism={self.parallelism}")
+        return "".join(bits) + "]"
+
+
+def _chunk(items: List[Any], k: int) -> List[List[Any]]:
+    k = max(1, min(k, len(items)))
+    size = (len(items) + k - 1) // k
+    return [items[i:i + size] for i in range(0, len(items), size)]
+
+
+def resolve(plan) -> Tuple[List[Any], List[Any]]:
+    """Apply the read-side rules and launch the source; returns
+    (input_refs, remaining_stages).  Called once per execution by the
+    executor's entry points."""
+    src = getattr(plan, "source", None)
+    if src is None:
+        return list(plan.input_refs), list(plan.stages)
+    stages = list(plan.stages)
+    columns = src.columns
+
+    # Projection pushdown: a select_columns DIRECTLY after the read
+    # moves into the file reader (only there is column use knowable —
+    # an arbitrary map could touch any column).
+    if stages and getattr(stages[0], "projection", None) is not None \
+            and columns is None:
+        columns = stages[0].projection
+        stages = stages[1:]
+
+    # Limit pushdown: scan past row-preserving stages for a limit.
+    limit_rows = None
+    for s in stages:
+        lr = getattr(s, "limit_rows", None)
+        if lr is not None:
+            limit_rows = lr
+            break        # the limit stage stays: it trims the tail block
+        if not getattr(s, "row_preserving", False):
+            break
+    paths = list(src.paths)
+    if limit_rows is not None and src.count_rows is not None:
+        picked: List[str] = []
+        acc = 0
+        for p in paths:
+            picked.append(p)
+            n = src.count_rows(p)
+            if n is None:      # unknown metadata: read everything
+                picked = paths
+                break
+            acc += n
+            if acc >= limit_rows:
+                break
+        paths = picked
+
+    key = (tuple(paths), tuple(columns) if columns is not None else None)
+    refs = src._launched.get(key)
+    if refs is None:
+        groups = (_chunk(paths, src.parallelism) if src.parallelism
+                  else [[p] for p in paths])
+        refs = [src.loader(g, columns) for g in groups]
+        src._launched[key] = refs
+    return list(refs), stages
+
+
+def explain(plan) -> str:
+    """Human-readable logical plan + the optimizer's decisions (the
+    plan-inspection surface; reference: Dataset.explain())."""
+    src = getattr(plan, "source", None)
+    lines = []
+    if src is None:
+        lines.append(f"EagerInput[{len(plan.input_refs)} blocks]")
+    else:
+        # Re-run the rule analysis without launching anything.
+        stages = list(plan.stages)
+        columns = src.columns
+        if stages and getattr(stages[0], "projection", None) is not None \
+                and columns is None:
+            columns = stages[0].projection
+        limit_rows = None
+        for s in stages:
+            lr = getattr(s, "limit_rows", None)
+            if lr is not None:
+                limit_rows = lr
+                break
+            if not getattr(s, "row_preserving", False):
+                break
+        d = src.describe()
+        if columns is not None and src.columns is None:
+            d += f" <- pushed projection {columns}"
+        if limit_rows is not None and src.count_rows is not None:
+            d += f" <- pushed limit {limit_rows}"
+        lines.append(d)
+    for s in plan.stages:
+        tags = []
+        if getattr(s, "row_preserving", False):
+            tags.append("row-preserving")
+        if getattr(s, "projection", None) is not None:
+            tags.append(f"projection={s.projection}")
+        if getattr(s, "limit_rows", None) is not None:
+            tags.append(f"limit={s.limit_rows}")
+        suffix = f"  [{', '.join(tags)}]" if tags else ""
+        lines.append(f"  -> {getattr(s, 'name', '?')}{suffix}")
+    return "\n".join(lines)

@@ -84,15 +84,37 @@ def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
     return out
 
 
-def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 parallelism: Optional[int] = None) -> Dataset:
+    """LAZY columnar read: the plan optimizer (data/logical.py) may push
+    a downstream select_columns into the file reader and a downstream
+    limit into the file list (per-file row counts come from Parquet
+    metadata, no data IO); `parallelism` groups files into that many
+    read tasks."""
     import pyarrow.parquet as pq
+
+    from ray_tpu.data.logical import LazyRead
     files = _expand_paths(paths, ".parquet")
 
     @ray_tpu.remote
-    def load(path):
-        return pq.read_table(path, columns=columns)
+    def load(group, cols):
+        import pyarrow as pa
+        tables = [pq.read_table(p, columns=cols) for p in group]
+        return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
 
-    return Dataset(ExecPlan([load.remote(p) for p in files]))
+    def count_rows(path):
+        try:
+            return pq.ParquetFile(path).metadata.num_rows
+        except Exception:
+            return None
+
+    return Dataset(ExecPlan([], source=LazyRead(
+        paths=files,
+        loader=lambda group, cols: load.remote(group, cols),
+        columns=list(columns) if columns else None,
+        parallelism=parallelism,
+        count_rows=count_rows,
+        name="read_parquet")))
 
 
 def read_csv(paths) -> Dataset:

@@ -37,6 +37,10 @@ class AlgorithmConfig:
         self.sgd_minibatch_size = 128
         self.num_sgd_iter = 8
         self.model_hidden = (64, 64)
+        # Recurrent model (reference: model config use_lstm/lstm_cell_size
+        # + max_seq_len; here the rollout fragment IS the training chunk).
+        self.use_lstm = False
+        self.lstm_size = 64
         self.seed = 0
         # Data-parallel learner group: a jax Mesh whose "data" axis spans
         # the learner chips (reference: LearnerGroup learner_group.py:51).

@@ -293,10 +293,58 @@ class SyntheticPixelVector(VectorEnv):
         return self._obs(), rewards, terminated, truncated
 
 
+class RepeatPrevVector(VectorEnv):
+    """Memory probe: at every step the agent sees a one-hot symbol and is
+    rewarded for emitting the PREVIOUS step's symbol.  The current
+    observation carries zero information about the correct action, so a
+    feedforward policy is capped at chance (1/K) while one step of
+    memory solves it exactly — the standard separation task for
+    recurrent policies (reference: rllib's RepeatAfterMeEnv,
+    examples/env/repeat_after_me_env.py, used by the LSTM examples)."""
+
+    K = 3
+    MAX_STEPS = 48
+    observation_dim = 3   # == K
+    num_actions = 3       # == K
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        super().__init__(num_envs)
+        self._rng = np.random.default_rng(seed)
+        self._sym = np.zeros(num_envs, np.int64)
+        self._prev = np.zeros(num_envs, np.int64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        return np.eye(self.K, dtype=np.float32)[self._sym]
+
+    def reset_all(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._sym = self._rng.integers(0, self.K, self.num_envs)
+        self._prev[:] = self._sym   # step 0: reward "repeat what you see"
+        self._steps[:] = 0
+        self._ep_return[:] = 0.0
+        self._ep_len[:] = 0
+        return self._obs()
+
+    def step_batch(self, actions: np.ndarray):
+        rewards = (np.asarray(actions) == self._prev).astype(np.float32)
+        self._prev = self._sym
+        self._sym = self._rng.integers(0, self.K, self.num_envs)
+        self._steps += 1
+        truncated = self._steps >= self.MAX_STEPS
+        terminated = np.zeros(self.num_envs, bool)
+        if truncated.any():
+            self._steps[truncated] = 0
+            self._prev[truncated] = self._sym[truncated]
+        return self._obs(), rewards, terminated, truncated
+
+
 _ENV_REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
     "CartPole-v1": CartPoleVector,
     "Pendulum-v1": PendulumVector,
     "SyntheticPixel-v0": SyntheticPixelVector,
+    "RepeatPrev-v0": RepeatPrevVector,
 }
 
 

@@ -48,7 +48,17 @@ class _VTraceLearner:
 
     def __init__(self, obs_dim: int, num_actions: int, cfg: IMPALAConfig,
                  hidden, seed: int, mesh=None):
-        init_params, self.apply = make_model(obs_dim, num_actions, hidden)
+        use_lstm = getattr(cfg, "use_lstm", False)
+        apply_seq = apply_step = None
+        if use_lstm:
+            from ray_tpu.rllib.models import make_recurrent_model
+            init_params, apply_step, apply_seq, _init_state = \
+                make_recurrent_model(obs_dim, num_actions, hidden,
+                                     getattr(cfg, "lstm_size", 64))
+            self.apply = apply_seq
+        else:
+            init_params, self.apply = make_model(obs_dim, num_actions,
+                                                 hidden)
         self.params = init_params(jax.random.key(seed))
         self.tx = optax.chain(
             optax.clip_by_global_norm(cfg.grad_clip),
@@ -72,11 +82,21 @@ class _VTraceLearner:
         def loss(params, batch):
             obs = batch[SampleBatch.OBS]      # [T, B, D] or [T, B, H, W, C]
             T, B = obs.shape[:2]
-            logits, values = apply(
-                params, obs.reshape((T * B,) + obs.shape[2:]))
-            logits = logits.reshape(T, B, -1)
-            values = values.reshape(T, B)
-            _, bootstrap_value = apply(params, batch["bootstrap_obs"])
+            if use_lstm:
+                # Time-major V-trace fragments are the LSTM's native
+                # layout: one masked-reset scan over the chunk
+                # (reference: rnn_sequencing in the IMPALA learner).
+                logits, values = apply_seq(
+                    params, obs, batch["state_in"], batch["resets"])
+                _, bootstrap_value, _ = apply_step(
+                    params, batch["bootstrap_obs"],
+                    batch["bootstrap_state"])
+            else:
+                logits, values = apply(
+                    params, obs.reshape((T * B,) + obs.shape[2:]))
+                logits = logits.reshape(T, B, -1)
+                values = values.reshape(T, B)
+                _, bootstrap_value = apply(params, batch["bootstrap_obs"])
 
             logp_all = jax.nn.log_softmax(logits)
             actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
@@ -218,7 +238,10 @@ class IMPALA(Algorithm):
                 rollout_fragment_length=cfg.rollout_fragment_length,
                 gamma=cfg.gamma, lam=cfg.lambda_,
                 hidden=cfg.model_hidden, seed=cfg.seed,
-                postprocess=False))
+                postprocess=False,
+                **({"policy_kind": "recurrent",
+                    "lstm_size": cfg.lstm_size}
+                   if getattr(cfg, "use_lstm", False) else {})))
         self.learner = _VTraceLearner(
             self.obs_dim, self.num_actions, cfg, cfg.model_hidden, cfg.seed,
             mesh=cfg.learner_mesh)

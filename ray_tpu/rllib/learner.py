@@ -38,9 +38,15 @@ class JaxLearner:
     def __init__(self, obs_dim: int, num_actions: int, *,
                  loss_fn: Callable, config: Dict[str, Any],
                  hidden=(64, 64), seed: int = 0,
-                 mesh: Optional[Any] = None, action_dim: int = 0):
+                 mesh: Optional[Any] = None, action_dim: int = 0,
+                 model: str = "fc", lstm_size: int = 64):
         self.config = config
-        if num_actions == 0 and action_dim > 0:
+        if model == "lstm":
+            from ray_tpu.rllib.models import make_recurrent_model
+            init_params, _step, self.apply, self.initial_state = \
+                make_recurrent_model(obs_dim, num_actions, hidden,
+                                     lstm_size)
+        elif num_actions == 0 and action_dim > 0:
             init_params, self.apply = make_continuous_model(
                 obs_dim, action_dim, hidden)
         else:
@@ -159,9 +165,12 @@ class JaxLearner:
                         (num_mb, mb_rows) + x.shape[1:]), batch)
                 if SampleBatch.ADVANTAGES in mbs:
                     adv = mbs[SampleBatch.ADVANTAGES]
+                    # Normalize over every non-minibatch axis (recurrent
+                    # batches carry a time axis after the row axis).
+                    ax = tuple(range(1, adv.ndim))
                     mbs[SampleBatch.ADVANTAGES] = (
-                        (adv - adv.mean(1, keepdims=True))
-                        / (adv.std(1, keepdims=True) + 1e-8))
+                        (adv - adv.mean(ax, keepdims=True))
+                        / (adv.std(ax, keepdims=True) + 1e-8))
                 local = jax.tree_util.tree_map(
                     lambda x: jax.lax.dynamic_slice_in_dim(
                         x, idx * local_rows, local_rows, axis=1), mbs)
@@ -242,6 +251,27 @@ def _ppo_surrogate(mb, cfg, values, logp, entropy):
 def ppo_loss(apply, params, mb, cfg) -> Tuple[jnp.ndarray, Dict]:
     """Clipped-surrogate PPO loss (categorical actions)."""
     values, logp, _adv, entropy = policy_terms(apply, params, mb)
+    return _ppo_surrogate(mb, cfg, values, logp, entropy)
+
+
+def ppo_loss_recurrent(apply_seq, params, mb, cfg) -> Tuple[jnp.ndarray,
+                                                            Dict]:
+    """Clipped-surrogate PPO over LSTM sequence chunks.  Minibatch rows
+    are SEQUENCES: OBS [b, T, D], actions/logp/advantages/targets
+    [b, T], resets [b, T], state_in [b, 2, H] (reference:
+    rnn_sequencing.py chunked training — here a masked-reset lax.scan
+    replay instead of padded variable-length sequences)."""
+    obs = jnp.moveaxis(mb[SampleBatch.OBS], 0, 1)        # [T, b, D]
+    resets = mb["resets"].T                              # [T, b]
+    state0 = jnp.moveaxis(mb["state_in"], 0, 1)          # [2, b, H]
+    logits, values = apply_seq(params, obs, state0, resets)
+    logits = jnp.moveaxis(logits, 0, 1)                  # [b, T, A]
+    values = values.T                                    # [b, T]
+    logp_all = jax.nn.log_softmax(logits)
+    actions = mb[SampleBatch.ACTIONS].astype(jnp.int32)
+    logp = jnp.take_along_axis(logp_all, actions[..., None],
+                               axis=-1)[..., 0]
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
     return _ppo_surrogate(mb, cfg, values, logp, entropy)
 
 

@@ -206,3 +206,104 @@ def make_q_network(obs_dim: int, action_dim: int,
         return model.init(rng, jnp.zeros((1, obs_dim), jnp.float32),
                           jnp.zeros((1, action_dim), jnp.float32))
     return init_params, model.apply
+
+
+# ---------------------------------------------------------------------------
+# Recurrent (LSTM) actor-critic.
+#
+# Reference parity: rllib/models/torch/recurrent_net.py (LSTMWrapper: an
+# fcnet encoder feeding an LSTM whose hidden state threads through
+# state_in/state_out) + rllib/policy/rnn_sequencing.py (training over
+# fixed-length chunks with per-boundary state resets).  TPU-first
+# differences: the cell is hand-rolled so training is one lax.scan with a
+# masked carry reset at episode boundaries — static shapes, no ragged
+# padding, everything fuses under jit.
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(rng, n_in, n_out, scale=None):
+    scale = np.sqrt(2.0 / n_in) if scale is None else scale
+    return {"w": jax.random.normal(rng, (n_in, n_out)) * scale,
+            "b": jnp.zeros((n_out,))}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _lstm_step(p, carry, x):
+    """One LSTM step: carry = (h, c), gates in i/f/g/o order; forget-gate
+    bias +1 (standard recurrent-training stabilizer)."""
+    h, c = carry
+    z = x @ p["wx"] + h @ p["wh"] + p["b"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c)
+
+
+def make_recurrent_model(obs_dim: int, num_actions: int,
+                         hidden: Sequence[int] = (64,),
+                         lstm_size: int = 64):
+    """Returns (init_params, apply_step, apply_seq, initial_state):
+
+    - apply_step(params, obs[B,D], state[2,B,H]) ->
+          (logits[B,A], value[B], state_out[2,B,H])   — rollout inference
+    - apply_seq(params, obs[T,B,D], state0[2,B,H], resets[T,B]) ->
+          (logits[T,B,A], values[T,B])                — chunked training;
+      resets[t] True zeroes the carry BEFORE consuming step t (episode
+      boundaries inside the chunk).
+    - initial_state(batch) -> zeros [2, batch, lstm_size]
+    """
+    obs_dim = int(obs_dim)
+
+    def init_params(rng: jax.Array):
+        ks = jax.random.split(rng, len(hidden) + 4)
+        enc = []
+        n_in = obs_dim
+        for i, h in enumerate(hidden):
+            enc.append(_dense_init(ks[i], n_in, h))
+            n_in = h
+        k = len(hidden)
+        lstm = {
+            "wx": jax.random.normal(ks[k], (n_in, 4 * lstm_size))
+            * np.sqrt(1.0 / n_in),
+            "wh": jax.random.normal(ks[k + 1], (lstm_size, 4 * lstm_size))
+            * np.sqrt(1.0 / lstm_size),
+            "b": jnp.zeros((4 * lstm_size,)),
+        }
+        return {"enc": enc, "lstm": lstm,
+                "pi": _dense_init(ks[k + 2], lstm_size, num_actions,
+                                  scale=0.01),
+                "vf": _dense_init(ks[k + 3], lstm_size, 1, scale=1.0)}
+
+    def _encode(params, obs):
+        x = obs
+        for p in params["enc"]:
+            x = jnp.tanh(_dense(p, x))
+        return x
+
+    def apply_step(params, obs, state):
+        x = _encode(params, obs)
+        h, c = _lstm_step(params["lstm"], (state[0], state[1]), x)
+        return (_dense(params["pi"], h), _dense(params["vf"], h)[..., 0],
+                jnp.stack([h, c]))
+
+    def apply_seq(params, obs, state0, resets):
+        x = _encode(params, obs)           # [T, B, E]
+
+        def step(carry, inp):
+            xt, rt = inp
+            mask = (~rt)[:, None].astype(xt.dtype)
+            carry = (carry[0] * mask, carry[1] * mask)
+            carry = _lstm_step(params["lstm"], carry, xt)
+            return carry, carry[0]
+
+        _, hs = jax.lax.scan(step, (state0[0], state0[1]), (x, resets))
+        return (_dense(params["pi"], hs),
+                _dense(params["vf"], hs)[..., 0])
+
+    def initial_state(batch: int) -> np.ndarray:
+        return np.zeros((2, batch, lstm_size), np.float32)
+
+    return init_params, apply_step, apply_seq, initial_state

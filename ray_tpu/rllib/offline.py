@@ -176,3 +176,72 @@ class BC:
     def get_weights(self):
         import jax
         return jax.device_get(self.params)
+
+
+# ---------------------------------------------------------------------------
+# Data-native experience IO (reference: rllib/offline/dataset_reader.py —
+# offline data flows through the Data layer: Parquet files, parallel block
+# reads, streaming batches into the learner instead of one monolithic
+# in-memory SampleBatch).
+# ---------------------------------------------------------------------------
+
+
+class ParquetWriter:
+    """Append SampleBatches as Parquet files — the Data-native experience
+    format (columnar, compressed, parallel-readable).  Multi-dim columns
+    (observations) are stored as nested lists; shapes reconstruct on
+    read."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._index = 0
+
+    def write(self, batch: SampleBatch) -> None:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        cols = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            cols[k] = (arr.tolist() if arr.ndim > 1 else arr)
+        table = pa.table(cols)
+        pq.write_table(table, os.path.join(
+            self.path, f"part-{self._index:05d}.parquet"))
+        self._index += 1
+
+    def close(self) -> None:
+        pass
+
+
+def _numpy_batch_to_sample(batch: Dict[str, Any]) -> SampleBatch:
+    out = {}
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        if arr.dtype == object:          # nested-list column -> ndarray
+            arr = np.asarray([np.asarray(x) for x in v])
+        out[k] = arr
+    return SampleBatch(out)
+
+
+class DatasetReader:
+    """Stream SampleBatches out of a `ray_tpu.data` Dataset (reference:
+    offline/dataset_reader.py).  Blocks are read by data-plane tasks in
+    parallel and flow through iter_batches with prefetch — the learner
+    never materializes the whole log."""
+
+    def __init__(self, dataset, batch_size: int = 1024):
+        self._ds = dataset
+        self._batch_size = batch_size
+
+    @classmethod
+    def from_path(cls, path: str, batch_size: int = 1024) -> "DatasetReader":
+        from ray_tpu import data as rdata
+        return cls(rdata.read_parquet(path), batch_size)
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        for b in self._ds.iter_batches(batch_size=self._batch_size,
+                                       batch_format="numpy"):
+            yield _numpy_batch_to_sample(b)
+
+    def read_all(self) -> SampleBatch:
+        return SampleBatch.concat_samples(list(self))

@@ -230,3 +230,64 @@ class DeterministicNoiseRolloutPolicy(_ContinuousRolloutPolicy):
             a, mean = self._act(self.params, obs, sub, sigma)
             z = np.zeros(len(obs), np.float32)
             return np.asarray(a), z, z, np.asarray(mean)
+
+
+class RecurrentJaxPolicy:
+    """LSTM actor-critic policy with explicit state threading
+    (reference: rllib/policy — compute_actions' state_batches /
+    get_initial_state).  compute_actions takes and returns the recurrent
+    state; the rollout worker owns per-env state and resets it at episode
+    boundaries."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64,), lstm_size: int = 64,
+                 seed: int = 0, force_cpu: bool = True):
+        from ray_tpu.rllib.models import make_recurrent_model
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.continuous = False
+        self.lstm_size = lstm_size
+        self._device = None
+        if force_cpu and jax.default_backend() != "cpu":
+            self._device = jax.local_devices(backend="cpu")[0]
+        init_params, self.apply_step, self.apply_seq, self.initial_state \
+            = make_recurrent_model(obs_dim, num_actions, hidden, lstm_size)
+
+        def _sample(params, obs, state, rng):
+            logits, value, state_out = self.apply_step(params, obs, state)
+            action = jax.random.categorical(rng, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(action.shape[0]), action]
+            return action, logp, value, logits, state_out
+
+        def _greedy(params, obs, state):
+            logits, value, state_out = self.apply_step(params, obs, state)
+            return jnp.argmax(logits, axis=-1), value, logits, state_out
+
+        with self._ctx():
+            self.params = init_params(jax.random.key(seed))
+            self._rng = jax.random.key(seed + 1)
+            self._sample = jax.jit(_sample)
+            self._greedy = jax.jit(_greedy)
+
+    _ctx = JaxPolicy._ctx
+
+    def compute_actions(self, obs: np.ndarray, state: np.ndarray,
+                        explore: bool = True):
+        """(actions, logp, vf, logits, state_out) — state is [2, B, H]."""
+        with self._ctx():
+            obs = jnp.asarray(obs, jnp.float32)
+            state = jnp.asarray(state)
+            if explore:
+                self._rng, sub = jax.random.split(self._rng)
+                a, logp, v, logits, s = self._sample(
+                    self.params, obs, state, sub)
+                return (np.asarray(a), np.asarray(logp), np.asarray(v),
+                        np.asarray(logits), np.asarray(s))
+            a, v, logits, s = self._greedy(self.params, obs, state)
+            z = np.zeros(len(obs), np.float32)
+            return (np.asarray(a), z, np.asarray(v), np.asarray(logits),
+                    np.asarray(s))
+
+    get_weights = JaxPolicy.get_weights
+    set_weights = JaxPolicy.set_weights

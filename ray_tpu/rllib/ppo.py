@@ -12,7 +12,12 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.learner import JaxLearner, ppo_loss, ppo_loss_continuous
+from ray_tpu.rllib.learner import (
+    JaxLearner,
+    ppo_loss,
+    ppo_loss_continuous,
+    ppo_loss_recurrent,
+)
 from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
@@ -60,7 +65,9 @@ class PPO(Algorithm):
                 env=cfg.env, num_envs=cfg.num_envs_per_worker,
                 rollout_fragment_length=cfg.rollout_fragment_length,
                 gamma=cfg.gamma, lam=cfg.lambda_,
-                hidden=cfg.model_hidden, seed=cfg.seed, postprocess=True))
+                hidden=cfg.model_hidden, seed=cfg.seed, postprocess=True,
+                **({"policy_kind": "recurrent",
+                    "lstm_size": cfg.lstm_size} if cfg.use_lstm else {})))
         self.learner = self._make_learner()
         self.workers.sync_weights(self.learner.get_weights())
 
@@ -71,9 +78,18 @@ class PPO(Algorithm):
         cfg = self.config
         obs_dim, num_actions = spec if spec else (self.obs_dim,
                                                   self.num_actions)
+        use_lstm = getattr(cfg, "use_lstm", False)
+        if use_lstm:
+            loss = ppo_loss_recurrent
+        elif self.continuous:
+            loss = ppo_loss_continuous
+        else:
+            loss = ppo_loss
         return JaxLearner(
             obs_dim, num_actions, action_dim=self.action_dim,
-            loss_fn=(ppo_loss_continuous if self.continuous else ppo_loss),
+            model=("lstm" if use_lstm else "fc"),
+            lstm_size=getattr(cfg, "lstm_size", 64),
+            loss_fn=loss,
             config={
                 "lr": cfg.lr, "grad_clip": cfg.grad_clip,
                 "num_sgd_iter": cfg.num_sgd_iter,

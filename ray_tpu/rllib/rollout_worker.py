@@ -50,6 +50,7 @@ class RolloutWorker:
                  postprocess: bool = True,
                  epsilon_schedule=None,
                  policy_kind: str = "actor_critic",
+                 lstm_size: int = 64,
                  exploration_noise: float = 0.1,
                  random_warmup_steps: int = 0,
                  exploration=None,
@@ -85,7 +86,14 @@ class RolloutWorker:
             probe = obs_connector(self.env.reset_all(seed))
             policy_obs_dim = (probe.shape[1] if probe.ndim == 2
                               else tuple(probe.shape[1:]))
-        if policy_kind == "actor_critic":
+        self._rnn_state = None
+        if policy_kind == "recurrent":
+            from ray_tpu.rllib.policy import RecurrentJaxPolicy
+            self.policy = RecurrentJaxPolicy(
+                policy_obs_dim, self.env.num_actions, hidden,
+                lstm_size=lstm_size, seed=seed)
+            self._rnn_state = self.policy.initial_state(num_envs)
+        elif policy_kind == "actor_critic":
             self.policy = JaxPolicy(
                 policy_obs_dim, self.env.num_actions, hidden,
                 seed=seed, action_dim=action_dim,
@@ -143,6 +151,8 @@ class RolloutWorker:
         path); otherwise it stays time-major [T, B, ...] with behavior
         logits (IMPALA/V-trace path).
         """
+        if self._rnn_state is not None:
+            return self._sample_recurrent()
         T, B = self.fragment_length, self.num_envs
         # Image envs declare a shape tuple + uint8 observations; buffers
         # follow the (possibly connector-transformed) obs the policy
@@ -234,6 +244,100 @@ class RolloutWorker:
         })
         return batch, metrics
 
+    def _sample_recurrent(self) -> Tuple[SampleBatch, Dict]:
+        """Fragment collection with LSTM state threading (reference:
+        sampler state_batches + rnn_sequencing).  The chunk IS the
+        max_seq_len unit: training replays the whole [T] fragment from
+        the recorded initial state, zeroing the carry at episode
+        boundaries via the `resets` mask — the static-shape equivalent
+        of the reference's padded sequence batches.
+
+        Batch layout: postprocess=True -> sequence-major [B, T, ...]
+        rows (the learner minibatches over SEQUENCES); otherwise
+        time-major [T, B, ...] for the V-trace path.  Extra columns:
+        state_in ([B, 2, H] / [2, B, H]), resets, dones."""
+        T, B = self.fragment_length, self.num_envs
+        obs_buf = np.empty((T, B) + self.obs.shape[1:], self.obs.dtype)
+        act_buf = np.empty((T, B), np.int32)
+        logits_buf = np.empty((T, B, self.env.num_actions), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), np.bool_)
+        trunc_buf = np.empty((T, B), np.bool_)
+        logp_buf = np.empty((T, B), np.float32)
+        vf_buf = np.empty((T, B), np.float32)
+        resets_buf = np.zeros((T, B), np.bool_)
+
+        state_in = self._rnn_state.copy()    # [2, B, H] at fragment start
+        obs = self.obs
+        state = self._rnn_state
+        for t in range(T):
+            actions, logp, vf, logits, state = \
+                self.policy.compute_actions(obs, state)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            vf_buf[t] = vf
+            logits_buf[t] = logits
+            env_actions = (self._action_connector(actions)
+                           if self._action_connector is not None
+                           else actions)
+            obs, rew, term, trunc = self.env.step(env_actions)
+            if self._obs_connector is not None:
+                obs = self._obs_connector(obs)
+            rew_buf[t] = rew
+            term_buf[t] = term
+            trunc_buf[t] = trunc
+            done = term | trunc
+            if done.any():
+                # Auto-reset envs: zero the carry for finished episodes;
+                # the NEXT consumed step starts fresh (mirrored by the
+                # resets mask during training).  Copy: the policy returns
+                # a read-only view of a device buffer.
+                state = state.copy()
+                state[:, done, :] = 0.0
+                if t + 1 < T:
+                    resets_buf[t + 1, done] = True
+        self.obs = obs
+        self._rnn_state = state
+        self._total_steps += T * B
+
+        rets, lens = self.env.drain_episode_metrics()
+        metrics = {"episode_returns": rets, "episode_lengths": lens,
+                   "env_steps": T * B, "total_env_steps": self._total_steps}
+
+        if not self.postprocess:
+            batch = SampleBatch({
+                SampleBatch.OBS: obs_buf, SampleBatch.ACTIONS: act_buf,
+                SampleBatch.REWARDS: rew_buf,
+                SampleBatch.TERMINATEDS: term_buf,
+                SampleBatch.TRUNCATEDS: trunc_buf,
+                SampleBatch.ACTION_LOGP: logp_buf,
+                SampleBatch.ACTION_LOGITS: logits_buf,
+                "state_in": state_in,         # [2, B, H]
+                "resets": resets_buf,         # [T, B]
+                "bootstrap_obs": self.obs,
+                "bootstrap_state": self._rnn_state.copy(),
+            })
+            return batch, metrics
+
+        done = term_buf | trunc_buf
+        _, _, bootstrap_vf, _, _ = self.policy.compute_actions(
+            self.obs, self._rnn_state)
+        adv, targets = compute_gae(rew_buf, vf_buf, done, bootstrap_vf,
+                                   self.gamma, self.lam)
+        seq = lambda x: np.moveaxis(x, 0, 1)   # [T,B,...] -> [B,T,...]
+        batch = SampleBatch({
+            SampleBatch.OBS: seq(obs_buf),
+            SampleBatch.ACTIONS: seq(act_buf),
+            SampleBatch.ACTION_LOGP: seq(logp_buf),
+            SampleBatch.VF_PREDS: seq(vf_buf),
+            SampleBatch.ADVANTAGES: seq(adv),
+            SampleBatch.VALUE_TARGETS: seq(targets),
+            "resets": seq(resets_buf),                    # [B, T]
+            "state_in": np.moveaxis(state_in, 0, 1),      # [B, 2, H]
+        })
+        return batch, metrics
+
     def evaluate(self, num_episodes: int = 10,
                  max_steps: int = 1000) -> Dict:
         """Greedy-policy evaluation rollouts."""
@@ -241,6 +345,26 @@ class RolloutWorker:
         returns: list = []
         obs = self.obs
         steps = 0
+        if self._rnn_state is not None:
+            state = self.policy.initial_state(self.num_envs)
+            while len(returns) < num_episodes and steps < max_steps:
+                actions, _, _, _, state = self.policy.compute_actions(
+                    obs, state, explore=False)
+                if self._action_connector is not None:
+                    actions = self._action_connector(actions)
+                obs, _, term, trunc = self.env.step(actions)
+                if self._obs_connector is not None:
+                    obs = self._obs_connector(obs)
+                done = term | trunc
+                if done.any():
+                    state = state.copy()
+                    state[:, done, :] = 0.0
+                steps += 1
+                rets, _ = self.env.drain_episode_metrics()
+                returns.extend(rets)
+            self.obs = obs
+            self._rnn_state = self.policy.initial_state(self.num_envs)
+            return {"episode_returns": returns}
         while len(returns) < num_episodes and steps < max_steps:
             actions, _, _, _ = self.policy.compute_actions(obs, explore=False)
             if self._action_connector is not None:

@@ -507,6 +507,61 @@ def cmd_rllib_evaluate(args) -> int:
     return 0
 
 
+def cmd_rllib_evaluate_offline(args) -> int:
+    """Off-policy evaluation of a checkpointed policy against logged
+    experiences (reference: rllib/offline/estimators — `rllib train
+    --evaluate-offline` workflow)."""
+    import importlib
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.rllib.estimators import ESTIMATORS, fit_fqe
+    from ray_tpu.rllib.offline import JsonReader
+    mod_name, cfg_name = _RLLIB_ALGOS[args.algo]
+    cfg_cls = getattr(importlib.import_module(mod_name), cfg_name)
+    ray_tpu.init()
+    cfg = (cfg_cls().environment(args.env)
+           .rollouts(num_rollout_workers=0)
+           .debugging(seed=args.seed))
+    algo = cfg.build()
+    try:
+        algo.restore(Checkpoint.from_directory(args.checkpoint))
+        policy = algo.workers.local_worker.policy
+        if getattr(policy, "num_actions", 0) == 0:
+            print("evaluate-offline requires a discrete-action policy "
+                  "(the IS/WIS/DM/DR estimators are categorical)")
+            return 2
+
+        def target_probs(obs):
+            _a, _z, _v, logits = policy.compute_actions(
+                np.asarray(obs), explore=False)
+            z = logits - logits.max(-1, keepdims=True)
+            e = np.exp(z)
+            return e / e.sum(-1, keepdims=True)
+
+        batch = JsonReader(args.data).read_all()
+        names = [n.strip() for n in args.estimators.split(",") if n.strip()]
+        q_fn = None
+        if any(n in ("dm", "dr") for n in names):
+            q_fn = fit_fqe(batch, target_probs,
+                           num_actions=policy.num_actions,
+                           gamma=args.gamma, seed=args.seed)
+        for name in names:
+            cls = ESTIMATORS[name]
+            out = cls(target_probs, gamma=args.gamma,
+                      q_fn=q_fn).estimate(batch)
+            print(f"{name:4s} v_target={out['v_target']:.3f} "
+                  f"v_behavior={out['v_behavior']:.3f} "
+                  f"v_gain={out['v_gain']:+.3f} "
+                  f"({out['episodes']} episodes)")
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+    return 0
+
+
 def cmd_up(args) -> int:
     from ray_tpu.autoscaler import launcher
     state = launcher.create_or_update_cluster(
@@ -670,6 +725,19 @@ def main(argv=None) -> int:
     re_.add_argument("--episodes", type=int, default=10)
     re_.add_argument("--seed", type=int, default=0)
     re_.set_defaults(fn=cmd_rllib_evaluate)
+    ro = rsub.add_parser(
+        "evaluate-offline",
+        help="off-policy estimates of a checkpointed policy on logged "
+             "data (reference: rllib/offline/estimators)")
+    ro.add_argument("checkpoint")
+    ro.add_argument("--data", required=True,
+                    help="JSON experience directory (JsonWriter output)")
+    ro.add_argument("--algo", choices=sorted(_RLLIB_ALGOS), default="PPO")
+    ro.add_argument("--env", default="CartPole-v1")
+    ro.add_argument("--estimators", default="is,wis,dm,dr")
+    ro.add_argument("--gamma", type=float, default=0.99)
+    ro.add_argument("--seed", type=int, default=0)
+    ro.set_defaults(fn=cmd_rllib_evaluate_offline)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -11,6 +11,7 @@ eventual-consistency contract, no blocked actor threads).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -74,6 +75,13 @@ class ReplicaActor:
             max_workers=max(1, min(max_concurrent_queries, 64)),
             thread_name_prefix="replica-sync")
         self._ongoing = 0
+        # In-progress streaming responses: stream id -> async generator
+        # (reference: replica-side generator streaming, replica.py's
+        # handle_request_streaming).  Chunks are PULLED by the caller
+        # (proxy or handle) one next_chunk() at a time — incremental by
+        # construction, replica-pinned by the router.
+        self._streams: dict = {}
+        self._stream_ids = itertools.count(1)
 
     async def handle_request(self, method_name, args, kwargs):
         import asyncio
@@ -86,6 +94,15 @@ class ReplicaActor:
             elif not callable(target):
                 raise TypeError("deployment object is not callable")
             kwargs = kwargs or {}
+            if inspect.isasyncgenfunction(target) or inspect.isgeneratorfunction(target):
+                # Streaming method: stash the generator and hand back a
+                # stream ticket; the in-flight slot stays charged until
+                # the consumer drains or cancels (next_chunk below).
+                gen = target(*args, **kwargs)
+                sid = next(self._stream_ids)
+                self._streams[sid] = gen
+                self._ongoing += 1   # held until stream end
+                return {"__serve_stream__": sid}
             if inspect.iscoroutinefunction(target) or (
                     not inspect.isfunction(target)
                     and not inspect.ismethod(target)
@@ -100,6 +117,57 @@ class ReplicaActor:
                 return await result
             return result
         finally:
+            self._ongoing -= 1
+
+    async def next_chunk(self, sid: int):
+        """Pull ONE chunk of stream `sid`: {"chunk": value} or
+        {"done": True}.  Sync generators advance on the thread pool so
+        they cannot stall the replica loop."""
+        import asyncio
+        import inspect
+        gen = self._streams.get(sid)
+        if gen is None:
+            return {"done": True}
+        try:
+            if inspect.isasyncgen(gen):
+                chunk = await gen.__anext__()
+            else:
+                # StopIteration cannot cross a Future: pull behind a
+                # sentinel on the thread pool.
+                def _pull():
+                    try:
+                        return True, gen.__next__()
+                    except StopIteration:
+                        return False, None
+                loop = asyncio.get_running_loop()
+                alive, chunk = await loop.run_in_executor(self._pool,
+                                                          _pull)
+                if not alive:
+                    self._finish_stream(sid)
+                    return {"done": True}
+            return {"chunk": chunk}
+        except StopAsyncIteration:
+            self._finish_stream(sid)
+            return {"done": True}
+        except Exception:
+            self._finish_stream(sid)
+            raise
+
+    async def cancel_stream(self, sid: int):
+        gen = self._streams.get(sid)
+        if gen is not None:
+            try:
+                if hasattr(gen, "aclose"):
+                    await gen.aclose()
+                else:
+                    gen.close()
+            except Exception:
+                pass
+            self._finish_stream(sid)
+        return True
+
+    def _finish_stream(self, sid: int) -> None:
+        if self._streams.pop(sid, None) is not None:
             self._ongoing -= 1
 
     async def ongoing_requests(self) -> int:
@@ -503,6 +571,97 @@ class DeploymentHandle:
                     f"no replica of {self._name!r} under its "
                     f"max_concurrent_queries cap within 60s")
             time.sleep(0.01)  # every replica saturated: backpressure
+
+    def stream(self, *args, **kwargs):
+        """Synchronous streaming call: yields the chunks of a generator
+        (or async-generator) deployment method INCREMENTALLY — each
+        chunk is pulled from the replica on demand (reference: streaming
+        DeploymentResponseGenerator over handle_request_streaming).
+        Replica-pinned: every chunk comes from the replica that started
+        the stream."""
+        self._refresh()
+        deadline = time.monotonic() + 60
+        while True:
+            pick = self._pick_replica()
+            if pick is not None:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {self._name!r} under its "
+                    f"max_concurrent_queries cap within 60s")
+            time.sleep(0.01)
+        replica, key = pick
+        try:
+            ticket = ray_tpu.get(replica.handle_request.remote(
+                self._method, args, kwargs), timeout=60)
+            if not (isinstance(ticket, dict)
+                    and "__serve_stream__" in ticket):
+                # Non-generator method: degrade to a one-item stream.
+                yield ticket
+                return
+            sid = ticket["__serve_stream__"]
+            try:
+                while True:
+                    out = ray_tpu.get(replica.next_chunk.remote(sid),
+                                      timeout=60)
+                    if out.get("done"):
+                        return
+                    yield out["chunk"]
+            except GeneratorExit:
+                try:
+                    ray_tpu.get(replica.cancel_stream.remote(sid),
+                                timeout=10)
+                except Exception:
+                    pass
+                raise
+        finally:
+            self._done(key)
+
+    async def stream_async(self, method, args, kwargs, *,
+                           timeout: float = 60.0):
+        """Async streaming variant (the proxy's path): an async
+        generator over the method's chunks."""
+        import asyncio
+        self._refresh()
+        deadline = time.monotonic() + timeout
+        while True:
+            pick = self._pick_replica()
+            if pick is not None:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {self._name!r} under its "
+                    f"max_concurrent_queries cap within {timeout}s")
+            await asyncio.sleep(0.005)
+        replica, key = pick
+        try:
+            # Per-step timeout: a wedged generator must not hold this
+            # coroutine (and the in-flight slot) forever — mirror the
+            # sync stream()'s bounded gets.
+            ticket = await asyncio.wait_for(asyncio.wrap_future(
+                replica.handle_request.remote(method, args,
+                                              kwargs).future()), timeout)
+            if not (isinstance(ticket, dict)
+                    and "__serve_stream__" in ticket):
+                yield ticket
+                return
+            sid = ticket["__serve_stream__"]
+            try:
+                while True:
+                    out = await asyncio.wait_for(asyncio.wrap_future(
+                        replica.next_chunk.remote(sid).future()), timeout)
+                    if out.get("done"):
+                        return
+                    yield out["chunk"]
+            except (GeneratorExit, asyncio.TimeoutError):
+                try:
+                    await asyncio.wait_for(asyncio.wrap_future(
+                        replica.cancel_stream.remote(sid).future()), 10)
+                except Exception:
+                    pass
+                raise
+        finally:
+            self._done(key)
 
     async def call_async(self, method, args, kwargs, *,
                          timeout: float = 60.0, _retried=False):

@@ -58,11 +58,62 @@ class HTTPProxyActor:
         async def healthz(_):
             return web.Response(text="ok")
 
+        async def dispatch_asgi(request: web.Request):
+            """ASGI path: /{deployment}/{tail} — the replica runs the
+            mounted app and streams response events back; chunked bodies
+            flow to the HTTP client as they are produced (reference:
+            http_proxy.py ASGI host + streaming responses)."""
+            name = request.match_info["deployment"]
+            handle = handles.get(name)
+            if handle is None:
+                handle = DeploymentHandle(name)
+                handles[name] = handle
+            req = {
+                "method": request.method,
+                "path": "/" + request.match_info.get("tail", ""),
+                "query_string": request.query_string,
+                "root_path": "/" + name,
+                "headers": [(k, v) for k, v in request.headers.items()],
+                "body": await request.read(),
+            }
+            try:
+                agen = handle.stream_async("__asgi_call__", (req,), {})
+                first = await agen.__anext__()
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            except StopAsyncIteration:
+                return web.Response(status=500, text="empty ASGI response")
+            except Exception as e:  # noqa: BLE001 — incl. non-ASGI targets
+                if "__asgi_call__" in str(e) or isinstance(e,
+                                                           AttributeError):
+                    return web.json_response(
+                        {"error": f"deployment {name!r} does not mount "
+                                  f"an ASGI app"}, status=404)
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500)
+            from multidict import CIMultiDict
+            hdrs = CIMultiDict()
+            for k, v in first.get("headers", []):
+                # Duplicate names are legitimate (Set-Cookie); only the
+                # framing headers are ours to manage.
+                if k.lower() not in ("content-length",
+                                     "transfer-encoding"):
+                    hdrs.add(k, v)
+            resp = web.StreamResponse(status=first.get("status", 200),
+                                      headers=hdrs)
+            await resp.prepare(request)
+            async for chunk in agen:
+                await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+
         def serve_forever():
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             app = web.Application()
             app.router.add_get("/-/healthz", healthz)
+            app.router.add_route("*", "/{deployment}/{tail:.*}",
+                                 dispatch_asgi)
             app.router.add_route("*", "/{deployment}", dispatch)
             runner = web.AppRunner(app)
             loop.run_until_complete(runner.setup())

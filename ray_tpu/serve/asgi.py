@@ -1,0 +1,135 @@
+"""ASGI ingress for Serve deployments.
+
+Reference parity: serve/api.py `@serve.ingress(app)` (mount a
+FastAPI/Starlette/any-ASGI app on a deployment) + the proxy's ASGI host
+(serve/_private/http_proxy.py:250).  Here the replica RUNS the ASGI
+protocol itself and streams response events back through the generic
+replica streaming plane (_private.ReplicaActor.next_chunk), so chunked/
+SSE responses flow to the HTTP client incrementally and replica-pinned.
+
+Usage — any ASGI callable works (no framework dependency):
+
+    async def app(scope, receive, send): ...
+    @serve.deployment
+    @serve.ingress(app)
+    class Api:
+        pass
+
+Requests to /{deployment}/{path} reach the app with `path` as its route
+(root_path = /{deployment}).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Dict
+
+
+def ingress(asgi_app: Callable):
+    """Class decorator mounting an ASGI app on the deployment.  The
+    wrapped class gains `__asgi_call__`, an async generator the proxy
+    consumes: first item = {"status", "headers"}, then body chunks."""
+
+    def wrap(cls):
+        class AsgiWrapped(cls):
+            __serve_asgi__ = True
+
+            async def __asgi_call__(self, request: Dict[str, Any]):
+                app = getattr(self, "__asgi_app__", None)
+                if app is None:
+                    app = asgi_app
+                    # Factory support (@ingress(lambda: build_app())):
+                    # build ONCE PER REPLICA — per-request construction
+                    # would reset in-app state and re-pay route setup.
+                    if not _looks_asgi(app):
+                        app = app()
+                    self.__asgi_app__ = app
+                async for event in _run_asgi(app, request):
+                    yield event
+
+        AsgiWrapped.__name__ = cls.__name__
+        AsgiWrapped.__qualname__ = getattr(cls, "__qualname__",
+                                           cls.__name__)
+        return AsgiWrapped
+
+    return wrap
+
+
+def _looks_asgi(app) -> bool:
+    import inspect
+    if inspect.iscoroutinefunction(app):
+        return True
+    call = getattr(app, "__call__", None)
+    return call is not None and inspect.iscoroutinefunction(call)
+
+
+async def _run_asgi(app, request: Dict[str, Any]):
+    """Drive one ASGI http request/response cycle, yielding the response
+    start followed by each body chunk AS THE APP PRODUCES THEM (a
+    bounded queue hands events from the app task to this generator, so
+    a slow consumer backpressures the app)."""
+    body = request.get("body") or b""
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.get("method", "GET"),
+        "scheme": "http",
+        "path": request.get("path", "/"),
+        "raw_path": request.get("path", "/").encode(),
+        "query_string": request.get("query_string", "").encode(),
+        "root_path": request.get("root_path", ""),
+        "headers": [(k.encode().lower(), v.encode())
+                    for k, v in request.get("headers", [])],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 0),
+    }
+    consumed = False
+
+    async def receive():
+        nonlocal consumed
+        if consumed:
+            return {"type": "http.disconnect"}
+        consumed = True
+        return {"type": "http.request", "body": body, "more_body": False}
+
+    events: asyncio.Queue = asyncio.Queue(maxsize=4)
+
+    async def send(event):
+        await events.put(event)
+
+    async def run():
+        try:
+            await app(scope, receive, send)
+        except Exception as e:  # surfaces as a 500 with the error text
+            await events.put({"type": "__error__", "error": repr(e)})
+        finally:
+            await events.put(None)
+
+    task = asyncio.ensure_future(run())
+    started = False
+    try:
+        while True:
+            ev = await events.get()
+            if ev is None:
+                return
+            kind = ev.get("type")
+            if kind == "__error__":
+                if not started:
+                    yield {"status": 500,
+                           "headers": [("content-type", "text/plain")]}
+                    yield ev["error"].encode()
+                return
+            if kind == "http.response.start":
+                started = True
+                yield {"status": ev.get("status", 200),
+                       "headers": [(k.decode(), v.decode())
+                                   for k, v in ev.get("headers", [])]}
+            elif kind == "http.response.body":
+                chunk = ev.get("body", b"")
+                if chunk:
+                    yield bytes(chunk)
+                if not ev.get("more_body", False):
+                    return
+    finally:
+        task.cancel()

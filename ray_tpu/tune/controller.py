@@ -60,6 +60,8 @@ class TuneController:
         self._scheduler = scheduler or sched_mod.FIFOScheduler()
         self._max_concurrent = max_concurrent
         self._resources = dict(resources_per_trial or {"CPU": 1})
+        self._capacity_cap: Optional[int] = None  # from cluster totals
+        self._capacity_cap_at = 0.0
         self._run_config = run_config or RunConfig()
         self._max_failures = max_failures_per_trial
         self.trials: List[Trial] = []
@@ -115,10 +117,42 @@ class TuneController:
     def _running(self) -> List[Trial]:
         return [t for t in self.trials if t.state == "RUNNING"]
 
+    def _resource_cap(self) -> int:
+        """How many trials the CLUSTER can run at once (reference: the
+        trial executor only starts trials whose resources fit).  The
+        controller must never block on a trial whose actor is queued for
+        resources — `_start_trial`'s init get would starve the RUNNING
+        trials that will free them (livelock until timeout).  Refreshed
+        every ~2s so an autoscaling cluster raises the cap."""
+        now = time.monotonic()
+        if self._capacity_cap is not None \
+                and now - self._capacity_cap_at < 2.0:
+            return self._capacity_cap
+        try:
+            total = ray_tpu.cluster_resources()
+        except Exception:
+            total = None
+        cap = None
+        if total is not None:
+            for k, need in self._resources.items():
+                if not need:
+                    continue
+                # A demanded resource ABSENT from the cluster caps at 1:
+                # one launch surfaces the pend/failure instead of a
+                # thundering start that livelocks on init.
+                fit = int(total.get(k, 0) / need)
+                cap = fit if cap is None else min(cap, fit)
+        self._capacity_cap = max(1, cap) if cap is not None \
+            else self._max_concurrent
+        self._capacity_cap_at = now
+        return self._capacity_cap
+
     def step(self) -> bool:
         """One controller iteration; False when everything is done."""
-        # 1. Launch new/pending trials up to the concurrency cap.
-        while len(self._running()) < self._max_concurrent:
+        # 1. Launch new/pending trials up to the concurrency AND
+        # cluster-capacity caps.
+        launch_cap = min(self._max_concurrent, self._resource_cap())
+        while len(self._running()) < launch_cap:
             pending = next((t for t in self.trials if t.state == "PENDING"),
                            None)
             if pending is None:

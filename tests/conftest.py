@@ -38,6 +38,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long learning-gate tests (deselect with "
         "-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "examples: executes the committed examples/ scripts "
+        "as subprocesses (select with -m examples)")
 
 
 @pytest.fixture

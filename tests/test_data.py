@@ -422,3 +422,67 @@ def test_streaming_spans_all_operators(cluster, tmp_path):
     assert len(rest) == n_blocks - 1
     np.testing.assert_array_equal(
         rest[-1], np.full(4, ((n_blocks - 1) * 100 + 1) * 2 - 1.0))
+
+
+# ---------------------------------------------------------------------------
+# Logical plan + optimizer (reference: data/_internal/logical/optimizers.py)
+# ---------------------------------------------------------------------------
+
+
+def _write_parts(tmp_path, n_files=8, rows=100):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path / "parts"
+    d.mkdir(exist_ok=True)
+    for i in range(n_files):
+        t = pa.table({"a": list(range(i * rows, (i + 1) * rows)),
+                      "b": [float(x) for x in range(rows)],
+                      "c": ["x"] * rows})
+        pq.write_table(t, str(d / f"p-{i:03d}.parquet"))
+    return str(d)
+
+
+def test_limit_pushdown_reads_fewer_blocks(cluster, tmp_path):
+    """read_parquet(...).limit(n) launches read tasks for only the file
+    prefix covering n rows (row counts from Parquet METADATA)."""
+    from ray_tpu import data as rdata
+    path = _write_parts(tmp_path, n_files=8, rows=100)
+    ds = rdata.read_parquet(path).limit(150)
+    refs, _stages = ds._plan.resolve()
+    assert len(refs) == 2, f"expected 2 of 8 files read, got {len(refs)}"
+    assert ds.count() == 150
+    # Plan inspection shows the decision without executing.
+    assert "pushed limit 150" in rdata.read_parquet(path).limit(150).explain()
+    # A row-preserving map between read and limit keeps the rule valid...
+    ds2 = rdata.read_parquet(path).map(lambda r: r).limit(150)
+    refs2, _ = ds2._plan.resolve()
+    assert len(refs2) == 2
+    # ...but a filter blocks it (it changes row counts).
+    ds3 = rdata.read_parquet(path).filter(lambda r: True).limit(150)
+    refs3, _ = ds3._plan.resolve()
+    assert len(refs3) == 8
+
+
+def test_projection_pushdown_into_parquet(cluster, tmp_path):
+    """select_columns directly after read_parquet reads only those
+    columns from disk."""
+    import ray_tpu
+    from ray_tpu import data as rdata
+    path = _write_parts(tmp_path, n_files=3, rows=50)
+    ds = rdata.read_parquet(path).select_columns(["a"])
+    refs, stages = ds._plan.resolve()
+    assert not stages            # the projection moved into the reader
+    block = ray_tpu.get(refs[0])
+    assert block.column_names == ["a"]
+    assert "pushed projection ['a']" in \
+        rdata.read_parquet(path).select_columns(["a"]).explain()
+    assert ds.count() == 150
+
+
+def test_read_parallelism_hint_groups_files(cluster, tmp_path):
+    from ray_tpu import data as rdata
+    path = _write_parts(tmp_path, n_files=9, rows=10)
+    ds = rdata.read_parquet(path, parallelism=3)
+    refs, _ = ds._plan.resolve()
+    assert len(refs) == 3
+    assert ds.count() == 90
