@@ -66,14 +66,13 @@ def _chunk(items: List[Any], k: int) -> List[List[Any]]:
     return [items[i:i + size] for i in range(0, len(items), size)]
 
 
-def resolve(plan) -> Tuple[List[Any], List[Any]]:
-    """Apply the read-side rules and launch the source; returns
-    (input_refs, remaining_stages).  Called once per execution by the
-    executor's entry points."""
-    src = getattr(plan, "source", None)
-    if src is None:
-        return list(plan.input_refs), list(plan.stages)
-    stages = list(plan.stages)
+def _analyze(src, stages: List[Any]) -> Tuple[Optional[List[str]],
+                                              Optional[int], List[Any]]:
+    """The shared rule analysis behind resolve() and explain():
+    returns (columns, limit_rows, remaining_stages) WITHOUT launching
+    anything — one implementation so the executed plan and the explained
+    plan cannot drift."""
+    stages = list(stages)
     columns = src.columns
 
     # Projection pushdown: a select_columns DIRECTLY after the read
@@ -93,6 +92,17 @@ def resolve(plan) -> Tuple[List[Any], List[Any]]:
             break        # the limit stage stays: it trims the tail block
         if not getattr(s, "row_preserving", False):
             break
+    return columns, limit_rows, stages
+
+
+def resolve(plan) -> Tuple[List[Any], List[Any]]:
+    """Apply the read-side rules and launch the source; returns
+    (input_refs, remaining_stages).  Called once per execution by the
+    executor's entry points."""
+    src = getattr(plan, "source", None)
+    if src is None:
+        return list(plan.input_refs), list(plan.stages)
+    columns, limit_rows, stages = _analyze(src, plan.stages)
     paths = list(src.paths)
     if limit_rows is not None and src.count_rows is not None:
         picked: List[str] = []
@@ -123,30 +133,20 @@ def explain(plan) -> str:
     plan-inspection surface; reference: Dataset.explain())."""
     src = getattr(plan, "source", None)
     lines = []
+    stages_shown = list(plan.stages)
     if src is None:
         lines.append(f"EagerInput[{len(plan.input_refs)} blocks]")
     else:
-        # Re-run the rule analysis without launching anything.
-        stages = list(plan.stages)
-        columns = src.columns
-        if stages and getattr(stages[0], "projection", None) is not None \
-                and columns is None:
-            columns = stages[0].projection
-        limit_rows = None
-        for s in stages:
-            lr = getattr(s, "limit_rows", None)
-            if lr is not None:
-                limit_rows = lr
-                break
-            if not getattr(s, "row_preserving", False):
-                break
+        # stages_shown = what will actually run after pushdown — a
+        # pushed-down select_columns must not ALSO appear as a stage.
+        columns, limit_rows, stages_shown = _analyze(src, plan.stages)
         d = src.describe()
         if columns is not None and src.columns is None:
             d += f" <- pushed projection {columns}"
         if limit_rows is not None and src.count_rows is not None:
             d += f" <- pushed limit {limit_rows}"
         lines.append(d)
-    for s in plan.stages:
+    for s in stages_shown:
         tags = []
         if getattr(s, "row_preserving", False):
             tags.append("row-preserving")

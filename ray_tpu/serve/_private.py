@@ -83,7 +83,8 @@ class ReplicaActor:
         self._streams: dict = {}
         self._stream_ids = itertools.count(1)
 
-    async def handle_request(self, method_name, args, kwargs):
+    async def handle_request(self, method_name, args, kwargs,
+                             stream: bool = False):
         import asyncio
         import inspect
         self._ongoing += 1  # loop-thread only: no lock needed
@@ -95,6 +96,17 @@ class ReplicaActor:
                 raise TypeError("deployment object is not callable")
             kwargs = kwargs or {}
             if inspect.isasyncgenfunction(target) or inspect.isgeneratorfunction(target):
+                if not stream:
+                    # Non-streaming caller (handle.remote(), plain HTTP
+                    # dispatch): a stream ticket would leak its slot
+                    # (no one would pull chunks), and materializing an
+                    # unbounded generator would wedge the replica —
+                    # reference behavior: require the streaming API.
+                    raise TypeError(
+                        f"method {method_name or '__call__'!r} is a "
+                        f"generator; call it via handle.stream() / "
+                        f"stream_async() (or the ASGI route), not "
+                        f".remote()")
                 # Streaming method: stash the generator and hand back a
                 # stream ticket; the in-flight slot stays charged until
                 # the consumer drains or cancels (next_chunk below).
@@ -454,6 +466,23 @@ _router_states: Dict[str, _RouterState] = {}
 _router_states_lock = threading.Lock()
 
 
+def _reap_orphan_stream(replica, req_ref) -> None:
+    """The caller abandoned a handle_request whose ticket it never saw.
+    If that call registered a stream replica-side, its generator and
+    in-flight slot would be held forever (no one knows the sid) — wait
+    out the call on a daemon thread and cancel any stream it opened."""
+    def _reap():
+        try:
+            ticket = ray_tpu.get(req_ref, timeout=60)
+            if isinstance(ticket, dict) and "__serve_stream__" in ticket:
+                ray_tpu.get(replica.cancel_stream.remote(
+                    ticket["__serve_stream__"]), timeout=10)
+        except Exception:
+            pass  # replica died or call failed: nothing leaked
+    threading.Thread(target=_reap, daemon=True,
+                     name="serve-stream-reaper").start()
+
+
 def _get_router_state(name: str) -> _RouterState:
     with _router_states_lock:
         st = _router_states.get(name)
@@ -592,8 +621,16 @@ class DeploymentHandle:
             time.sleep(0.01)
         replica, key = pick
         try:
-            ticket = ray_tpu.get(replica.handle_request.remote(
-                self._method, args, kwargs), timeout=60)
+            req_ref = replica.handle_request.remote(self._method, args,
+                                                    kwargs, True)
+            try:
+                ticket = ray_tpu.get(req_ref, timeout=60)
+            except BaseException:
+                # The replica may still complete the call and register a
+                # stream whose sid we never learned — reap it so the
+                # in-flight slot isn't held forever.
+                _reap_orphan_stream(replica, req_ref)
+                raise
             if not (isinstance(ticket, dict)
                     and "__serve_stream__" in ticket):
                 # Non-generator method: degrade to a one-item stream.
@@ -607,7 +644,9 @@ class DeploymentHandle:
                     if out.get("done"):
                         return
                     yield out["chunk"]
-            except GeneratorExit:
+            except BaseException:
+                # Any abandonment (consumer close, get timeout, worker
+                # error) must release the replica's stream slot.
                 try:
                     ray_tpu.get(replica.cancel_stream.remote(sid),
                                 timeout=10)
@@ -638,9 +677,15 @@ class DeploymentHandle:
             # Per-step timeout: a wedged generator must not hold this
             # coroutine (and the in-flight slot) forever — mirror the
             # sync stream()'s bounded gets.
-            ticket = await asyncio.wait_for(asyncio.wrap_future(
-                replica.handle_request.remote(method, args,
-                                              kwargs).future()), timeout)
+            req_ref = replica.handle_request.remote(method, args, kwargs,
+                                                    True)
+            try:
+                ticket = await asyncio.wait_for(
+                    asyncio.wrap_future(req_ref.future()), timeout)
+            except BaseException:
+                # Unknown-sid orphan (see stream()): reap off-loop.
+                _reap_orphan_stream(replica, req_ref)
+                raise
             if not (isinstance(ticket, dict)
                     and "__serve_stream__" in ticket):
                 yield ticket
@@ -653,7 +698,8 @@ class DeploymentHandle:
                     if out.get("done"):
                         return
                     yield out["chunk"]
-            except (GeneratorExit, asyncio.TimeoutError):
+            except BaseException:
+                # Same slot-release contract as the sync stream().
                 try:
                     await asyncio.wait_for(asyncio.wrap_future(
                         replica.cancel_stream.remote(sid).future()), 10)

@@ -89,7 +89,13 @@ async def _run_asgi(app, request: Dict[str, Any]):
     async def receive():
         nonlocal consumed
         if consumed:
-            return {"type": "http.disconnect"}
+            # Block until the cycle ends: frameworks (Starlette's
+            # listen_for_disconnect) await receive() concurrently to
+            # detect client disconnects — fabricating one here would
+            # make every StreamingResponse cancel itself immediately.
+            # The app task is cancelled at stream end, which is the
+            # only "disconnect" this replica-side shim can observe.
+            await asyncio.Event().wait()
         consumed = True
         return {"type": "http.request", "body": body, "more_body": False}
 
@@ -119,7 +125,11 @@ async def _run_asgi(app, request: Dict[str, Any]):
                     yield {"status": 500,
                            "headers": [("content-type", "text/plain")]}
                     yield ev["error"].encode()
-                return
+                    return
+                # Mid-stream failure: abort the stream so the client sees
+                # a broken response, not a clean (truncated) end-of-body.
+                raise RuntimeError(
+                    f"ASGI app failed mid-stream: {ev['error']}")
             if kind == "http.response.start":
                 started = True
                 yield {"status": ev.get("status", 200),

@@ -8,10 +8,13 @@ pluggable Searcher interface, Train integration (a Trainer is a trainable).
 from ray_tpu.tune.controller import Trial, TuneController  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
+    DistributeResources,
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (  # noqa: F401

@@ -44,6 +44,10 @@ class Trial:
     exploit_from: Any = None
     explored_config: Optional[dict] = None
     restarts: int = 0
+    # Per-trial resource override + pending reallocation
+    # (ResourceChangingScheduler):
+    resources: Optional[dict] = None
+    new_resources: Optional[dict] = None
 
 
 class TuneController:
@@ -64,6 +68,9 @@ class TuneController:
         self._capacity_cap_at = 0.0
         self._run_config = run_config or RunConfig()
         self._max_failures = max_failures_per_trial
+        if hasattr(self._scheduler, "base_resources"):
+            self._scheduler.base_resources = dict(self._resources)
+            self._scheduler.controller = self
         self.trials: List[Trial] = []
         self._next_index = 0
         self._experiment_path = experiment_path
@@ -84,7 +91,7 @@ class TuneController:
         return trial
 
     def _start_trial(self, trial: Trial):
-        res = dict(self._resources)
+        res = dict(trial.resources or self._resources)
         cpu = res.pop("CPU", 1)
         tpu = res.pop("TPU", None)
         trial.actor = RayTrainWorker.options(
@@ -134,18 +141,51 @@ class TuneController:
             total = None
         cap = None
         if total is not None:
+            # Resources already pledged to RUNNING trials (per-trial
+            # overrides included — a ResourceChangingScheduler may have
+            # grown them past the base request).  Ignoring the overrides
+            # would overcount free capacity and block _start_trial on an
+            # unplaceable actor — the livelock this cap exists to prevent.
+            held: Dict[str, float] = {}
+            n_running = 0
+            for t in self.trials:
+                if t.state != "RUNNING":
+                    continue
+                n_running += 1
+                for k, v in (t.resources or self._resources).items():
+                    held[k] = held.get(k, 0) + (v or 0)
             for k, need in self._resources.items():
                 if not need:
                     continue
                 # A demanded resource ABSENT from the cluster caps at 1:
                 # one launch surfaces the pend/failure instead of a
                 # thundering start that livelocks on init.
-                fit = int(total.get(k, 0) / need)
+                free = total.get(k, 0) - held.get(k, 0)
+                fit = n_running + max(0, int(free / need))
                 cap = fit if cap is None else min(cap, fit)
         self._capacity_cap = max(1, cap) if cap is not None \
             else self._max_concurrent
         self._capacity_cap_at = now
         return self._capacity_cap
+
+    def _fits(self, trial: Trial) -> bool:
+        """Does THIS trial's demand (its per-trial override, not the base
+        request) fit in what the cluster has left after the RUNNING
+        trials' holdings?  Restored experiments can hold grown
+        allocations on PENDING trials — launching one the cluster can't
+        place blocks _start_trial's init get and starves everyone."""
+        try:
+            total = ray_tpu.cluster_resources()
+        except Exception:
+            return True
+        held: Dict[str, float] = {}
+        for t in self._running():
+            for k, v in (t.resources or self._resources).items():
+                held[k] = held.get(k, 0) + (v or 0)
+        for k, need in (trial.resources or self._resources).items():
+            if need and total.get(k, 0) - held.get(k, 0) < need:
+                return False
+        return True
 
     def step(self) -> bool:
         """One controller iteration; False when everything is done."""
@@ -158,6 +198,11 @@ class TuneController:
             if pending is None:
                 pending = self._make_trial()
             if pending is None:
+                break
+            # A demanded resource the cluster can NEVER satisfy still
+            # launches when nothing is running (one launch surfaces the
+            # pend/failure); otherwise wait for capacity to free up.
+            if not self._fits(pending) and self._running():
                 break
             try:
                 self._start_trial(pending)
@@ -195,6 +240,8 @@ class TuneController:
             if decision == sched_mod.STOP:
                 if t.explored_config is not None:
                     self._exploit_explore(t)
+                elif t.new_resources is not None:
+                    self._change_resources(t)
                 else:
                     self._stop_trial(t, "TERMINATED")
             else:
@@ -223,6 +270,21 @@ class TuneController:
         trial.checkpoint = donor.checkpoint
         trial.exploit_from = None
         trial.explored_config = None
+        self._teardown_actor(trial)
+        try:
+            self._start_trial(trial)
+        except Exception as e:
+            self._stop_trial(trial, "ERROR", e)
+
+    def _change_resources(self, trial: Trial):
+        """ResourceChangingScheduler restart: same config, latest
+        checkpoint, new resource allocation."""
+        logger.info("resources: %s -> %s for %s",
+                    trial.resources or self._resources, trial.new_resources,
+                    trial.trial_id)
+        trial.resources = trial.new_resources
+        trial.new_resources = None
+        self._capacity_cap_at = 0.0  # held-resources changed: recompute
         self._teardown_actor(trial)
         try:
             self._start_trial(trial)
@@ -263,6 +325,7 @@ class TuneController:
                 "checkpoint": t.checkpoint,
                 "iteration": t.iteration,
                 "restarts": t.restarts,
+                "resources": t.resources,
                 "error": repr(t.error) if t.error is not None else None,
             } for t in self.trials],
         }
@@ -282,6 +345,8 @@ class TuneController:
         self._next_index = state["next_index"]
         self._searcher = state["searcher"]
         self._scheduler = state["scheduler"]
+        if hasattr(self._scheduler, "base_resources"):
+            self._scheduler.controller = self
         self.trials = []
         for ts in state["trials"]:
             trial = Trial(trial_id=ts["trial_id"], config=ts["config"])
@@ -290,6 +355,7 @@ class TuneController:
             trial.checkpoint = ts["checkpoint"]
             trial.iteration = ts["iteration"]
             trial.restarts = ts["restarts"]
+            trial.resources = ts.get("resources")
             # In-flight trials resume from their latest checkpoint;
             # errored ones too when resume_errored (reference:
             # Tuner.restore resume_errored/restart_errored flags).

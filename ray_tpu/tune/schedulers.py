@@ -190,6 +190,196 @@ class PopulationBasedTraining(TrialScheduler):
         return config
 
 
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: tune/schedulers/pb2.py,
+    Parker-Holder et al. 2020).
+
+    PBT's random explore step is replaced by a GP-UCB suggestion: a
+    Gaussian process models the per-window reward CHANGE as a function of
+    (normalized time, hyperparameters); the exploited trial's new config
+    maximizes UCB = mu + kappa*sigma over candidates sampled inside
+    `hyperparam_bounds`.  Unlike the reference we fit a small numpy GP
+    (RBF kernel over [t, hparams]) rather than depending on GPy — the
+    time dimension gives the paper's time-varying behavior (stale windows
+    decorrelate from current candidates as t grows).
+    """
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, list]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None,
+                 time_attr: str = "training_iteration",
+                 ucb_kappa: float = 2.0, n_candidates: int = 256):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed,
+                         time_attr=time_attr)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds={key: [lo, hi]}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        self._data: List[tuple] = []      # (t, config_vec, reward_delta)
+        self._window_start: Dict[str, float] = {}  # trial_id -> metric value
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        value = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if value is not None and t and t % self.interval == 0:
+            prev = self._window_start.get(trial.trial_id)
+            if prev is not None:
+                delta = value - prev
+                if self.mode != "max":
+                    delta = -delta
+                vec = [self._norm(k, trial.config.get(k)) for k in self.bounds]
+                if None not in vec:
+                    self._data.append((float(t), vec, delta))
+            self._window_start[trial.trial_id] = value
+        decision = super().on_trial_result(trial, result)
+        if trial.explored_config is not None:
+            # Exploit/explore restart: the next window starts from the
+            # DONOR's score, so the pre-clone window must not attribute
+            # the checkpoint jump to the newly explored config.
+            self._window_start.pop(trial.trial_id, None)
+        return decision
+
+    def _norm(self, key, v):
+        if v is None:
+            return None
+        lo, hi = self.bounds[key]
+        return (float(v) - lo) / (hi - lo) if hi > lo else 0.0
+
+    def _explore(self, config: dict) -> dict:
+        import numpy as np
+        keys = list(self.bounds)
+        cand = np.array([[self._rng.random() for _ in keys]
+                         for _ in range(self.n_candidates)])
+        if len(self._data) >= 4:
+            tmax = max(d[0] for d in self._data) or 1.0
+            X = np.array([[d[0] / tmax] + d[1] for d in self._data])
+            y = np.array([d[2] for d in self._data], dtype=float)
+            # Candidates are evaluated at "now" (t = tmax -> normalized 1).
+            C = np.hstack([np.ones((len(cand), 1)), cand])
+            best = cand[int(np.argmax(self._gp_ucb(X, y, C)))]
+        else:  # cold start: uniform random inside the bounds
+            best = cand[0]
+        out = dict(config)
+        for i, k in enumerate(keys):
+            lo, hi = self.bounds[k]
+            out[k] = lo + float(best[i]) * (hi - lo)
+        return out
+
+    def _gp_ucb(self, X, y, C):
+        """UCB scores for candidate rows C under an RBF-kernel GP fit to
+        (X, y).  Normalized y; fixed length scale 0.3 on unit-box inputs;
+        jitter for conditioning."""
+        import numpy as np
+        ystd = y.std()
+        yn = (y - y.mean()) / (ystd if ystd > 0 else 1.0)
+        ls2 = 2 * 0.3 * 0.3
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-d2 / ls2) + 1e-4 * np.eye(len(X))
+        d2c = ((C[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Kc = np.exp(-d2c / ls2)
+        Kinv_y = np.linalg.solve(K, yn)
+        mu = Kc @ Kinv_y
+        var = 1.0 - (Kc * np.linalg.solve(K, Kc.T).T).sum(1)
+        return mu + self.kappa * np.sqrt(np.maximum(var, 1e-9))
+
+
+class DistributeResources:
+    """Default allocation policy for ResourceChangingScheduler (reference:
+    resource_changing_scheduler.py DistributeResources): split the
+    cluster's total CPUs evenly among live trials, never below the trial's
+    base request.  Returns None when the allocation is unchanged."""
+
+    def __init__(self, resource: str = "CPU"):
+        self.resource = resource
+
+    def __call__(self, trial, result, base_resources: dict,
+                 total_resources: dict, n_live: int) -> Optional[dict]:
+        total = total_resources.get(self.resource, 0)
+        base = base_resources.get(self.resource, 1)
+        share = max(base, int(total // max(1, n_live)))
+        current = dict(trial.resources or base_resources)
+        if current.get(self.resource, base) == share:
+            return None
+        current[self.resource] = share
+        return current
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Wrap a base scheduler and periodically reallocate trial resources
+    (reference: tune/schedulers/resource_changing_scheduler.py).
+
+    Every `resource_interval` iterations the allocation function proposes
+    new resources for the trial; when they differ from the current ones
+    the scheduler records them on `trial.new_resources` and returns STOP —
+    the controller restarts the trial from its latest checkpoint under the
+    new allocation (the reference updates the placement group the same
+    restart-driven way for function trainables).
+    """
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None,
+                 resource_interval: int = 4,
+                 time_attr: str = "training_iteration"):
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc = resources_allocation_function or DistributeResources()
+        self.interval = resource_interval
+        self.time_attr = time_attr
+        self._live: Dict[str, Any] = {}
+        self.base_resources: dict = {"CPU": 1}  # controller injects
+        self.controller = None                  # controller injects
+
+    def set_search_properties(self, metric, mode):
+        super().set_search_properties(metric, mode)
+        self.base.set_search_properties(metric, mode)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        self._live[trial.trial_id] = trial
+        decision = self.base.on_trial_result(trial, result)
+        if decision == STOP:
+            return STOP
+        t = result.get(self.time_attr, 0)
+        if t and t % self.interval == 0:
+            try:
+                import ray_tpu
+                total = ray_tpu.cluster_resources()
+            except Exception:
+                total = {}
+            # Live = the controller's RUNNING/PENDING trials, not trials
+            # seen so far: dividing by an early partial count hands the
+            # first reporter the whole cluster and livelocks the rest.
+            if self.controller is not None:
+                n_live = sum(t.state in ("RUNNING", "PENDING")
+                             for t in self.controller.trials)
+            else:
+                n_live = len(self._live)
+            new = self.alloc(trial, result, self.base_resources, total,
+                             n_live)
+            if new is not None:
+                trial.new_resources = new
+                return STOP  # controller restarts under the new resources
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result=None):
+        self._live.pop(trial.trial_id, None)
+        self.base.on_trial_complete(trial, result)
+
+    def __getstate__(self):
+        # The controller back-ref (actor handles, live trials) must not
+        # ride experiment-state snapshots; it is re-injected on restore.
+        state = dict(self.__dict__)
+        state["controller"] = None
+        state["_live"] = {}
+        return state
+
+
 class HyperBandScheduler(TrialScheduler):
     """HyperBand as a family of successive-halving brackets.
 

@@ -487,3 +487,75 @@ def test_handle_streaming_is_incremental(cluster):
     gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
     assert all(g > 0.05 for g in gaps), gaps
     serve.delete("streamer")
+
+
+def test_generator_method_non_stream_call_raises_cleanly(cluster):
+    """A generator method called through the NON-streaming path
+    (handle.remote(), plain HTTP dispatch) raises a clear TypeError
+    directing the caller to the streaming API — and must not leak the
+    replica's in-flight stream slot (reference: streaming methods
+    require the streaming handle API)."""
+
+    @serve.deployment(name="genmat", max_concurrent_queries=2)
+    class GenMat:
+        def chunks(self, n):
+            for i in range(n):
+                yield i
+
+        def plain(self):
+            return "ok"
+
+    serve.run(GenMat.bind())
+    h = serve.get_deployment_handle("genmat")
+    # Repeat PAST max_concurrent_queries: a leaked slot per call would
+    # saturate the replica and time out the later calls.
+    for _ in range(5):
+        with pytest.raises(Exception, match="stream"):
+            h.options("chunks").remote(3).result(timeout=30)
+    # The replica still serves normal calls (no slots were leaked) and
+    # the streaming API still works.
+    assert h.options("plain").remote().result(timeout=30) == "ok"
+    assert list(h.options("chunks").stream(3)) == [0, 1, 2]
+    serve.delete("genmat")
+
+
+def test_asgi_receive_does_not_fabricate_disconnect(cluster):
+    """Frameworks (Starlette listen_for_disconnect) await receive()
+    concurrently while streaming; a fabricated http.disconnect would
+    cancel the stream immediately.  The shim must block instead."""
+    import asyncio
+    import urllib.request
+
+    async def app(scope, receive, send):
+        await receive()  # request body
+        cancelled = asyncio.Event()
+
+        async def watch_disconnect():
+            msg = await receive()   # must BLOCK, not return immediately
+            if msg["type"] == "http.disconnect":
+                cancelled.set()
+
+        watcher = asyncio.ensure_future(watch_disconnect())
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"text/plain")]})
+        for i in range(3):
+            await asyncio.sleep(0.05)
+            if cancelled.is_set():   # the bug: fires on fabricated msg
+                break
+            await send({"type": "http.response.body",
+                        "body": f"c{i};".encode(), "more_body": True})
+        await send({"type": "http.response.body", "body": b"",
+                    "more_body": False})
+        watcher.cancel()
+
+    @serve.deployment(name="sseapp")
+    @serve.ingress(app)
+    class SSE:
+        pass
+
+    serve.run(SSE.bind())
+    port = serve.start(with_proxy=True)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/sseapp/x", timeout=30).read()
+    assert body == b"c0;c1;c2;", body
+    serve.delete("sseapp")

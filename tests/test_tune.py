@@ -302,3 +302,132 @@ def test_bohb_searcher_with_hyperband(cluster):
     # same budget (deterministic objective, fixed seeds).
     assert bohb <= rnd * 1.05, (bohb, rnd)
     assert bohb < 1.0, bohb
+
+
+def test_pb2_gp_explore_targets_good_region():
+    """PB2's GP-UCB explore must learn from observed (hparam, reward-delta)
+    data: with history showing lr near 0.9 yields high deltas and lr near
+    0.1 yields low ones, the suggested config lands in the good half."""
+    from ray_tpu.tune.schedulers import PB2
+
+    class _T:
+        def __init__(self, tid, lr):
+            self.trial_id = tid
+            self.config = {"lr": lr}
+            self.reached_rungs = set()
+            self.exploit_from = None
+            self.explored_config = None
+            self.checkpoint = None  # no donor ckpt: no exploits, pure GP data
+
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=2,
+              hyperparam_bounds={"lr": [0.0, 1.0]}, seed=11)
+    # Feed windows: reward delta == lr (monotone), several trials/windows.
+    trials = [_T(f"t{i}", 0.1 + 0.2 * i) for i in range(5)]
+    score = {t.trial_id: 0.0 for t in trials}
+    for step in (2, 4, 6):
+        for t in trials:
+            score[t.trial_id] += t.config["lr"]
+            pb2.on_trial_result(t, {"training_iteration": step,
+                                    "score": score[t.trial_id]})
+    assert len(pb2._data) >= 10  # windows recorded after the first boundary
+    suggestions = [pb2._explore({"lr": 0.1})["lr"] for _ in range(5)]
+    assert all(0.0 <= s <= 1.0 for s in suggestions)
+    # GP-UCB should concentrate suggestions in the high-delta region.
+    assert sum(s > 0.5 for s in suggestions) >= 4, suggestions
+
+
+def test_pb2_end_to_end_migrates_bad_trials(cluster):
+    def objective(config):
+        from ray_tpu.tune import get_checkpoint
+        start = 0
+        ckpt = get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, 12):
+            tune.report({"score": config["lr"] * (step + 1)},
+                        checkpoint=Checkpoint.from_dict({"step": step}))
+
+    pb2 = tune.PB2(metric="score", mode="max", perturbation_interval=3,
+                   hyperparam_bounds={"lr": [0.1, 2.0]}, seed=3,
+                   quantile_fraction=0.34)
+    results = tune.run(
+        objective, config={"lr": tune.grid_search([0.1, 1.0, 2.0])},
+        scheduler=pb2, metric="score", mode="max",
+        resources_per_trial={"CPU": 1})
+    assert len(results) == 3
+    assert not results.errors
+    final_lrs = [r.metrics["config"]["lr"] for r in results if r.metrics]
+    assert any(lr != 0.1 for lr in final_lrs)  # worst trial was moved
+
+
+def test_resource_changing_scheduler_grows_allocation(cluster):
+    """With 8 cluster CPUs and 2 trials at base CPU:1, DistributeResources
+    should grow each live trial to CPU:4 at the interval boundary and the
+    controller must restart it from checkpoint under the new allocation."""
+    from ray_tpu.tune.controller import TuneController
+    from ray_tpu.tune.schedulers import ResourceChangingScheduler
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    def objective(config):
+        from ray_tpu.tune import get_checkpoint
+        start = 0
+        ckpt = get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, 6):
+            tune.report({"score": float(step)},
+                        checkpoint=Checkpoint.from_dict({"step": step}))
+
+    sched = ResourceChangingScheduler(resource_interval=2)
+    sched.set_search_properties("score", "max")
+    searcher = BasicVariantGenerator({"x": tune.uniform(0, 1)},
+                                     num_samples=2, seed=0)
+    ctl = TuneController(objective, searcher=searcher, scheduler=sched,
+                         max_concurrent=2, resources_per_trial={"CPU": 1})
+    ctl.run(deadline_s=120)
+    assert all(t.state == "TERMINATED" for t in ctl.trials)
+    # Both trials ran to completion (checkpoint resume across the restart)
+    assert all(t.last_result["score"] == 5.0 for t in ctl.trials)
+    # Each trial grew past its base CPU:1 (to 4 while both live; a trial
+    # reallocating after its peer terminates may claim the freed capacity).
+    grown = [t for t in ctl.trials if (t.resources or {}).get("CPU", 1) >= 4]
+    assert len(grown) == 2, [t.resources for t in ctl.trials]
+
+
+def test_pb2_window_resets_on_exploit():
+    """The score jump from adopting a donor checkpoint must not be
+    recorded as a reward delta for the explored config."""
+    from ray_tpu.tune.schedulers import PB2
+
+    class _T:
+        def __init__(self, tid, lr):
+            self.trial_id = tid
+            self.config = {"lr": lr}
+            self.reached_rungs = set()
+            self.exploit_from = None
+            self.explored_config = None
+            self.checkpoint = object()
+
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=2,
+              hyperparam_bounds={"lr": [0.0, 1.0]}, seed=1,
+              quantile_fraction=0.5)
+    good, bad = _T("good", 0.9), _T("bad", 0.1)
+    pb2.on_trial_result(good, {"training_iteration": 2, "score": 10.0})
+    d = pb2.on_trial_result(bad, {"training_iteration": 2, "score": 1.0})
+    # Exploit decided at the first boundary (both trials known).
+    assert d == "STOP" and bad.explored_config is not None
+    assert "bad" not in pb2._window_start  # window dropped on exploit
+    # Post-restart: controller clears the decision and the trial resumes
+    # from the DONOR's checkpoint at donor-level scores.
+    bad.config = bad.explored_config
+    bad.explored_config = None
+    n_obs = len(pb2._data)
+    pb2.on_trial_result(bad, {"training_iteration": 4, "score": 11.0})
+    # The 1.0 -> 11.0 checkpoint jump was NOT recorded as a delta; the
+    # boundary only restarts the window.
+    assert len(pb2._data) == n_obs
+    assert pb2._window_start["bad"] == 11.0
+    # The window AFTER the restart does record (a genuine config effect).
+    pb2.on_trial_result(bad, {"training_iteration": 6, "score": 12.5})
+    assert len(pb2._data) == n_obs + 1
+    assert abs(pb2._data[-1][2] - 1.5) < 1e-9
