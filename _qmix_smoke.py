@@ -1,0 +1,17 @@
+import time
+from ray_tpu.rllib import QMixConfig, VDNConfig
+
+def run(cfg_cls, iters=40):
+    cfg = cfg_cls()
+    cfg.seed = 0
+    algo = cfg.build()
+    t0 = time.time()
+    for i in range(iters):
+        algo.train()
+    g = algo.evaluate_greedy()
+    print(cfg.mixer, "greedy team return:", g, f"({time.time()-t0:.0f}s)")
+    return g
+
+q = run(QMixConfig)
+v = run(VDNConfig)
+print("RESULT qmix", q, "vdn", v)

@@ -19,10 +19,20 @@ from ray_tpu.rllib.env import (  # noqa: F401
     register_env,
 )
 from ray_tpu.rllib.a2c import A2C, A2CConfig  # noqa: F401
+from ray_tpu.rllib.ars import ARS, ARSConfig  # noqa: F401
+from ray_tpu.rllib.bandit import (  # noqa: F401
+    LinTS,
+    LinTSConfig,
+    LinUCB,
+    LinUCBConfig,
+)
+from ray_tpu.rllib.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, LearnerThread  # noqa: F401
 from ray_tpu.rllib.learner import JaxLearner, ppo_loss  # noqa: F401
+from ray_tpu.rllib.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rllib.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.offline import BC, BCConfig, JsonReader, JsonWriter  # noqa: F401
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
 from ray_tpu.rllib.replay_buffer import (  # noqa: F401
@@ -30,6 +40,7 @@ from ray_tpu.rllib.replay_buffer import (  # noqa: F401
     ReplayBuffer,
 )
 from ray_tpu.rllib.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.qmix import QMix, QMixConfig, VDNConfig  # noqa: F401
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.td3 import TD3, TD3Config  # noqa: F401

@@ -1,0 +1,259 @@
+"""Evolution Strategies (OpenAI-ES, Salimans et al. 2017).
+
+Reference parity: rllib/algorithms/es/ (es.py driver + worker fleet,
+shared-noise-table perturbations, centered-rank utilities, antithetic
+pairs).  The design here is TPU-first rather than a translation:
+
+* **Noise by seed, not by table**: workers regenerate each perturbation
+  from its integer seed (`default_rng(seed)`), so only scalars cross
+  the wire — the reference's 250MB shared noise table becomes ~8 bytes
+  per direction.
+* **Batched evaluation as one vmapped program**: a worker evaluates ALL
+  its perturbations simultaneously — the policy forward is
+  `vmap`-ed over a [2K, dim] parameter matrix against a 2K-env vector
+  env, so the whole population rollout is a single jitted computation
+  per step (MXU-batched on TPU; the reference steps one gym env per
+  perturbation in Python).
+* Episodes are masked, not restarted: each lane accumulates reward
+  until its FIRST done; lanes then go inactive (the auto-reset obs
+  keeps shapes static for XLA).
+
+The evaluation worker is shared with ARS (ars.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_vector_env
+
+
+# ---------------------------------------------------------------------------
+# Flat-vector MLP policy (pure functions over a single flat param vector —
+# the ES/ARS search space).
+# ---------------------------------------------------------------------------
+
+def _mlp_shapes(obs_dim: int, hidden: Tuple[int, ...], out_dim: int):
+    dims = (obs_dim,) + tuple(hidden) + (out_dim,)
+    return [(dims[i], dims[i + 1]) for i in range(len(dims) - 1)]
+
+
+def _init_flat(obs_dim: int, hidden: Tuple[int, ...], out_dim: int,
+               seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    parts = []
+    for n_in, n_out in _mlp_shapes(obs_dim, hidden, out_dim):
+        parts.append((rng.standard_normal((n_in, n_out))
+                      / np.sqrt(n_in)).astype(np.float32).ravel())
+        parts.append(np.zeros(n_out, np.float32))
+    return np.concatenate(parts)
+
+
+def _make_apply(obs_dim: int, hidden: Tuple[int, ...], out_dim: int):
+    """Returns jitted batched_apply(P, obs) -> outputs, where P is a
+    [B, dim] parameter matrix and obs is [B, obs_dim]: lane i runs the
+    policy with ITS OWN parameters P[i] (vmap over params AND obs)."""
+    import jax
+    import jax.numpy as jnp
+
+    shapes = _mlp_shapes(obs_dim, hidden, out_dim)
+
+    def apply_one(flat, x):
+        off = 0
+        for i, (n_in, n_out) in enumerate(shapes):
+            w = flat[off:off + n_in * n_out].reshape(n_in, n_out)
+            off += n_in * n_out
+            b = flat[off:off + n_out]
+            off += n_out
+            x = x @ w + b
+            if i < len(shapes) - 1:
+                x = jnp.tanh(x)
+        return x
+
+    return jax.jit(jax.vmap(apply_one))
+
+
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class EvalWorker:
+    """Evaluates perturbed parameter vectors for full (masked) episodes.
+
+    One call = one jitted rollout of the whole assigned population slice
+    (antithetic pairs: lanes 2i / 2i+1 run theta +/- sigma*eps_i)."""
+
+    def __init__(self, env: Any, hidden: Tuple[int, ...], seed: int,
+                 horizon: int = 500):
+        self._env_spec = env
+        self._hidden = tuple(hidden)
+        self._seed = seed
+        self._horizon = horizon
+        self._envs: Dict[int, Any] = {}   # lane count -> VectorEnv
+        self._apply = None
+        probe = make_vector_env(env, 1, seed=seed)
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self.action_dim = getattr(probe, "action_dim", 0)
+
+    def _get_env(self, lanes: int):
+        env = self._envs.get(lanes)
+        if env is None:
+            env = make_vector_env(self._env_spec, lanes, seed=self._seed)
+            self._envs[lanes] = env
+        return env
+
+    def evaluate(self, theta: np.ndarray, seeds: List[int], sigma: float,
+                 obs_stats: Optional[Tuple[np.ndarray, np.ndarray]] = None
+                 ) -> Dict[str, Any]:
+        """Antithetic evaluation: returns per-seed (r_plus, r_minus),
+        episode lengths, and observation moments (for ARS-V2 filters).
+        `obs_stats=(mean, std)` normalizes observations when given."""
+        theta = np.asarray(theta, np.float32)
+        dim = theta.size
+        k = len(seeds)
+        eps = np.stack([
+            np.random.default_rng(s).standard_normal(dim).astype(np.float32)
+            for s in seeds])                                   # [K, dim]
+        pop = np.empty((2 * k, dim), np.float32)
+        pop[0::2] = theta[None, :] + sigma * eps
+        pop[1::2] = theta[None, :] - sigma * eps
+        if self._apply is None:
+            self._apply = _make_apply(self.obs_dim, self._hidden,
+                                      self.num_actions or self.action_dim)
+        env = self._get_env(2 * k)
+        obs = env.reset_all(seed=self._seed)
+        active = np.ones(2 * k, bool)
+        returns = np.zeros(2 * k, np.float64)
+        lengths = np.zeros(2 * k, np.int64)
+        o_sum = np.zeros(self.obs_dim, np.float64)
+        o_sq = np.zeros(self.obs_dim, np.float64)
+        o_n = 0
+        for _ in range(self._horizon):
+            o_sum += obs[active].sum(0)
+            o_sq += (obs[active] ** 2).sum(0)
+            o_n += int(active.sum())
+            x = obs
+            if obs_stats is not None:
+                x = (obs - obs_stats[0]) / obs_stats[1]
+            out = np.asarray(self._apply(pop, x.astype(np.float32)))
+            actions = (out.argmax(-1) if self.num_actions
+                       else np.tanh(out))
+            _obs, rew, term, trunc = env.step(actions)
+            returns += rew * active
+            lengths += active
+            active &= ~(term | trunc)
+            obs = _obs
+            if not active.any():
+                break
+        env.drain_episode_metrics()  # masked lanes: driver uses `returns`
+        return {"r_plus": returns[0::2], "r_minus": returns[1::2],
+                "lengths": lengths, "obs_sum": o_sum, "obs_sq": o_sq,
+                "obs_n": o_n}
+
+
+def centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Rank transform to [-0.5, 0.5] (reference: es/utils.py
+    compute_centered_ranks) — scale-free utilities make the update
+    invariant to reward magnitude."""
+    flat = x.ravel()
+    ranks = np.empty(flat.size, dtype=np.float64)
+    ranks[flat.argsort()] = np.arange(flat.size)
+    ranks = ranks / (flat.size - 1) - 0.5
+    return ranks.reshape(x.shape)
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=ES)
+        self.num_rollout_workers = 2
+        self.episodes_per_batch = 32     # perturbation DIRECTIONS per iter
+        self.noise_stdev = 0.05
+        self.lr = 0.02
+        self.l2_coeff = 0.005
+        self.episode_horizon = 500
+        self.model_hidden = (32, 32)
+
+
+class ES(Algorithm):
+    """Driver: sample direction seeds -> fan out to the worker fleet ->
+    centered-rank gradient estimate -> Adam step on the flat vector."""
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.theta = _init_flat(self.obs_dim, tuple(cfg.model_hidden),
+                                self.num_actions or self.action_dim,
+                                cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._adam_m = np.zeros_like(self.theta)
+        self._adam_v = np.zeros_like(self.theta)
+        self._adam_t = 0
+        self.workers = [
+            EvalWorker.options(num_cpus=cfg.num_cpus_per_worker).remote(
+                cfg.env, tuple(cfg.model_hidden), cfg.seed + 7919 * (i + 1),
+                cfg.episode_horizon)
+            for i in range(max(1, cfg.num_rollout_workers))]
+
+    def _fan_out(self, seeds: np.ndarray, obs_stats=None):
+        n = len(self.workers)
+        shards = np.array_split(seeds, n)
+        refs = [w.evaluate.remote(self.theta, [int(s) for s in shard],
+                                  self.config.noise_stdev, obs_stats)
+                for w, shard in zip(self.workers, shards) if len(shard)]
+        return ray_tpu.get(refs, timeout=600), [s for s in shards if len(s)]
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n_dir = cfg.episodes_per_batch
+        seeds = self._rng.integers(0, 2 ** 31 - 1, size=n_dir)
+        results, shards = self._fan_out(seeds)
+        r_plus = np.concatenate([r["r_plus"] for r in results])
+        r_minus = np.concatenate([r["r_minus"] for r in results])
+        used = np.concatenate(shards)
+        # Utilities from the CENTERED RANKS of all 2n returns.
+        ranks = centered_ranks(np.stack([r_plus, r_minus]))
+        weights = ranks[0] - ranks[1]                          # [n_dir]
+        eps = np.stack([
+            np.random.default_rng(int(s)).standard_normal(self.theta.size)
+            .astype(np.float32) for s in used])
+        grad = (weights[:, None] * eps).sum(0) / (
+            n_dir * cfg.noise_stdev)
+        grad = grad - cfg.l2_coeff * self.theta                # weight decay
+        # Adam ascent on the flat vector (reference: es/optimizers.py).
+        self._adam_t += 1
+        b1, b2, eps_ = 0.9, 0.999, 1e-8
+        self._adam_m = b1 * self._adam_m + (1 - b1) * grad
+        self._adam_v = b2 * self._adam_v + (1 - b2) * grad * grad
+        mh = self._adam_m / (1 - b1 ** self._adam_t)
+        vh = self._adam_v / (1 - b2 ** self._adam_t)
+        self.theta += cfg.lr * mh / (np.sqrt(vh) + eps_)
+
+        all_returns = np.concatenate([r_plus, r_minus])
+        lengths = np.concatenate([r["lengths"] for r in results])
+        self._episode_returns.extend(all_returns.tolist())
+        self._episode_lengths.extend(lengths.tolist())
+        self.total_env_steps += int(lengths.sum())
+        return {"episodes_this_iter": int(all_returns.size),
+                "update_norm": float(np.linalg.norm(grad)),
+                "theta_norm": float(np.linalg.norm(self.theta))}
+
+    def save_to_dict(self) -> Dict[str, Any]:
+        return {"theta": self.theta, "adam_m": self._adam_m,
+                "adam_v": self._adam_v, "adam_t": self._adam_t}
+
+    def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        self.theta = state["theta"]
+        self._adam_m = state["adam_m"]
+        self._adam_v = state["adam_v"]
+        self._adam_t = state["adam_t"]
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

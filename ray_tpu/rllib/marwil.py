@@ -1,0 +1,143 @@
+"""MARWIL: Monotonic Advantage Re-Weighted Imitation Learning.
+
+Reference parity: rllib/algorithms/marwil/ (marwil.py + the torch policy's
+loss) — exponentially advantage-weighted behavior cloning over logged
+trajectories: L = -E[ exp(beta * A_hat / c) * log pi(a|s) ] + vf loss,
+with A_hat = (monte-carlo return) - V(s) from a jointly-trained critic
+and c a running estimate of the advantage scale.  beta = 0 degrades to
+plain BC (the reference implements BC as MARWIL with beta=0).
+
+Offline-first like BC here: trains from logged SampleBatches (JsonReader
+/ DatasetReader); the jitted update runs actor and critic in one fused
+step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def compute_mc_returns(rewards: np.ndarray, dones: np.ndarray,
+                       gamma: float) -> np.ndarray:
+    """Per-row discounted Monte-Carlo return-to-go within each logged
+    episode (episode boundaries = done rows)."""
+    out = np.zeros(len(rewards), np.float32)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        if dones[i]:
+            acc = 0.0
+        acc = rewards[i] + gamma * acc
+        out[i] = acc
+    return out
+
+
+class MARWILConfig:
+    def __init__(self):
+        self.beta = 1.0            # 0 = BC
+        self.vf_coeff = 1.0
+        self.gamma = 0.99
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_epochs = 1
+        self.model_hidden = (64, 64)
+        self.max_weight = 20.0     # clip the exp advantage weight
+        self.seed = 0
+
+
+class MARWIL:
+    def __init__(self, obs_dim: int, num_actions: int,
+                 config: Optional[MARWILConfig] = None):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.models import make_model
+
+        self.config = config or MARWILConfig()
+        cfg = self.config
+        init_params, self.apply = make_model(obs_dim, num_actions,
+                                             cfg.model_hidden)
+        self.params = init_params(jax.random.key(cfg.seed))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(cfg.seed)
+        # c^2 running moment of squared advantages (reference:
+        # marwil_torch_policy.py ma_adv_norm update).
+        self.adv_norm_sq = 1.0
+        apply = self.apply
+        beta, vf_coeff, max_w = cfg.beta, cfg.vf_coeff, cfg.max_weight
+
+        def loss(params, obs, actions, returns, adv_norm):
+            import jax.numpy as jnp
+            logits, values = apply(params, obs)
+            adv = returns - values
+            # The weight uses the CURRENT advantage but must not push
+            # gradients through the critic into the actor term.
+            w = jnp.minimum(
+                jnp.exp(beta * jax.lax.stop_gradient(adv) / adv_norm),
+                max_w)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+            policy_loss = (w * nll).mean()
+            vf_loss = (adv ** 2).mean()
+            return policy_loss + vf_coeff * vf_loss, (
+                policy_loss, vf_loss, jax.lax.stop_gradient(adv))
+
+        def step(params, opt_state, obs, actions, returns, adv_norm):
+            (l, aux), grads = jax.value_and_grad(loss, has_aux=True)(
+                params, obs, actions, returns, adv_norm)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, l, aux
+
+        self._step = jax.jit(step)
+
+    def train_on(self, batch: SampleBatch) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        obs = np.asarray(batch[SampleBatch.OBS], np.float32)
+        actions = np.asarray(batch[SampleBatch.ACTIONS])
+        rewards = np.asarray(batch[SampleBatch.REWARDS], np.float32)
+        term = np.asarray(batch.get(SampleBatch.TERMINATEDS,
+                                    np.zeros(len(obs))), bool)
+        trunc = np.asarray(batch.get(SampleBatch.TRUNCATEDS,
+                                     np.zeros(len(obs))), bool)
+        if obs.ndim > 2:
+            obs = obs.reshape(-1, obs.shape[-1])
+            actions, rewards = actions.reshape(-1), rewards.reshape(-1)
+            term, trunc = term.reshape(-1), trunc.reshape(-1)
+        returns = compute_mc_returns(rewards, term | trunc, cfg.gamma)
+        n = len(obs)
+        last = {}
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n, cfg.train_batch_size):
+                idx = perm[lo:lo + cfg.train_batch_size]
+                c = float(np.sqrt(self.adv_norm_sq)) + 1e-8
+                self.params, self.opt_state, l, aux = self._step(
+                    self.params, self.opt_state, jnp.asarray(obs[idx]),
+                    jnp.asarray(actions[idx]), jnp.asarray(returns[idx]),
+                    c)
+                policy_loss, vf_loss, adv = aux
+                # EMA of E[A^2] (the reference's moving advantage norm).
+                self.adv_norm_sq += 1e-2 * (
+                    float(np.mean(np.asarray(adv) ** 2)) - self.adv_norm_sq)
+                last = {"total_loss": float(l),
+                        "policy_loss": float(policy_loss),
+                        "vf_loss": float(vf_loss)}
+        last["samples"] = n
+        return last
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+        logits, _ = self.apply(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.params)

@@ -1,0 +1,326 @@
+"""QMIX / VDN: value-decomposition multi-agent Q-learning.
+
+Reference parity: rllib/algorithms/qmix/ (qmix.py, qmix_policy.py
+mixers) — cooperative agents learn per-agent utilities Q_i(o_i, a_i)
+combined into a team value Q_tot by a MONOTONIC mixing network whose
+weights are produced by hypernetworks of the global state (Rashid et
+al. 2018); VDN (Sunehag et al. 2017) is the linear special case
+Q_tot = sum_i Q_i.  Monotonicity (non-negative mixing weights) makes
+the per-agent argmax consistent with the joint argmax, so execution
+stays decentralized while training is centralized.
+
+Everything is one jitted TD step over replay minibatches: agent nets
+(shared parameters, vmapped over agents) + hypernet mixer + target
+copies.  The global state defaults to the concatenation of agent
+observations when the env does not expose one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentVectorEnv,
+    make_multi_agent_env,
+    register_multi_agent_env,
+)
+
+
+class TwoStepGameEnv(MultiAgentVectorEnv):
+    """The QMIX paper's two-step cooperative matrix game (Rashid et al.
+    2018, section 5.1): agent a0's FIRST action picks the second-step
+    game — 2A pays 7 for every joint action; 2B pays [[0,1],[1,8]].
+    The optimum (pick 2B, then both play 1 -> 8) is invisible to purely
+    additive mixing: VDN settles on the safe 7, QMIX's state-conditioned
+    monotonic mixer recovers 8 — the canonical separation test."""
+
+    agent_ids = ("a0", "a1")
+    observation_dims = {"a0": 3, "a1": 3}   # one-hot state s0/s2A/s2B
+    num_actions_by_agent = {"a0": 2, "a1": 2}
+    PAYOFF_2B = np.array([[0.0, 1.0], [1.0, 8.0]])
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        super().__init__(num_envs)
+        self._state = np.zeros(num_envs, np.int64)   # 0=s0, 1=s2A, 2=s2B
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        onehot = np.eye(3, dtype=np.float32)[self._state]
+        return {a: onehot.copy() for a in self.agent_ids}
+
+    def reset_all(self, seed: Optional[int] = None):
+        self._state[:] = 0
+        for a in self.agent_ids:
+            self._ep_return[a][:] = 0.0
+        self._ep_len[:] = 0
+        return self._obs()
+
+    def step_batch(self, actions: Dict[str, np.ndarray]):
+        a0 = np.asarray(actions["a0"])
+        a1 = np.asarray(actions["a1"])
+        in_s0 = self._state == 0
+        team = np.zeros(self.num_envs, np.float32)
+        # Step 2 payoffs:
+        in_2a = self._state == 1
+        in_2b = self._state == 2
+        team[in_2a] = 7.0
+        team[in_2b] = self.PAYOFF_2B[a0[in_2b], a1[in_2b]]
+        terminated = ~in_s0
+        # Step-1 transition: a0's action selects the matrix game.
+        nxt = np.where(a0 == 0, 1, 2)
+        self._state = np.where(in_s0, nxt, 0)   # done envs auto-reset
+        rew = {a: team / 2.0 for a in self.agent_ids}  # team split
+        return self._obs(), rew, terminated, np.zeros(self.num_envs, bool)
+
+
+register_multi_agent_env("two-step-game", TwoStepGameEnv)
+
+
+class QMixConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=QMix)
+        self.env = "two-step-game"
+        # One shared net across (homogeneous) agents — declare the map so
+        # the Algorithm base probes the env as multi-agent.
+        self.policies = ["shared"]
+        self.policy_mapping_fn = lambda aid: "shared"
+        self.mixer = "qmix"              # "qmix" | "vdn"
+        self.mixing_embed_dim = 16
+        self.num_envs_per_worker = 16
+        self.lr = 5e-3
+        self.gamma = 0.99
+        self.buffer_size = 4096
+        self.train_batch_size = 128
+        self.epsilon_timesteps = 2000    # linear 1.0 -> 0.05
+        self.final_epsilon = 0.05
+        self.target_update_interval = 100
+        self.rollout_steps_per_iter = 64
+        self.train_steps_per_iter = 16
+        self.model_hidden = (64,)
+
+
+class QMix(Algorithm):
+    def setup(self) -> None:
+        import jax
+        cfg = self.config
+        self.env = make_multi_agent_env(cfg.env, cfg.num_envs_per_worker,
+                                        seed=cfg.seed)
+        self.agents: List[str] = list(self.env.agent_ids)
+        self.n_agents = len(self.agents)
+        # Homogeneous-agent assumption (shared net, vmapped): dims match.
+        dims = set(self.env.observation_dims.values())
+        acts = set(self.env.num_actions_by_agent.values())
+        if len(dims) != 1 or len(acts) != 1:
+            raise ValueError("QMIX here shares one agent net: all agents "
+                             "need identical obs/action spaces")
+        self.agent_obs_dim = dims.pop()
+        self.n_actions = acts.pop()
+        self.state_dim = self.agent_obs_dim * self.n_agents
+        self._rng = np.random.default_rng(cfg.seed)
+        self.params = self._init_params(jax.random.key(cfg.seed))
+        self.target_params = self.params
+        import optax
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._buf: List[Any] = []
+        self._buf_pos = 0
+        self._env_obs = self.env.reset_all(seed=cfg.seed)
+        self._steps_sampled = 0
+        self._train_steps = 0
+        self.workers = None
+        self._build_fns()
+
+    # -- parameters --------------------------------------------------------
+    def _init_params(self, key):
+        import jax
+        cfg = self.config
+        h = cfg.model_hidden[0]
+        e = cfg.mixing_embed_dim
+        ks = jax.random.split(key, 8)
+
+        def dense(k, n_in, n_out):
+            import jax.numpy as jnp
+            w = jax.random.normal(k, (n_in, n_out)) / jnp.sqrt(n_in)
+            return {"w": w.astype(jnp.float32),
+                    "b": jnp.zeros(n_out, jnp.float32)}
+
+        params = {
+            "agent1": dense(ks[0], self.agent_obs_dim, h),
+            "agent2": dense(ks[1], h, self.n_actions),
+        }
+        if cfg.mixer == "qmix":
+            params.update({
+                "hyper_w1": dense(ks[2], self.state_dim,
+                                  self.n_agents * e),
+                "hyper_b1": dense(ks[3], self.state_dim, e),
+                "hyper_w2": dense(ks[4], self.state_dim, e),
+                "hyper_b2_1": dense(ks[5], self.state_dim, e),
+                "hyper_b2_2": dense(ks[6], e, 1),
+            })
+        return params
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+        cfg = self.config
+        n_agents, e = self.n_agents, cfg.mixing_embed_dim
+        gamma = cfg.gamma
+        mixer = cfg.mixer
+
+        def lin(p, x):
+            return x @ p["w"] + p["b"]
+
+        def agent_q(params, obs):            # [.., obs_dim] -> [.., A]
+            return lin(params["agent2"],
+                       jnp.tanh(lin(params["agent1"], obs)))
+
+        def mix(params, qs, state):
+            """qs [B, n_agents] -> Q_tot [B]; monotone in every q_i."""
+            if mixer == "vdn":
+                return qs.sum(-1)
+            w1 = jnp.abs(lin(params["hyper_w1"], state)).reshape(
+                -1, n_agents, e)
+            b1 = lin(params["hyper_b1"], state)
+            hidden = jax.nn.elu(
+                jnp.einsum("bn,bne->be", qs, w1) + b1)
+            w2 = jnp.abs(lin(params["hyper_w2"], state))
+            b2 = lin(params["hyper_b2_2"], jax.nn.relu(
+                lin(params["hyper_b2_1"], state)))[:, 0]
+            return (hidden * w2).sum(-1) + b2
+
+        def td_loss(params, target_params, obs, actions, team_rew,
+                    next_obs, dones):
+            # obs [B, n_agents, obs_dim]; actions [B, n_agents]
+            B = obs.shape[0]
+            state = obs.reshape(B, -1)
+            next_state = next_obs.reshape(B, -1)
+            q_all = agent_q(params, obs)               # [B, n, A]
+            q_taken = jnp.take_along_axis(
+                q_all, actions[..., None].astype(jnp.int32), -1)[..., 0]
+            q_tot = mix(params, q_taken, state)
+            # Decentralized-consistent target: per-agent argmax under the
+            # TARGET net, mixed by the target mixer.
+            tq_all = agent_q(target_params, next_obs)
+            tq_max = tq_all.max(-1)
+            t_tot = mix(target_params, tq_max, next_state)
+            y = team_rew + gamma * (1.0 - dones) * t_tot
+            return ((q_tot - jax.lax.stop_gradient(y)) ** 2).mean()
+
+        def train_step(params, target_params, opt_state, obs, actions,
+                       team_rew, next_obs, dones):
+            import optax
+            l, grads = jax.value_and_grad(td_loss)(
+                params, target_params, obs, actions, team_rew, next_obs,
+                dones)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, l
+
+        self._agent_q = jax.jit(agent_q)
+        self._train_step = jax.jit(train_step)
+
+    # -- rollout / replay --------------------------------------------------
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._steps_sampled / cfg.epsilon_timesteps)
+        return 1.0 + frac * (cfg.final_epsilon - 1.0)
+
+    def _act(self, obs: Dict[str, np.ndarray], explore=True
+             ) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+        stacked = np.stack([obs[a] for a in self.agents], 1)  # [n_env,n,O]
+        q = np.asarray(self._agent_q(self.params, jnp.asarray(stacked)))
+        greedy = q.argmax(-1)                                 # [n_env, n]
+        if explore:
+            eps = self._epsilon()
+            rnd = self._rng.integers(0, self.n_actions, greedy.shape)
+            mask = self._rng.random(greedy.shape) < eps
+            greedy = np.where(mask, rnd, greedy)
+        return {a: greedy[:, i] for i, a in enumerate(self.agents)}
+
+    def _store(self, trans):
+        if len(self._buf) < self.config.buffer_size:
+            self._buf.append(trans)
+        else:
+            self._buf[self._buf_pos] = trans
+            self._buf_pos = (self._buf_pos + 1) % self.config.buffer_size
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        cfg = self.config
+        for _ in range(cfg.rollout_steps_per_iter):
+            obs = self._env_obs
+            actions = self._act(obs)
+            nobs, rew, term, trunc = self.env.step(actions)
+            team = sum(np.asarray(rew[a], np.float32)
+                       for a in self.agents)
+            done = (term | trunc).astype(np.float32)
+            o = np.stack([obs[a] for a in self.agents], 1)
+            no = np.stack([nobs[a] for a in self.agents], 1)
+            acts = np.stack([np.asarray(actions[a]) for a in self.agents],
+                            1)
+            for i in range(self.env.num_envs):
+                self._store((o[i], acts[i], team[i], no[i], done[i]))
+            self._env_obs = nobs
+            self._steps_sampled += self.env.num_envs
+        losses = []
+        if len(self._buf) >= cfg.train_batch_size:
+            for _ in range(cfg.train_steps_per_iter):
+                idx = self._rng.integers(0, len(self._buf),
+                                         cfg.train_batch_size)
+                o, a, r, no, d = (np.stack(x) for x in zip(
+                    *[self._buf[i] for i in idx]))
+                self.params, self.opt_state, l = self._train_step(
+                    self.params, self.target_params, self.opt_state,
+                    jnp.asarray(o, jnp.float32), jnp.asarray(a),
+                    jnp.asarray(r), jnp.asarray(no, jnp.float32),
+                    jnp.asarray(d))
+                losses.append(float(l))
+                self._train_steps += 1
+                if self._train_steps % cfg.target_update_interval == 0:
+                    self.target_params = self.params
+        rets, lens = self.env.drain_episode_metrics()
+        # Team return = sum of the agents' per-episode returns.
+        team_rets = [sum(vals) for vals in zip(*rets.values())]
+        self._episode_returns.extend(team_rets)
+        self._episode_lengths.extend(lens)
+        self.total_env_steps += cfg.rollout_steps_per_iter * \
+            self.env.num_envs
+        return {"episodes_this_iter": len(team_rets),
+                "epsilon": self._epsilon(),
+                "td_loss": float(np.mean(losses)) if losses else np.nan}
+
+    def evaluate_greedy(self, episodes: int = 64) -> float:
+        """Mean TEAM return under the greedy decentralized policies."""
+        env = make_multi_agent_env(self.config.env, episodes,
+                                   seed=self.config.seed + 1)
+        obs = env.reset_all()
+        total = np.zeros(episodes, np.float64)
+        for _ in range(64):
+            actions = self._act(obs, explore=False)
+            obs, rew, term, trunc = env.step(actions)
+            total += sum(np.asarray(rew[a]) for a in self.agents)
+            if (term | trunc).all():
+                break
+        return float(total.mean())
+
+    def save_to_dict(self) -> Dict[str, Any]:
+        import jax
+        return {"params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.target_params),
+                "steps_sampled": self._steps_sampled}
+
+    def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self._steps_sampled = state["steps_sampled"]
+
+
+class VDNConfig(QMixConfig):
+    """VDN = additive mixing (reference: qmix.py's mixer=None/'vdn')."""
+
+    def __init__(self):
+        super().__init__()
+        self.mixer = "vdn"

@@ -435,6 +435,10 @@ _RLLIB_ALGOS = {
     "DQN": ("ray_tpu.rllib.dqn", "DQNConfig"),
     "SAC": ("ray_tpu.rllib.sac", "SACConfig"),
     "TD3": ("ray_tpu.rllib.td3", "TD3Config"),
+    "ES": ("ray_tpu.rllib.es", "ESConfig"),
+    "ARS": ("ray_tpu.rllib.ars", "ARSConfig"),
+    "LinUCB": ("ray_tpu.rllib.bandit", "LinUCBConfig"),
+    "LinTS": ("ray_tpu.rllib.bandit", "LinTSConfig"),
 }
 
 
