@@ -124,6 +124,43 @@ _D("serve_controller_threads", 64, int,
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")
+# -- rpc retry -------------------------------------------------------------
+_D("rpc_max_retries", 4, int,
+   "transient-failure (UNAVAILABLE/disconnect) retries per RpcClient.call; "
+   "0 disables retrying")
+_D("rpc_retry_base_ms", 50.0, float,
+   "first retry backoff; doubles per attempt with +/-50% jitter")
+_D("rpc_retry_max_ms", 2000.0, float, "backoff ceiling per retry sleep")
+# -- fault injection (chaos) ----------------------------------------------
+# Deterministic seeded chaos: see _private/fault_injection.py.  All
+# probabilities are per-event in [0,1]; flags propagate to daemons and
+# workers through the RAY_TPU_* env export in api.init.
+_D("chaos_enabled", False, _bool,
+   "master switch for the fault-injection layer")
+_D("chaos_seed", 0, int,
+   "seed for the deterministic fault schedule (same seed => same faults)")
+_D("chaos_max_faults", 0, int,
+   "total faults to inject before going quiet; 0 = unlimited")
+_D("chaos_rpc_drop", 0.0, float,
+   "probability an outbound RPC attempt fails with ChaosInjectedError")
+_D("chaos_rpc_delay_p", 0.0, float,
+   "probability an outbound RPC attempt is delayed")
+_D("chaos_rpc_delay_ms", 100.0, float, "injected RPC delay duration")
+_D("chaos_rpc_disconnect", 0.0, float,
+   "probability an outbound RPC attempt tears down its channel first")
+_D("chaos_native_drop", 0.0, float,
+   "probability a native-transport task push is dropped")
+_D("chaos_object_fetch_drop", 0.0, float,
+   "probability an object-transfer fetch reports the copy missing")
+_D("chaos_kill_worker", 0.0, float,
+   "probability a worker kills itself before executing a task")
+_D("chaos_kill_worker_salts", "", str,
+   "scripted kills: csv of worker spawn ordinals that self-kill (see "
+   "fault_injection.ChaosController.kill_worker)")
+_D("chaos_kill_worker_at", 0, int,
+   "task-execution index at which a scripted worker kill fires")
+_D("chaos_kill_hostd", 0.0, float,
+   "probability hostd kills itself at a heartbeat tick")
 
 
 GLOBAL_CONFIG = RayTpuConfig()

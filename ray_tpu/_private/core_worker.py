@@ -173,6 +173,11 @@ class CoreWorker:
         self.objects: dict[ObjectID, _ObjectState] = {}
         self.tasks: dict[TaskID, _PendingTask] = {}
         self._pg_rr: dict = {}  # placement group -> round-robin counter
+        # Lineage reconstructions in flight, by producing task: concurrent
+        # getters of a lost object piggyback on one resubmission instead
+        # of burning one retry each (reference:
+        # object_recovery_manager.h objects_pending_recovery_).
+        self._reconstructing: dict = {}   # TaskID -> asyncio.Event
         # Lease pipelining (reference: direct_task_transport.h:53-55,151 —
         # queued tasks with the same SchedulingKey reuse a held worker
         # lease instead of paying pick_node+lease+return per task).
@@ -291,6 +296,7 @@ class CoreWorker:
         s.register("CoreWorker", "StackTrace", self._rpc_stack_trace)
         s.register("CoreWorker", "Ping", self._rpc_ping)
         s.register("CoreWorker", "NativePort", self._rpc_native_port)
+        s.register("CoreWorker", "NodeDead", self._rpc_node_dead)
 
     async def _rpc_native_port(self, req):
         """Native-transport discovery: callers connect to this port for the
@@ -299,6 +305,29 @@ class CoreWorker:
 
     async def _rpc_ping(self, req):
         return {"ok": True, "worker_id": self.worker_id}
+
+    async def _rpc_node_dead(self, req):
+        """Hostd pushes GCS-detected node death down to its workers
+        (reference: raylet NodeRemoved pub/sub -> core-worker object
+        directory invalidation).  Drop the dead node from every owned
+        object's location set (gets fail over to live copies or lineage),
+        forget its pooled channel and native route, and purge its leases
+        from every key scheduler so queued work re-leases elsewhere."""
+        dead_hex = req["node_id"]
+        dead_addr = req.get("address") or ""
+        with self._obj_lock:
+            for st in self.objects.values():
+                st.locations.discard(dead_hex)
+        self._node_cache = None   # next _node_table() refetches live view
+        if dead_addr:
+            self.pool.invalidate(dead_addr)
+        purged = 0
+        for ks in list(self._lease_cache.values()):
+            purged += ks.purge_node(dead_hex)
+        if purged:
+            logger.info("node %s dead: purged %d lease(s)",
+                        dead_hex[:8], purged)
+        return {"ok": True, "purged": purged}
 
     async def _kv_call(self, method: str, request):
         return await self.gcs.call("Kv", method, request)
@@ -1633,24 +1662,42 @@ class CoreWorker:
 
     async def _try_reconstruct(self, ref: ObjectRef) -> bool:
         """Lineage reconstruction: resubmit the producing task
-        (reference: object_recovery_manager.h:41)."""
+        (reference: object_recovery_manager.h:41).
+
+        Retry accounting: exactly ONE retry is burned per lost-output
+        event regardless of how many getters notice — concurrent getters
+        (and getters of sibling returns of the same task) piggyback on
+        the in-flight resubmission via `_reconstructing` instead of each
+        decrementing `retries_left` and racing duplicate resubmits."""
         st = self.objects.get(ref.id)
         if st is None or st.producing_task is None:
             return False
-        pending = self.tasks.get(st.producing_task)
+        tid = st.producing_task
+        inflight = self._reconstructing.get(tid)
+        if inflight is not None:
+            await inflight.wait()
+            return True
+        pending = self.tasks.get(tid)
         if pending is None or pending.retries_left <= 0:
             return False
         pending.retries_left -= 1
-        for i in range(pending.spec.num_returns):
-            oid = ObjectID.for_return(pending.spec.task_id, i)
-            rst = self.objects.setdefault(oid, _ObjectState())
-            rst.pending = True
-            rst.inline = None
-            rst.error = None
-            rst.locations.clear()
-            rst.event = asyncio.Event()
-        logger.info("reconstructing %s via task %s", ref.id, pending.spec.name)
-        await self._run_task_to_completion(st.producing_task)
+        done = asyncio.Event()
+        self._reconstructing[tid] = done
+        try:
+            for i in range(pending.spec.num_returns):
+                oid = ObjectID.for_return(pending.spec.task_id, i)
+                rst = self.objects.setdefault(oid, _ObjectState())
+                rst.pending = True
+                rst.inline = None
+                rst.error = None
+                rst.locations.clear()
+                rst.event = asyncio.Event()
+            logger.info("reconstructing %s via task %s", ref.id,
+                        pending.spec.name)
+            await self._run_task_to_completion(tid)
+        finally:
+            self._reconstructing.pop(tid, None)
+            done.set()
         return True
 
     # ------------------------------------------------------------------
@@ -1890,14 +1937,18 @@ class CoreWorker:
             if naddr:
                 # Always batched: the only caller is _drain_fast, which
                 # owns the burst's per-worker batch dict and flushes it.
+                # Capture the incarnation now: by the time a failure
+                # callback fires the submitter may point at a restart.
+                ver = sub.version
                 cb = (lambda status, data: self._on_actor_push_done(
-                    sub, task_id, addr, status, data))
+                    sub, task_id, addr, status, data, ver))
                 batches.setdefault(naddr, []).append(
                     (pending.payload, pending.template, cb))
                 return
         asyncio.ensure_future(self._run_actor_task(sub, task_id))
 
-    def _on_actor_push_done(self, sub, task_id, addr, status, data):
+    def _on_actor_push_done(self, sub, task_id, addr, status, data,
+                            version: int = -1):
         pending = self.tasks.get(task_id)
         if pending is None:
             return
@@ -1915,14 +1966,15 @@ class CoreWorker:
         asyncio.ensure_future(
             self._actor_push_failed_cont(
                 sub, task_id, addr,
-                ConnClosedError("native connection closed")))
+                ConnClosedError("native connection closed"), version))
 
-    async def _actor_push_failed_cont(self, sub, task_id, addr, exc):
+    async def _actor_push_failed_cont(self, sub, task_id, addr, exc,
+                                      version: int = -1):
         pending = self.tasks.get(task_id)
         if pending is None:
             return
         if await self._actor_failure_step(sub, pending, pending.spec, addr,
-                                          exc):
+                                          exc, version):
             return
         await self._run_actor_task(sub, task_id)
 
@@ -1946,6 +1998,7 @@ class CoreWorker:
             except ActorDiedError as e:
                 self._complete_task_error(spec, e)
                 return
+            ver = sub.version   # incarnation this dispatch targets
             try:
                 reply = await self._native_call_worker(
                     addr, spec, wire_seq=spec.seq_no - sub.epoch_base)
@@ -1959,16 +2012,24 @@ class CoreWorker:
                 return
             except Exception as e:
                 if await self._actor_failure_step(sub, pending, spec,
-                                                  addr, e):
+                                                  addr, e, ver):
                     return
 
     async def _actor_failure_step(self, sub, pending, spec, addr,
-                                  e) -> bool:
+                                  e, version: int = -1) -> bool:
         """One transport-failure outcome for an actor call; True = the task
-        completed terminally (with an error)."""
+        completed terminally (with an error).
+
+        `version` is the actor incarnation the caller OBSERVED when it
+        dispatched (captured at resolve time).  The rebase below must run
+        once per incarnation death: without the version guard, a stale
+        failure callback arriving after the actor restarted on a reused
+        address would rebase a LIVE incarnation's window and desequence
+        every in-flight call."""
         self.pool.invalidate(addr)
         with sub.lock:
-            if sub.address == addr:
+            if sub.address == addr and (version < 0
+                                        or sub.version == version):
                 # First detector of this incarnation's death: rebase
                 # the wire sequence for the next incarnation.
                 sub.address = None
@@ -2322,6 +2383,15 @@ class CoreWorker:
 
     def _execute_task(self, spec: TaskSpec) -> dict:
         from ray_tpu.exceptions import TaskCancelledError
+        from ray_tpu._private.fault_injection import get_chaos
+        chaos = get_chaos()
+        if chaos is not None and self.mode == "worker" \
+                and chaos.kill_worker():
+            # Injected preemption: die BEFORE touching the task, exactly
+            # like a SIGKILL'd/preempted worker — the owner sees the
+            # connection drop and must retry/reconstruct.
+            logger.warning("chaos: killing worker before task %s", spec.name)
+            os._exit(1)
         _t0 = time.time()
         if spec.task_id in self._cancelled_exec:
             self._cancelled_exec.discard(spec.task_id)
@@ -2551,6 +2621,27 @@ class _KeyScheduler:
             leases, self.leases = self.leases, []
         for lease in leases:
             await self.worker._return_lease(lease)
+
+    def purge_node(self, node_hex: str) -> int:
+        """Forget every lease on a dead node WITHOUT a return RPC (the
+        daemon is gone) and re-pump so queued work leases elsewhere.
+        In-flight pushes on the purged leases fail through their own
+        transport callbacks, which find the lease already removed and
+        route each task into the normal retry machinery."""
+        def _hex(nid):
+            h = getattr(nid, "hex", None)
+            return h() if callable(h) else nid
+        with self.tlock:
+            dead = [l for l in self.leases
+                    if _hex(l.get("node_id")) == node_hex]
+            for lease in dead:
+                self.leases.remove(lease)
+        for lease in dead:
+            self.worker.pool.invalidate(lease["worker_address"])
+            self.worker._native_addrs.pop(lease["worker_address"], None)
+        if dead:
+            self._pump()
+        return len(dead)
 
     # -- internals ---------------------------------------------------------
     def _pump(self, batches=None):

@@ -1,0 +1,203 @@
+"""Deterministic fault injection (chaos) for the whole runtime.
+
+Reference parity: Ray's testing_asio_delay_us / RAY_testing_rpc_failure
+knobs (src/ray/common/ray_config_def.h) plus the chaos-mesh style kill
+tests in python/ray/tests/test_failure*.py — here unified behind one
+seeded controller so an injected-fault schedule is a *pure function of
+the seed*, independent of thread timing.
+
+Three planes are interposed:
+
+- ``rpc``    — every outbound `RpcClient.call` (drop / delay / disconnect)
+- ``native`` — the framed-TCP task plane (`task_transport.NativeSubmitter`)
+  and the object-transfer fetch path (`object_transfer.fetch`)
+- ``proc``   — process lifetime: worker self-kill before task execution
+  (`core_worker._execute_task`) and hostd self-kill in its heartbeat loop
+
+Determinism: each plane keeps a monotonically increasing event index, and
+the decision for event *n* on plane *p* is drawn from
+``random.Random(f"{seed}|{p}|{n}")`` — a fresh PRNG keyed by (seed, plane,
+index).  Two runs with the same seed therefore inject the *same* fault at
+the *same* per-plane event ordinal even when threads interleave
+differently; only the index allocation (which call gets which ordinal)
+needs to match, which holds per-plane because each interposition point
+increments under a lock.
+
+All flags live in `_private.config` (``RAY_TPU_CHAOS_*`` env vars /
+``_system_config={"chaos_enabled": True, ...}``) and propagate to spawned
+daemons and workers via the env-var export in `api.init`.  With
+``chaos_enabled`` off (the default) `get_chaos()` returns None and the
+hot paths pay a single attribute read.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import List, Optional, Tuple
+
+from .config import GLOBAL_CONFIG
+
+
+class ChaosInjectedError(ConnectionError):
+    """A fault injected by the chaos layer.
+
+    Subclasses ConnectionError so injected faults ride the exact same
+    retry / failover paths as real transport failures — the point of the
+    exercise is that recovery code cannot tell them apart.
+    """
+
+
+class ChaosController:
+    """Seeded fault scheduler; one per process.
+
+    ``should(plane, probability)`` allocates the next event index on
+    `plane` and returns the deterministic verdict for that (seed, plane,
+    index) triple.  Every injected fault is appended to ``schedule`` as
+    ``(plane, index, kind)`` so tests can assert that two controllers
+    with the same seed produce identical schedules.
+    """
+
+    def __init__(self, seed: int, max_faults: int = 0,
+                 salt: str | None = None):
+        self.seed = int(seed)
+        self.max_faults = int(max_faults)  # 0 = unlimited
+        # Process identity salt: hostd stamps each worker with its spawn
+        # ordinal (RAY_TPU_CHAOS_PROC_SALT).  Without it a killed worker's
+        # replacement would replay the exact draw that killed its
+        # predecessor (same seed, same fresh counters) and die forever;
+        # with it, the replacement draws a distinct — still seed-
+        # deterministic — schedule.  Daemons and the driver carry no salt.
+        self.salt = (os.environ.get("RAY_TPU_CHAOS_PROC_SALT", "")
+                     if salt is None else salt)
+        self._counters: dict = {}
+        self._faults = 0
+        self._lock = threading.Lock()
+        self.schedule: List[Tuple[str, int, str]] = []
+
+    # -- deterministic draws ----------------------------------------------
+
+    def _next_index(self, plane: str) -> int:
+        n = self._counters.get(plane, 0)
+        self._counters[plane] = n + 1
+        return n
+
+    def draw(self, plane: str, index: int) -> float:
+        """The uniform [0,1) draw for event `index` on `plane` — a pure
+        function of (seed, salt, plane, index)."""
+        return random.Random(
+            f"{self.seed}|{self.salt}|{plane}|{index}").random()
+
+    def should(self, plane: str, probability: float, kind: str) -> bool:
+        """Allocate the next event on `plane`; True if a fault fires.
+
+        Respects ``max_faults``: once the budget is exhausted no further
+        faults fire (so chaos tests converge instead of flapping forever),
+        but indices keep advancing so the schedule stays aligned.
+        """
+        if probability <= 0.0:
+            with self._lock:
+                self._next_index(plane)
+            return False
+        with self._lock:
+            n = self._next_index(plane)
+            if self.max_faults and self._faults >= self.max_faults:
+                return False
+            hit = self.draw(plane, n) < probability
+            if hit:
+                self._faults += 1
+                self.schedule.append((plane, n, kind))
+            return hit
+
+    @property
+    def faults_injected(self) -> int:
+        with self._lock:
+            return self._faults
+
+    # -- plane-specific policy (reads config each call: flags are cached
+    # in the registry, and tests flip them between scenarios) -------------
+
+    def rpc_fault(self) -> Optional[Tuple[str, float]]:
+        """Chaos verdict for one outbound RPC attempt.
+
+        Returns None (no fault) or ("drop"|"disconnect", 0.0) /
+        ("delay", seconds).  Drop and disconnect surface as
+        ChaosInjectedError at the call site; delay just sleeps.
+        """
+        cfg = GLOBAL_CONFIG
+        if self.should("rpc", cfg.chaos_rpc_drop, "drop"):
+            return ("drop", 0.0)
+        if self.should("rpc", cfg.chaos_rpc_disconnect, "disconnect"):
+            return ("disconnect", 0.0)
+        if self.should("rpc", cfg.chaos_rpc_delay_p, "delay"):
+            return ("delay", cfg.chaos_rpc_delay_ms / 1000.0)
+        return None
+
+    def native_drop(self) -> bool:
+        """Drop one native-transport task push."""
+        return self.should("native", GLOBAL_CONFIG.chaos_native_drop, "drop")
+
+    def object_fetch_drop(self) -> bool:
+        """Fail one object-transfer fetch (simulates a lost copy)."""
+        return self.should(
+            "object", GLOBAL_CONFIG.chaos_object_fetch_drop, "drop")
+
+    def kill_worker(self) -> bool:
+        """Kill this worker process before executing the next task.
+
+        Two modes (ISSUE: "probabilistic or scripted kills"):
+        - scripted: `chaos_kill_worker_salts` names worker spawn ordinals
+          (csv); a listed worker dies right before executing its
+          `chaos_kill_worker_at`-th task.  Fully deterministic AND
+          convergent — the replacement worker has the next ordinal, which
+          is not in the list.
+        - probabilistic: `chaos_kill_worker` per-execution probability,
+          drawn from the salted (seed, plane, index) stream.
+        """
+        cfg = GLOBAL_CONFIG
+        salts = str(cfg.chaos_kill_worker_salts or "")
+        if salts and self.salt:
+            listed = self.salt in [s.strip() for s in salts.split(",")]
+            with self._lock:
+                n = self._next_index("proc")
+                if listed and n == int(cfg.chaos_kill_worker_at):
+                    self._faults += 1
+                    self.schedule.append(("proc", n, "kill"))
+                    return True
+            return False
+        return self.should("proc", cfg.chaos_kill_worker, "kill")
+
+    def kill_hostd(self) -> bool:
+        """Kill this node daemon at the next heartbeat."""
+        return self.should(
+            "hostd", GLOBAL_CONFIG.chaos_kill_hostd, "kill")
+
+
+_chaos: Optional[ChaosController] = None
+_chaos_lock = threading.Lock()
+
+
+def get_chaos() -> Optional[ChaosController]:
+    """The process-wide controller, or None when chaos is disabled.
+
+    Hot paths call this on every interposed event; the disabled case is
+    one cached config-attribute read.
+    """
+    if not GLOBAL_CONFIG.chaos_enabled:
+        return None
+    global _chaos
+    if _chaos is None:
+        with _chaos_lock:
+            if _chaos is None:
+                _chaos = ChaosController(
+                    GLOBAL_CONFIG.chaos_seed, GLOBAL_CONFIG.chaos_max_faults)
+    return _chaos
+
+
+def reset() -> None:
+    """Drop the process controller (tests flip seeds/flags between
+    scenarios; the next `get_chaos()` rebuilds from current config)."""
+    global _chaos
+    with _chaos_lock:
+        _chaos = None

@@ -312,6 +312,10 @@ class NodeDaemon:
         self._spawn_seq += 1
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        # Chaos identity: the spawn ordinal salts the worker's fault
+        # schedule so a killed worker's replacement doesn't replay the
+        # draw that killed it (fault_injection.ChaosController).
+        env["RAY_TPU_CHAOS_PROC_SALT"] = str(self._spawn_seq)
         if not tpu:
             # Leases without a TPU demand get a worker that skips runtime
             # TPU registration (the site hook imports jax + the PJRT plugin
@@ -1220,8 +1224,19 @@ class NodeDaemon:
 
     async def _heartbeat_loop(self):
         from ray_tpu import protocol
+        from ray_tpu._private.fault_injection import get_chaos
         last_ok = time.monotonic()
         while not self._shutdown.is_set():
+            chaos = get_chaos()
+            if chaos is not None and not self.is_head \
+                    and chaos.kill_hostd():
+                # Injected node failure: die like a preempted host — no
+                # cleanup, no dereg.  The GCS health loop declares the
+                # node dead after node_death_timeout_s and fails over its
+                # actors; peers learn through their node-watch loops.
+                logger.warning("chaos: killing hostd %s",
+                               self.node_id.hex()[:8])
+                os._exit(1)
             try:
                 hb = protocol.pb.HeartbeatRequest(
                     node_id=self.node_id.binary())
@@ -1246,6 +1261,61 @@ class NodeDaemon:
                     logger.error("GCS unreachable for 90s; hostd exiting")
                     self._shutdown.set()
             await asyncio.sleep(gcs_mod.HEARTBEAT_INTERVAL_S)
+
+    async def _node_watch_loop(self):
+        """Propagate GCS-detected node death to this node's workers
+        (reference: raylet subscribes to GCS NodeRemoved and notifies its
+        core workers).
+
+        The heartbeat reply is a compiled proto with no room for
+        membership deltas, so the daemon polls the GCS node table — the
+        cluster version makes the no-change iteration one cheap RPC —
+        and, when a peer transitions alive->dead, invalidates the peer's
+        pooled channel and pushes a NodeDead notification to every live
+        local worker.  Owners there drop the dead node from object
+        location sets and purge its worker leases
+        (core_worker._rpc_node_dead), reconnecting lease demand to the
+        surviving nodes."""
+        known_alive: set | None = None
+        version = None
+        while not self._shutdown.is_set():
+            try:
+                reply = await self.gcs.call("Gcs", "get_nodes", {},
+                                            timeout=5)
+            except Exception:
+                await asyncio.sleep(gcs_mod.HEARTBEAT_INTERVAL_S)
+                continue
+            if reply.get("version") != version:
+                version = reply.get("version")
+                nodes = reply["nodes"]
+                alive = {n.node_id.hex() for n in nodes if n.alive}
+                if known_alive is not None:
+                    addr_of = {n.node_id.hex(): n.address for n in nodes}
+                    for nid in known_alive - alive:
+                        if nid == self.node_id.hex():
+                            continue
+                        addr = addr_of.get(nid, "")
+                        logger.warning("peer node %s (%s) declared dead",
+                                       nid[:8], addr)
+                        if addr:
+                            self.pool.invalidate(addr)
+                        await self._broadcast_node_dead(nid, addr)
+                known_alive = alive
+            await asyncio.sleep(gcs_mod.HEARTBEAT_INTERVAL_S)
+
+    async def _broadcast_node_dead(self, nid_hex: str, addr: str):
+        async def _notify(handle):
+            try:
+                await self.pool.get(handle.address).call(
+                    "CoreWorker", "NodeDead",
+                    {"node_id": nid_hex, "address": addr}, timeout=2)
+            except Exception:
+                pass  # worker may be mid-exit; its own RPCs will fail over
+
+        targets = [h for h in list(self.workers.values())
+                   if h.address and h.proc.poll() is None]
+        if targets:
+            await asyncio.gather(*[_notify(h) for h in targets])
 
     async def _reaper_loop(self):
         """Detect dead/idle-expired workers; report dead actor workers."""
@@ -1337,7 +1407,8 @@ class NodeDaemon:
         if _cfg().worker_zygote:
             self._prestart_zygote()  # off-loop; cold imports never block
         self._tasks = [asyncio.ensure_future(self._heartbeat_loop()),
-                       asyncio.ensure_future(self._reaper_loop())]
+                       asyncio.ensure_future(self._reaper_loop()),
+                       asyncio.ensure_future(self._node_watch_loop())]
         if self.spill_enabled:
             self.store.set_eviction(False)
             self._tasks.append(asyncio.ensure_future(self._spill_loop()))

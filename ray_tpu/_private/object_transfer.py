@@ -96,6 +96,12 @@ def fetch(store_path: str, host: str, port: int, oid: ObjectID) -> bool:
     transport/allocation failures.  BLOCKING — call from an executor
     thread, never the event loop.
     """
+    from ray_tpu._private.fault_injection import get_chaos
+    chaos = get_chaos()
+    if chaos is not None and chaos.object_fetch_drop():
+        # Injected lost copy: report not-found so the caller's location
+        # failover (and ultimately lineage reconstruction) takes over.
+        return False
     rc = _load().tpot_fetch(_client(store_path), host.encode(), port,
                             oid.binary())
     if rc in (_OK, _EXISTS):

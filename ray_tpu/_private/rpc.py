@@ -15,11 +15,16 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import random
 import threading
+import time
 from typing import Any, Callable
 
 import grpc
 import grpc.aio
+
+from .config import GLOBAL_CONFIG
+from .fault_injection import ChaosInjectedError, get_chaos
 
 _MAX_MSG = 512 * 1024 * 1024
 _OPTIONS = [
@@ -150,13 +155,105 @@ class RpcClient:
             self._callables[path] = rpc
         return rpc
 
+    def _reset_channel(self):
+        """Tear down the channel so the next call redials.
+
+        Pooled clients self-heal through this: a disconnect invalidates
+        the cached MultiCallables (they hold the dead channel) and the
+        retry loop rebuilds both (reference: client_call.h channel
+        reconnection on UNAVAILABLE).
+        """
+        chan, self._channel = self._channel, None
+        self._callables.clear()
+        if chan is not None:
+            # Close asynchronously; we may be mid-retry on the loop.
+            try:
+                asyncio.ensure_future(chan.close())
+            except RuntimeError:
+                pass
+
+    @staticmethod
+    def _retryable(e: BaseException) -> bool:
+        if isinstance(e, ChaosInjectedError):
+            return True
+        if isinstance(e, grpc.aio.AioRpcError):
+            # Only UNAVAILABLE is safely retryable: the request never
+            # reached (or never committed on) the peer.  UNKNOWN may mean
+            # the handler ran.
+            return e.code() == grpc.StatusCode.UNAVAILABLE
+        return False
+
     async def call(self, service: str, method: str, request: Any = None,
                    timeout: float | None = None) -> Any:
+        """Invoke a remote method with transparent transient-failure retry.
+
+        `timeout` is the OVERALL deadline for the call, spanning all
+        attempts — a liveness probe with timeout=5 still fails within
+        ~5s even while retrying.  Only transport-level failures
+        (UNAVAILABLE, injected chaos faults) are retried, with
+        exponential backoff + jitter; remote handler exceptions
+        (RpcError) and DEADLINE_EXCEEDED surface immediately.
+        """
         path = f"/raytpu.{service}/{method}"
-        data = await self._callable(path)(_dumps(request), timeout=timeout)
-        if data[:1] == b"\x02":
-            raise RpcError(path, pickle.loads(data[1:]))
-        return _loads(data)
+        payload = _dumps(request)
+        cfg = GLOBAL_CONFIG
+        deadline = None if timeout is None else time.monotonic() + timeout
+        attempt = 0
+        while True:
+            chaos = get_chaos()
+            if chaos is not None:
+                fault = chaos.rpc_fault()
+                if fault is not None:
+                    kind, delay = fault
+                    if kind == "delay":
+                        await asyncio.sleep(delay)
+                    else:
+                        if kind == "disconnect":
+                            self._reset_channel()
+                        err = ChaosInjectedError(
+                            f"chaos: {kind} {self.address}{path}")
+                        if not await self._backoff(attempt, deadline, cfg):
+                            raise err
+                        attempt += 1
+                        continue
+            per_attempt = None
+            if deadline is not None:
+                per_attempt = deadline - time.monotonic()
+                if per_attempt <= 0:
+                    raise TimeoutError(
+                        f"{path} to {self.address}: deadline exceeded "
+                        f"after {attempt} attempt(s)")
+            try:
+                data = await self._callable(path)(payload,
+                                                  timeout=per_attempt)
+            except BaseException as e:  # noqa: BLE001 - classified below
+                if not self._retryable(e):
+                    raise
+                self._reset_channel()
+                if not await self._backoff(attempt, deadline, cfg):
+                    raise
+                attempt += 1
+                continue
+            if data[:1] == b"\x02":
+                raise RpcError(path, pickle.loads(data[1:]))
+            return _loads(data)
+
+    async def _backoff(self, attempt: int, deadline: float | None,
+                       cfg) -> bool:
+        """Sleep the exponential backoff for `attempt`; False when the
+        retry budget or the deadline is exhausted (caller re-raises)."""
+        if attempt >= cfg.rpc_max_retries:
+            return False
+        delay = min(cfg.rpc_retry_base_ms * (2 ** attempt),
+                    cfg.rpc_retry_max_ms) / 1000.0
+        delay *= 0.5 + random.random()  # +/-50% jitter, decorrelates peers
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            delay = min(delay, remaining)
+        await asyncio.sleep(delay)
+        return True
 
     async def close(self):
         if self._channel is not None:

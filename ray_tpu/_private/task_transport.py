@@ -220,6 +220,13 @@ class NativeSubmitter:
         from inside scheduler loops, and a synchronous error callback
         would re-enter them mid-iteration (the future-based API always
         deferred; this preserves that contract)."""
+        from ray_tpu._private.fault_injection import get_chaos
+        chaos = get_chaos()
+        if chaos is not None and chaos.native_drop():
+            # Injected drop: surface as a transport failure so the
+            # caller's worker-death/retry path handles it.
+            self._loop.call_soon(cb, TPT_ECONN, b"")
+            return
         try:
             tag = self.connect(addr)
         except ConnectionError:
@@ -255,6 +262,22 @@ class NativeSubmitter:
         tpl_bytes).  Callable from the loop OR a submitting thread
         (zero-hop dispatch); failure callbacks land on the loop either
         way."""
+        from ray_tpu._private.fault_injection import get_chaos
+        chaos = get_chaos()
+        if chaos is not None:
+            kept = []
+            for it in items:
+                if chaos.native_drop():
+                    try:
+                        self._loop.call_soon_threadsafe(it[2], TPT_ECONN,
+                                                        b"")
+                    except RuntimeError:
+                        pass
+                else:
+                    kept.append(it)
+            items = kept
+            if not items:
+                return
         with self._users_mu:
             if self._closed:
                 for _d, _t, cb in items:

@@ -228,6 +228,12 @@ def shutdown():
         for k in _applied_system_config:
             GLOBAL_CONFIG._overrides.pop(k, None)
             _os.environ.pop(f"RAY_TPU_{k.upper()}", None)
+        if _applied_system_config:
+            # Resolved values are cached on read; dropping the overrides
+            # without this would leak them into a later init().
+            GLOBAL_CONFIG.invalidate_cache()
+            from ray_tpu._private import fault_injection
+            fault_injection.reset()
         _applied_system_config = []
     try:
         if cluster and cluster.get("owned"):

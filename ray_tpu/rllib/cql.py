@@ -3,10 +3,11 @@
 Reference parity: rllib/algorithms/cql/ (cql.py extends SAC with the
 conservative regularizer; cql_torch_policy.py adds
 alpha * E[ logsumexp_a Q(s,a) - Q(s, a_logged) ] to the critic loss).
-Here the discrete-action form is implemented over the double-Q TD
-machinery (the CQL(H) objective, eq. 4 of the paper, whose inner max has
-the closed logsumexp form for finite action sets — no OOD action
-sampler needed).  The conservative term pushes down Q on actions the
+Here the discrete-action form is implemented over single-Q TD with a
+target-network max — i.e. DQN-style bootstrapping, not double-Q
+decoupling of argmax and evaluation (the CQL(H) objective, eq. 4 of the
+paper, whose inner max has the closed logsumexp form for finite action
+sets — no OOD action sampler needed).  The conservative term pushes down Q on actions the
 behavior policy never logged, so the greedy policy stays inside the
 data's support — the property the offline setting needs and plain TD
 lacks.
@@ -88,21 +89,44 @@ class CQL:
         self._step = jax.jit(step)
 
     def train_on(self, batch: SampleBatch) -> Dict[str, float]:
+        """Run `num_epochs` of minibatch CQL updates over a logged batch.
+
+        Input contract: rows are TIME-ORDERED transitions, episodes laid
+        out back to back, with done flags (terminateds|truncateds) marking
+        each episode's last row.  next_obs for row t is row t+1's obs —
+        valid precisely because a done row's bootstrap target is masked by
+        `(1 - dones)`, so the cross-episode splice at each boundary is
+        never read.  Shuffled or subsampled logs violate the contract and
+        must carry an explicit "next_obs" column instead.
+        """
         import jax.numpy as jnp
 
         cfg = self.config
         obs = np.asarray(batch[SampleBatch.OBS], np.float32)
         actions = np.asarray(batch[SampleBatch.ACTIONS])
         rewards = np.asarray(batch[SampleBatch.REWARDS], np.float32)
+        if len(obs) == 0:
+            raise ValueError("CQL.train_on: empty batch")
+        if not (len(actions) == len(rewards) == len(obs)):
+            raise ValueError(
+                "CQL.train_on: ragged batch (obs/actions/rewards rows "
+                f"{len(obs)}/{len(actions)}/{len(rewards)})")
         term = np.asarray(batch.get(SampleBatch.TERMINATEDS,
                                     np.zeros(len(obs))), bool)
         trunc = np.asarray(batch.get(SampleBatch.TRUNCATEDS,
                                      np.zeros(len(obs))), bool)
         dones = (term | trunc)
-        # next_obs = following row inside an episode; a done row
-        # bootstraps nothing so its next_obs is arbitrary (masked).
-        next_obs = np.concatenate([obs[1:], obs[-1:]], 0)
-        dones[-1] = True   # the log's tail cannot bootstrap
+        if "next_obs" in batch:
+            # Explicit column: no ordering assumption needed.
+            next_obs = np.asarray(batch["next_obs"], np.float32)
+            if len(next_obs) != len(obs):
+                raise ValueError("CQL.train_on: next_obs rows "
+                                 f"{len(next_obs)} != obs rows {len(obs)}")
+        else:
+            # next_obs = following row inside an episode; a done row
+            # bootstraps nothing so its next_obs is arbitrary (masked).
+            next_obs = np.concatenate([obs[1:], obs[-1:]], 0)
+            dones[-1] = True   # the log's tail cannot bootstrap
         n = len(obs)
         last = {}
         for _ in range(cfg.num_epochs):

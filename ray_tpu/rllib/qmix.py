@@ -298,11 +298,18 @@ class QMix(Algorithm):
                                    seed=self.config.seed + 1)
         obs = env.reset_all()
         total = np.zeros(episodes, np.float64)
+        # Episodes are masked, not restarted (es.py idiom): each lane
+        # accumulates team reward until its FIRST done, then goes
+        # inactive — auto-reset lanes must not leak a second episode's
+        # reward into the mean.
+        active = np.ones(episodes, bool)
         for _ in range(64):
             actions = self._act(obs, explore=False)
             obs, rew, term, trunc = env.step(actions)
-            total += sum(np.asarray(rew[a]) for a in self.agents)
-            if (term | trunc).all():
+            team_rew = sum(np.asarray(rew[a]) for a in self.agents)
+            total += team_rew * active
+            active &= ~(term | trunc)
+            if not active.any():
                 break
         return float(total.mean())
 

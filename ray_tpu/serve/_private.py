@@ -466,11 +466,32 @@ _router_states: Dict[str, _RouterState] = {}
 _router_states_lock = threading.Lock()
 
 
+# One small shared executor for orphan-stream reaps: each reap can block
+# up to 60s on the abandoned call, and a thread PER abandoned request is
+# an unbounded leak under a disconnect storm.  A bounded queue-backed
+# pool serializes the excess instead; reaps are cleanup, not latency-
+# sensitive.
+_reaper_pool = None
+_reaper_pool_lock = threading.Lock()
+
+
+def _get_reaper_pool():
+    global _reaper_pool
+    if _reaper_pool is None:
+        with _reaper_pool_lock:
+            if _reaper_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _reaper_pool = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="serve-stream-reaper")
+    return _reaper_pool
+
+
 def _reap_orphan_stream(replica, req_ref) -> None:
     """The caller abandoned a handle_request whose ticket it never saw.
     If that call registered a stream replica-side, its generator and
     in-flight slot would be held forever (no one knows the sid) — wait
-    out the call on a daemon thread and cancel any stream it opened."""
+    out the call on the shared reaper pool and cancel any stream it
+    opened."""
     def _reap():
         try:
             ticket = ray_tpu.get(req_ref, timeout=60)
@@ -479,8 +500,7 @@ def _reap_orphan_stream(replica, req_ref) -> None:
                     ticket["__serve_stream__"]), timeout=10)
         except Exception:
             pass  # replica died or call failed: nothing leaked
-    threading.Thread(target=_reap, daemon=True,
-                     name="serve-stream-reaper").start()
+    _get_reaper_pool().submit(_reap)
 
 
 def _get_router_state(name: str) -> _RouterState:

@@ -363,6 +363,12 @@ class ResourceChangingScheduler(TrialScheduler):
             new = self.alloc(trial, result, self.base_resources, total,
                              n_live)
             if new is not None:
+                # Reallocation works by stop-and-restart: without a
+                # checkpoint to restore from, the restart would silently
+                # rerun the trial from scratch.  Defer until one exists
+                # (the next interval hit re-evaluates).
+                if getattr(trial, "checkpoint", None) is None:
+                    return CONTINUE
                 trial.new_resources = new
                 return STOP  # controller restarts under the new resources
         return CONTINUE

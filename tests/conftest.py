@@ -41,6 +41,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "examples: executes the committed examples/ scripts "
         "as subprocesses (select with -m examples)")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection scenarios "
+        "(tests/test_fault_tolerance.py); fast cases run in tier-1, "
+        "long soaks also carry `slow`")
 
 
 @pytest.fixture
