@@ -121,6 +121,9 @@ _D("memory_monitor_interval_s", 1.0, float, "memory check period")
 # -- serve -----------------------------------------------------------------
 _D("serve_controller_threads", 64, int,
    "controller thread pool (long-polls + control loop)")
+_D("serve_backpressure_timeout_s", 60.0, float,
+   "how long a handle waits for a replica under its "
+   "max_concurrent_queries cap before raising TimeoutError")
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")

@@ -1,0 +1,13 @@
+"""ray_tpu.inference — TPU-native autoregressive generation engine.
+
+Paged KV cache (fixed-size blocks in a preallocated pool, per-sequence
+block tables — the vLLM memory model, PAPERS.md), single-query decode
+attention (Pallas kernel in ops/attention.py, masked-dense fallback),
+and a continuous-batching scheduler: one jitted decode step over a
+fixed-capacity lane array, sequences admitted into free lanes as others
+finish, so decode throughput scales with concurrency instead of
+resetting per batch.  serve/llm.py exposes it as an LLMDeployment.
+"""
+
+from ray_tpu.inference.kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from ray_tpu.inference.engine import InferenceEngine  # noqa: F401

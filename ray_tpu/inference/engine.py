@@ -1,0 +1,350 @@
+"""Continuous-batching generation engine over the paged KV cache.
+
+One jitted step advances a fixed-capacity LANE array: every live
+sequence owns a lane, new requests are admitted into lanes the moment
+their previous occupant finishes (mid-flight — no batch barrier), and
+padding lanes ride along masked.  Two compiled shapes total: the pure
+decode step (T=1, single-query paged attention — the Pallas kernel
+path) and the mixed step (T=prefill_chunk) used whenever any lane is
+still prefilling; in a mixed step decoding lanes keep advancing with
+one valid token, so prefill chunks interleave with decode instead of
+stalling the batch.  Throughput therefore scales with concurrent
+requests instead of resetting per batch — the property bench_decode.py
+measures.
+
+The engine is host-driven: block allocation, admission, sampling
+dispatch and stream fan-out are Python; the model math is one
+jax.jit'ed call per step with pools donated on TPU (in-place cache
+update).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.inference.kv_cache import PagedKVCache
+
+_DONE = object()
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    out: "queue.Queue" = field(default_factory=queue.Queue)
+    fed: int = 0            # prompt tokens written to the cache so far
+    produced: int = 0
+    last_token: int = 0
+    finish_reason: Optional[str] = None
+
+    @property
+    def prefilling(self) -> bool:
+        return self.fed < len(self.prompt)
+
+
+class GenerationHandle:
+    """Streaming view of one request: iterate to receive token ids as
+    the engine emits them (the serve stream-ticket path pulls these)."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        item = self._req.out.get()
+        if item is _DONE:
+            raise StopIteration
+        return item
+
+    def tokens(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finishes; returns all generated ids."""
+        out = []
+        while True:
+            item = self._req.out.get(timeout=timeout)
+            if item is _DONE:
+                return out
+            out.append(item)
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._req.finish_reason
+
+
+def _resolve_model(model):
+    if isinstance(model, str):
+        if model == "gpt":
+            from ray_tpu.models import gpt as mod
+        elif model == "llama":
+            from ray_tpu.models import llama as mod
+        else:
+            raise ValueError(f"unknown model family {model!r}")
+        return mod
+    return model  # a module implementing forward_cached/lm_head/CONFIGS
+
+
+class InferenceEngine:
+    """max_lanes concurrent sequences over one shared paged KV pool.
+
+    `auto_start=True` (default) runs the scheduler on a daemon thread —
+    submit() returns a streaming GenerationHandle immediately.  With
+    auto_start=False the caller drives `step()` (deterministic tests,
+    microbenchmarks).
+    """
+
+    def __init__(self, model="gpt", config="nano", params=None, *,
+                 max_lanes: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: int = 32, seed: int = 0,
+                 auto_start: bool = True):
+        self.model = _resolve_model(model)
+        self.config = (self.model.CONFIGS[config] if isinstance(config, str)
+                       else config)
+        if params is None:
+            params = self.model.init_params(self.config,
+                                            jax.random.key(seed))
+        self.params = params
+        self.max_lanes = max_lanes
+        self.prefill_chunk = prefill_chunk
+        max_seq_len = min(max_seq_len or self.config.max_seq_len,
+                          self.config.max_seq_len)
+        if num_blocks is None:
+            num_blocks = max_lanes * -(-max_seq_len // block_size)
+        self.cache = PagedKVCache.for_model(
+            self.model, self.config, num_blocks=num_blocks,
+            block_size=block_size, max_lanes=max_lanes,
+            max_seq_len=max_seq_len)
+        self._lanes: List[Optional[_Request]] = [None] * max_lanes
+        self._waiting: "collections.deque[_Request]" = collections.deque()
+        self._rid = itertools.count(1)
+        self._rng = np.random.default_rng(seed)
+        self._step_fns = {}
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._auto = auto_start
+
+    # ---------------- public API ----------------
+
+    def submit(self, prompt, max_new_tokens: int = 16, *,
+               temperature: float = 0.0,
+               eos_id: Optional[int] = None) -> GenerationHandle:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.cache.max_seq_len:
+            raise ValueError("prompt longer than max_seq_len")
+        req = _Request(rid=next(self._rid), prompt=prompt,
+                       max_new_tokens=max_new_tokens,
+                       temperature=temperature, eos_id=eos_id)
+        with self._work:
+            if self._stopped:
+                raise RuntimeError("engine is shut down")
+            self._waiting.append(req)
+            self._work.notify()
+        if self._auto:
+            self._ensure_thread()
+        return GenerationHandle(req)
+
+    def generate(self, prompt, max_new_tokens: int = 16, *,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None) -> List[int]:
+        """Blocking convenience wrapper: submit + drain."""
+        h = self.submit(prompt, max_new_tokens, temperature=temperature,
+                        eos_id=eos_id)
+        if not self._auto:
+            while self.step():
+                pass
+        return h.tokens()
+
+    def shutdown(self) -> None:
+        with self._work:
+            self._stopped = True
+            for req in list(self._waiting):
+                req.out.put(_DONE)
+            self._waiting.clear()
+            for lane, req in enumerate(self._lanes):
+                if req is not None:
+                    req.out.put(_DONE)
+                    self.cache.free_lane(lane)
+                    self._lanes[lane] = None
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._lanes)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    # ---------------- scheduler ----------------
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="inference-engine")
+            self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._work:
+                while (not self._stopped and not self._waiting
+                       and all(r is None for r in self._lanes)):
+                    self._work.wait()
+                if self._stopped:
+                    return
+            self.step()
+
+    def _admit(self):
+        """Fill free lanes from the FIFO queue — admission control is
+        block-level: a request enters only when its whole prompt fits
+        the pool (plus one block of decode headroom)."""
+        for lane in range(self.max_lanes):
+            if self._lanes[lane] is not None or not self._waiting:
+                continue
+            req = self._waiting[0]
+            need = self.cache.blocks_needed(len(req.prompt)) + 1
+            if not self.cache.allocator.can_alloc(need):
+                break  # FIFO: don't starve the head with later requests
+            self._waiting.popleft()
+            self.cache.alloc_lane(lane, len(req.prompt))
+            self._lanes[lane] = req
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, then one jitted model step
+        advancing every live lane.  Returns False when fully idle."""
+        with self._lock:
+            self._admit()
+            live = [(i, r) for i, r in enumerate(self._lanes)
+                    if r is not None]
+            if not live:
+                return False
+            t = (self.prefill_chunk
+                 if any(r.prefilling for _, r in live) else 1)
+            batch, chunks = self._build_batch(live, t)
+        next_tok, logits = self._run_step(t, *batch)
+        with self._work:
+            self._commit(live, chunks, np.asarray(next_tok), logits)
+            self._work.notify()
+        return True
+
+    def _build_batch(self, live, t):
+        """Host-side assembly of the fixed-shape lane arrays."""
+        n = self.max_lanes
+        tokens = np.zeros((n, t), np.int32)
+        positions = np.zeros((n, t), np.int32)
+        valid = np.zeros((n, t), bool)
+        ctx_lens = np.ones((n,), np.int32)
+        gather = np.zeros((n,), np.int32)
+        chunks = {}
+        for lane, req in live:
+            start = int(self.cache.seq_lens[lane])
+            if req.prefilling:
+                chunk = min(t, len(req.prompt) - req.fed)
+                tokens[lane, :chunk] = req.prompt[req.fed:req.fed + chunk]
+            else:
+                chunk = 1
+                tokens[lane, 0] = req.last_token
+            positions[lane] = start + np.arange(t)
+            valid[lane, :chunk] = True
+            ctx_lens[lane] = start + chunk
+            gather[lane] = chunk - 1
+            chunks[lane] = chunk
+            # Table entries must exist before the step writes K/V.
+            self.cache.ensure_capacity(lane, start + chunk)
+        return (jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(valid), self.cache.device_tables(),
+                jnp.asarray(ctx_lens), jnp.asarray(gather)), chunks
+
+    def _run_step(self, t, tokens, positions, valid, tables, ctx_lens,
+                  gather):
+        fn = self._step_fns.get(t)
+        if fn is None:
+            fn = self._step_fns[t] = self._make_step_fn()
+        next_tok, logits, k, v = fn(self.params, self.cache.k, self.cache.v,
+                                    tokens, positions, valid, tables,
+                                    ctx_lens, gather)
+        self.cache.update_pools(k, v)
+        return next_tok, logits
+
+    def _make_step_fn(self):
+        model, config = self.model, self.config
+
+        def step(params, k, v, tokens, positions, valid, tables, ctx_lens,
+                 gather):
+            x, k, v = model.forward_cached(
+                params, tokens, positions, valid, k, v, tables, ctx_lens,
+                config)
+            # Only each lane's last valid position reaches the lm head —
+            # a prefill chunk never materializes [B, T, V].
+            xg = jnp.take_along_axis(
+                x, gather[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+            logits = model.lm_head(params, xg, config)       # [B, V]
+            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return next_tok, logits, k, v
+
+        # Donating the pools makes the cache update in-place on TPU; CPU
+        # ignores donation with a warning, so only ask for it on TPU.
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _commit(self, live, chunks, next_tok, logits):
+        """Apply one step's results: advance prefill cursors, sample,
+        stream tokens, finish + free lanes."""
+        logits_np = None
+        for lane, req in live:
+            if self._lanes[lane] is not req:
+                continue  # shutdown() cleared the lane mid-step
+            if req.prefilling:
+                req.fed += chunks[lane]
+                self.cache.seq_lens[lane] += chunks[lane]
+                if req.prefilling:
+                    continue  # more prompt to go; nothing sampled yet
+            else:
+                self.cache.seq_lens[lane] += 1
+            if req.temperature > 0:
+                if logits_np is None:
+                    logits_np = np.asarray(logits, np.float32)
+                tok = self._sample(logits_np[lane], req.temperature)
+            else:
+                tok = int(next_tok[lane])
+            req.last_token = tok
+            req.produced += 1
+            req.out.put(tok)
+            if req.eos_id is not None and tok == req.eos_id:
+                req.finish_reason = "eos"
+            elif req.produced >= req.max_new_tokens:
+                req.finish_reason = "length"
+            elif int(self.cache.seq_lens[lane]) >= self.cache.max_seq_len:
+                req.finish_reason = "max_seq_len"
+            if req.finish_reason is not None:
+                req.out.put(_DONE)
+                self.cache.free_lane(lane)
+                self._lanes[lane] = None
+
+    def _sample(self, row: np.ndarray, temperature: float) -> int:
+        z = row / max(temperature, 1e-6)
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))

@@ -1,0 +1,154 @@
+"""Paged KV cache: fixed-size blocks in a preallocated device pool.
+
+The pool is [n_layers, num_blocks, block_size, kv_heads, head_dim] per
+K and V (one allocation for the engine's lifetime — no per-request HBM
+churn).  Each live sequence owns an ordered list of block ids; the
+per-lane block tables map logical context positions onto pool blocks so
+sequences of wildly different lengths pack the same pool with at most
+block_size - 1 wasted slots each (the vLLM memory model).  Allocation
+and free are host-side free-list operations; the device arrays are
+functional — the jitted step returns updated pools and the cache rebinds
+them (donated on TPU, so the update is in place).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockAllocator:
+    """Free-list over pool block ids.  No implicit growth: exhaustion
+    raises, and the scheduler's admission control is built on can_alloc
+    — a sequence is only admitted when its prompt fits."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError("need at least one block")
+        self.num_blocks = num_blocks
+        # LIFO: recently-freed blocks are re-used first (their pool slots
+        # are warm in HBM caches on real hardware).
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._allocated = [False] * num_blocks
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: want {n} blocks, {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._allocated[b] = True
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not self._allocated[b]:
+                raise ValueError(f"double free of block {b}")
+            self._allocated[b] = False
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """Device pools + per-lane block tables for a fixed lane capacity.
+
+    Host state (numpy block tables, sequence lengths, the allocator) is
+    mirrored to device lazily: `device_tables()` re-uploads only after a
+    host-side mutation, so steady-state decode ships two tiny arrays per
+    step at most.
+    """
+
+    def __init__(self, n_layers: int, kv_heads: int, head_dim: int, *,
+                 num_blocks: int, block_size: int, max_lanes: int,
+                 max_seq_len: int, dtype=jnp.float32):
+        self.block_size = block_size
+        self.max_lanes = max_lanes
+        self.max_seq_len = max_seq_len
+        self.max_blocks_per_seq = math.ceil(max_seq_len / block_size)
+        shape = (n_layers, num_blocks, block_size, kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.allocator = BlockAllocator(num_blocks)
+        # Unused table entries stay 0 — always a valid pool index; the
+        # attention mask (positions >= ctx_len) hides whatever lives there.
+        self.block_tables = np.zeros((max_lanes, self.max_blocks_per_seq),
+                                     np.int32)
+        self.seq_lens = np.zeros((max_lanes,), np.int32)
+        self._lane_blocks: List[List[int]] = [[] for _ in range(max_lanes)]
+        self._dev_tables: Optional[jax.Array] = None
+
+    @classmethod
+    def for_model(cls, model, config, **kw) -> "PagedKVCache":
+        """Build a cache shaped for a models/ module (gpt or llama)."""
+        kv_heads = getattr(config, "n_kv_heads", config.n_heads)
+        kw.setdefault("max_seq_len", config.max_seq_len)
+        kw.setdefault("dtype", config.dtype)
+        return cls(config.n_layers, kv_heads, config.head_dim, **kw)
+
+    # ---------------- host-side lane lifecycle ----------------
+
+    def blocks_needed(self, seq_len: int) -> int:
+        return math.ceil(max(seq_len, 1) / self.block_size)
+
+    def can_admit(self, prompt_len: int) -> bool:
+        return self.allocator.can_alloc(self.blocks_needed(prompt_len))
+
+    def alloc_lane(self, lane: int, prompt_len: int) -> None:
+        """Sequence start: claim blocks covering the prompt."""
+        if self._lane_blocks[lane]:
+            raise ValueError(f"lane {lane} already allocated")
+        if prompt_len > self.max_seq_len:
+            raise ValueError(f"prompt of {prompt_len} exceeds max_seq_len "
+                             f"{self.max_seq_len}")
+        blocks = self.allocator.alloc(self.blocks_needed(prompt_len))
+        self._lane_blocks[lane] = blocks
+        self.block_tables[lane, :len(blocks)] = blocks
+        self.seq_lens[lane] = 0
+        self._dev_tables = None
+
+    def ensure_capacity(self, lane: int, new_len: int) -> None:
+        """Grow the lane's table as decode crosses block boundaries."""
+        if new_len > self.max_seq_len:
+            raise RuntimeError(f"lane {lane} exceeded max_seq_len")
+        need = self.blocks_needed(new_len)
+        blocks = self._lane_blocks[lane]
+        while len(blocks) < need:
+            (b,) = self.allocator.alloc(1)
+            self.block_tables[lane, len(blocks)] = b
+            blocks.append(b)
+            self._dev_tables = None
+
+    def free_lane(self, lane: int) -> None:
+        """Sequence finish: return every block to the pool."""
+        blocks = self._lane_blocks[lane]
+        if blocks:
+            self.allocator.free(blocks)
+        self._lane_blocks[lane] = []
+        self.block_tables[lane, :] = 0
+        self.seq_lens[lane] = 0
+        self._dev_tables = None
+
+    def lane_blocks(self, lane: int) -> List[int]:
+        return list(self._lane_blocks[lane])
+
+    # ---------------- device mirrors ----------------
+
+    def device_tables(self) -> jax.Array:
+        if self._dev_tables is None:
+            self._dev_tables = jnp.asarray(self.block_tables)
+        return self._dev_tables
+
+    def update_pools(self, k: jax.Array, v: jax.Array) -> None:
+        """Rebind the functional pools returned by a jitted step."""
+        self.k = k
+        self.v = v

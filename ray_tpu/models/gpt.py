@@ -240,26 +240,39 @@ def _block(x, p, config: GPTConfig, mesh):
 
 
 def forward(params: dict, tokens: jax.Array, config: GPTConfig,
-            mesh=None) -> tuple[jax.Array, jax.Array]:
+            mesh=None, position_offset: int = 0) -> tuple[jax.Array,
+                                                          jax.Array]:
     """tokens [B, L] int32 -> (logits [B, L, V], moe_aux_loss scalar)."""
     c = config
-    x, aux = forward_trunk(params, tokens, c, mesh)
-    head = (params["tok_embed"].T if c.tie_embeddings
-            else params["lm_head"]).astype(c.dtype)
-    logits = jnp.einsum("bld,dv->blv", x, head)
+    x, aux = forward_trunk(params, tokens, c, mesh, position_offset)
+    logits = lm_head(params, x, c)
     logits = with_logical_constraint(logits, ("batch", "length", "vocab"),
                                      mesh=mesh)
     return logits, aux
 
 
+def lm_head(params: dict, x: jax.Array, config: GPTConfig) -> jax.Array:
+    """Project hidden states [..., D] to vocab logits [..., V]."""
+    head = (params["tok_embed"].T if config.tie_embeddings
+            else params["lm_head"]).astype(config.dtype)
+    return x @ head
+
+
 def forward_trunk(params: dict, tokens: jax.Array, config: GPTConfig,
-                  mesh=None) -> tuple[jax.Array, jax.Array]:
+                  mesh=None, position_offset: int = 0) -> tuple[jax.Array,
+                                                                jax.Array]:
     """Transformer stack up to (excluding) the lm head.
-    tokens [B, L] -> (x [B, L, D], moe_aux_loss)."""
+    tokens [B, L] -> (x [B, L, D], moe_aux_loss).
+
+    position_offset shifts the learned position table: a suffix call at
+    absolute position p must read pos_embed[p:p+l], not pos_embed[:l]
+    (the cached decode path depends on this)."""
     c = config
     b, l = tokens.shape
     x = params["tok_embed"][tokens].astype(c.dtype)
-    x = x + params["pos_embed"][:l][None].astype(c.dtype)
+    pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"],
+                                       position_offset, l)
+    x = x + pos[None].astype(c.dtype)
     x = with_logical_constraint(x, ("batch", "length", "act_embed"), mesh=mesh)
 
     block = partial(_block, config=c, mesh=mesh)
@@ -275,6 +288,69 @@ def forward_trunk(params: dict, tokens: jax.Array, config: GPTConfig,
                             unroll=min(c.scan_unroll, c.n_layers))
     x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
     return x, jnp.sum(auxes)
+
+
+def _block_cached(x, p, k_pool, v_pool, config: GPTConfig, block_tables,
+                  positions, valid, ctx_lens):
+    """One transformer block over a paged KV cache: new K/V are scattered
+    into this layer's pool slice, then attention runs over the block
+    table (ops/attention.py paged path).  x [B, T, D]; positions [B, T]
+    absolute; ctx_lens [B] = context length including this slice."""
+    from ray_tpu.ops.attention import paged_attention, paged_kv_update
+
+    h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
+    q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(h.dtype))
+    k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(h.dtype))
+    v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(h.dtype))
+    k_pool, v_pool = paged_kv_update(k_pool, v_pool, k, v, block_tables,
+                                     positions, valid)
+    attn = paged_attention(q, k_pool, v_pool, block_tables, ctx_lens,
+                           positions)
+    x = x + jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(h.dtype))
+
+    h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
+    hidden = jax.nn.gelu(
+        jnp.einsum("bld,df->blf", h, p["w_up"].astype(h.dtype)))
+    x = x + jnp.einsum("blf,fd->bld", hidden, p["w_down"].astype(h.dtype))
+    return x, k_pool, v_pool
+
+
+def forward_cached(params: dict, tokens: jax.Array, positions: jax.Array,
+                   valid: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                   block_tables: jax.Array, ctx_lens: jax.Array,
+                   config: GPTConfig):
+    """Cached (incremental) trunk for autoregressive decode/prefill.
+
+    tokens [B, T] is a SLICE of each lane's sequence at absolute
+    `positions` [B, T] (per-lane offsets — lanes decode at different
+    depths); K/V for the slice are written into the paged pools
+    [n_layers, NB, BS, H, D] and attention covers each lane's whole
+    block table.  `valid` masks padding lanes/overhang (their cache
+    writes are dropped).  Returns (x [B, T, D], k_pool, v_pool) — the
+    lm head is applied by the caller on the positions it needs, so a
+    prefill chunk never materializes [B, T, V].
+
+    Dense-MLP configs only (n_experts == 0): MoE decode would need
+    per-token expert dispatch, which the serving engine doesn't support.
+    """
+    c = config
+    if c.n_experts:
+        raise NotImplementedError("cached decode supports dense MLP only")
+    pos = jnp.clip(positions, 0, c.max_seq_len - 1)
+    x = params["tok_embed"][tokens].astype(c.dtype)
+    x = x + params["pos_embed"][pos].astype(c.dtype)
+
+    def body(x, layer):
+        p, k_l, v_l = layer
+        x, k_l, v_l = _block_cached(x, p, k_l, v_l, c, block_tables,
+                                    positions, valid, ctx_lens)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], k_pool, v_pool),
+        unroll=min(c.scan_unroll, c.n_layers))
+    x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    return x, k_pool, v_pool
 
 
 def loss_fn(params: dict, batch: dict, config: GPTConfig, mesh=None):

@@ -132,16 +132,23 @@ def _rmsnorm(x, scale, eps):
     return (y * scale).astype(x.dtype)
 
 
-def _rope(x, theta: float, offset: int = 0):
+def _rope(x, theta: float, offset=0):
     """Rotary position embedding over [B, L, H, K] (rotate-half pairing:
-    the head dim splits into two halves treated as (real, imag))."""
+    the head dim splits into two halves treated as (real, imag)).
+
+    `offset` is the absolute position of x's first token: a scalar shared
+    by the batch, or a per-lane [B] array (cached decode — lanes sit at
+    different depths)."""
     b, l, h, k = x.shape
     half = k // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = jnp.arange(offset, offset + l, dtype=jnp.float32)
-    ang = pos[:, None] * freqs[None, :]                   # [L, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    off = jnp.asarray(offset, jnp.float32)
+    pos = off[..., None] + jnp.arange(l, dtype=jnp.float32)  # [L] or [B, L]
+    ang = pos[..., None] * freqs                      # [L, half] / [B, L, half]
+    if ang.ndim == 2:
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin,
@@ -149,14 +156,14 @@ def _rope(x, theta: float, offset: int = 0):
     return out.astype(x.dtype)
 
 
-def _block(x, p, config: LlamaConfig, mesh):
+def _block(x, p, config: LlamaConfig, mesh, position_offset=0):
     c = config
     h = _rmsnorm(x, p["attn_norm"], c.norm_eps)
     q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(h.dtype))
     k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(h.dtype))
     v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(h.dtype))
-    q = _rope(q, c.rope_theta)
-    k = _rope(k, c.rope_theta)
+    q = _rope(q, c.rope_theta, position_offset)
+    k = _rope(k, c.rope_theta, position_offset)
     if c.q_per_kv > 1:
         # GQA: each kv head serves q_per_kv query heads.  Materializing
         # the repeat keeps the attention kernels head-uniform; XLA fuses
@@ -183,13 +190,18 @@ def _block(x, p, config: LlamaConfig, mesh):
 
 
 def forward_trunk(params: dict, tokens: jax.Array, config: LlamaConfig,
-                  mesh=None) -> jax.Array:
-    """tokens [B, L] -> hidden states [B, L, D] (pre-head, normed)."""
+                  mesh=None, position_offset=0) -> jax.Array:
+    """tokens [B, L] -> hidden states [B, L, D] (pre-head, normed).
+
+    position_offset rotates RoPE as if tokens started at that absolute
+    position (scalar or per-lane [B]) — single-token decode steps depend
+    on this; without it every suffix call re-rotates from position 0."""
     c = config
     x = params["tok_embed"][tokens].astype(c.dtype)
     x = with_logical_constraint(x, ("batch", "length", "act_embed"),
                                 mesh=mesh)
-    block = partial(_block, config=c, mesh=mesh)
+    block = partial(_block, config=c, mesh=mesh,
+                    position_offset=position_offset)
     if c.remat:
         block = jax.checkpoint(
             block, policy=jax.checkpoint_policies.nothing_saveable)
@@ -203,13 +215,74 @@ def forward_trunk(params: dict, tokens: jax.Array, config: LlamaConfig,
 
 
 def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
-            mesh=None) -> jax.Array:
+            mesh=None, position_offset=0) -> jax.Array:
     """tokens [B, L] -> logits [B, L, V]."""
-    x = forward_trunk(params, tokens, config, mesh)
+    x = forward_trunk(params, tokens, config, mesh, position_offset)
     logits = jnp.einsum("bld,dv->blv", x,
                         params["lm_head"].astype(config.dtype))
     return with_logical_constraint(logits, ("batch", "length", "vocab"),
                                    mesh=mesh)
+
+
+def lm_head(params: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
+    """Project hidden states [..., D] to vocab logits [..., V]."""
+    return x @ params["lm_head"].astype(config.dtype)
+
+
+def _block_cached(x, p, k_pool, v_pool, config: LlamaConfig, block_tables,
+                  positions, valid, ctx_lens):
+    """One Llama block over a paged KV cache.  K/V are cached with
+    kv_heads (GQA un-repeated — the whole point of the grouped cache);
+    the paged attention path expands groups itself."""
+    from ray_tpu.ops.attention import paged_attention, paged_kv_update
+
+    c = config
+    h = _rmsnorm(x, p["attn_norm"], c.norm_eps)
+    q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(h.dtype))
+    k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(h.dtype))
+    v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(h.dtype))
+    # Per-token rotation at each token's own absolute position: offset =
+    # positions[:, 0] with L-consecutive slices means positions must be
+    # contiguous per lane, which prefill/decode slices always are.
+    q = _rope(q, c.rope_theta, positions[:, 0])
+    k = _rope(k, c.rope_theta, positions[:, 0])
+    k_pool, v_pool = paged_kv_update(k_pool, v_pool, k, v, block_tables,
+                                     positions, valid)
+    attn = paged_attention(q, k_pool, v_pool, block_tables, ctx_lens,
+                           positions)
+    x = x + jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(h.dtype))
+
+    h = _rmsnorm(x, p["mlp_norm"], c.norm_eps)
+    gate = jax.nn.silu(jnp.einsum("bld,df->blf", h,
+                                  p["w_gate"].astype(h.dtype)))
+    up = jnp.einsum("bld,df->blf", h, p["w_up"].astype(h.dtype))
+    x = x + jnp.einsum("blf,fd->bld", gate * up,
+                       p["w_down"].astype(h.dtype))
+    return x, k_pool, v_pool
+
+
+def forward_cached(params: dict, tokens: jax.Array, positions: jax.Array,
+                   valid: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                   block_tables: jax.Array, ctx_lens: jax.Array,
+                   config: LlamaConfig):
+    """Cached (incremental) trunk — same contract as gpt.forward_cached:
+    tokens [B, T] at per-lane absolute `positions`, paged pools
+    [n_layers, NB, BS, KH, D] (KH = n_kv_heads), returns
+    (x [B, T, D], k_pool, v_pool)."""
+    c = config
+    x = params["tok_embed"][tokens].astype(c.dtype)
+
+    def body(x, layer):
+        p, k_l, v_l = layer
+        x, k_l, v_l = _block_cached(x, p, k_l, v_l, c, block_tables,
+                                    positions, valid, ctx_lens)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], k_pool, v_pool),
+        unroll=min(c.scan_unroll, c.n_layers))
+    x = _rmsnorm(x, params["final_norm"], c.norm_eps)
+    return x, k_pool, v_pool
 
 
 def loss_fn(params: dict, batch: dict, config: LlamaConfig, mesh=None):

@@ -1,4 +1,6 @@
 """TPU-native ops: Pallas kernels and sharded attention primitives."""
 
-from ray_tpu.ops.attention import flash_attention, reference_attention  # noqa: F401
+from ray_tpu.ops.attention import (  # noqa: F401
+    flash_attention, paged_attention, paged_attention_reference,
+    paged_decode_attention, paged_kv_update, reference_attention)
 from ray_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -219,6 +219,198 @@ def _flash_forward_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     return out.reshape(b, h, q_len, d).transpose(0, 2, 1, 3), lse
 
 
+# ---------------------------------------------------------------------------
+# Paged-KV attention (decode path for the inference engine)
+# ---------------------------------------------------------------------------
+#
+# The KV cache lives in a preallocated block pool [num_blocks, block_size,
+# kv_heads, head_dim]; each sequence owns a row of a block table mapping its
+# logical context positions onto pool blocks (inference/kv_cache.py).  The
+# decode step asks: one query per lane attends over that lane's block table.
+# The Pallas kernel streams KV blocks from the pool via scalar-prefetched
+# block-table indices (positions past the context length are masked, so
+# unused table entries may point anywhere valid); the dense fallback gathers
+# the table into a contiguous context and masks — it covers CPU tests, odd
+# head dims, and the multi-token prefill path.
+
+
+def paged_kv_update(k_pool, v_pool, k_new, v_new, block_tables, positions,
+                    valid):
+    """Scatter new K/V for one layer into the paged pools.
+
+    k_pool/v_pool [NB, BS, KH, D]; k_new/v_new [B, T, KH, D];
+    block_tables [B, MB] int32; positions [B, T] absolute positions;
+    valid [B, T] bool — invalid slots (padding lanes, prompt overhang)
+    are dropped instead of written (out-of-range index + mode="drop").
+    """
+    nb, bs, kh, d = k_pool.shape
+    b, t = positions.shape
+    blk = positions // bs
+    blk = jnp.clip(blk, 0, block_tables.shape[1] - 1)
+    phys = jnp.take_along_axis(block_tables, blk, axis=1)        # [B, T]
+    flat = phys * bs + positions % bs                            # [B, T]
+    flat = jnp.where(valid, flat, nb * bs)                       # OOB => drop
+    flat = flat.reshape(-1)
+    k_pool = k_pool.reshape(nb * bs, kh, d).at[flat].set(
+        k_new.reshape(-1, kh, d), mode="drop").reshape(nb, bs, kh, d)
+    v_pool = v_pool.reshape(nb * bs, kh, d).at[flat].set(
+        v_new.reshape(-1, kh, d), mode="drop").reshape(nb, bs, kh, d)
+    return k_pool, v_pool
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
+                              q_positions, *, scale=None):
+    """Masked-dense paged attention (fallback + prefill path).
+
+    q [B, T, H, D] at absolute q_positions [B, T]; pools [NB, BS, KH, D]
+    (KH may divide H — GQA); ctx_lens [B] = tokens written per lane.
+    Each query attends to context positions <= its own (the query's K/V
+    must already be in the pool).  All-masked rows (inactive lanes) come
+    out as a uniform average, never NaN (finite NEG_INF).
+    """
+    b, t, h, d = q.shape
+    nb, bs, kh, _ = k_pool.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    max_ctx = block_tables.shape[1] * bs
+    k_ctx = k_pool[block_tables].reshape(b, max_ctx, kh, d)
+    v_ctx = v_pool[block_tables].reshape(b, max_ctx, kh, d)
+    if h != kh:
+        k_ctx = jnp.repeat(k_ctx, h // kh, axis=2)
+        v_ctx = jnp.repeat(v_ctx, h // kh, axis=2)
+    logits = jnp.einsum("bthd,bkhd->bhtk", q.astype(jnp.float32),
+                        k_ctx.astype(jnp.float32)) * scale
+    kpos = jnp.arange(max_ctx)
+    mask = ((kpos[None, None, None, :] <= q_positions[:, None, :, None])
+            & (kpos[None, None, None, :] < ctx_lens[:, None, None, None]))
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhtk,bkhd->bthd", probs, v_ctx.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, block_size: int,
+                         q_per_kv: int, scale: float, n_blocks: int):
+    """One (lane, kv_block) grid step of single-query paged attention.
+
+    Scalar-prefetched block tables route each grid step's K/V DMA to the
+    lane's physical block (see the in_specs index maps); this kernel only
+    sees q [H, D], k/v [BS, KH, D] already in VMEM.  Online softmax
+    state persists in scratch across the lane's kv sweep, exactly like
+    the flash kernel above; blocks at/past the context length are
+    skipped entirely (their DMA still lands, but compute is gated)."""
+    lane = pl.program_id(0)
+    blk = pl.program_id(1)
+    base = blk * block_size
+
+    @pl.when(blk == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(base < len_ref[lane])
+    def _compute():
+        h, d = q_ref.shape
+        kh = h // q_per_kv
+        q = q_ref[...].astype(jnp.float32) * scale           # [H, D]
+        k_blk = k_ref[...].astype(jnp.float32)               # [BS, KH, D]
+        v_blk = v_ref[...].astype(jnp.float32)
+        q3 = q.reshape(kh, q_per_kv, d)
+        # Batched over kv heads: [KH, QPK, D] x [BS, KH, D] -> [KH, QPK, BS]
+        s = jax.lax.dot_general(
+            q3, k_blk, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        s = s.reshape(h, block_size)
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < len_ref[lane], s, NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.reshape(kh, q_per_kv, block_size), v_blk,
+            (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)              # [KH, QPK, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv.reshape(h, d)
+
+    @pl.when(blk == n_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _use_paged_kernel(d):
+    return _HAS_PALLAS and d in (64, 128, 256)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, ctx_lens, *,
+                           scale: Optional[float] = None,
+                           use_kernel: Optional[bool] = None,
+                           interpret: Optional[bool] = None):
+    """Single-query paged attention: q [B, H, D] (one decode token per
+    lane) over each lane's block table.  Pallas kernel where the head dim
+    allows, masked-dense fallback elsewhere.  ctx_lens counts tokens
+    already written to the pool INCLUDING the current one."""
+    b, h, d = q.shape
+    if use_kernel is None:
+        use_kernel = (_use_paged_kernel(d)
+                      and jax.default_backend() == "tpu")
+    if not use_kernel:
+        out = paged_attention_reference(
+            q[:, None], k_pool, v_pool, block_tables, ctx_lens,
+            (ctx_lens - 1)[:, None], scale=scale)
+        return out[:, 0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb, bs, kh, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    kernel = functools.partial(
+        _paged_decode_kernel, block_size=bs, q_per_kv=h // kh,
+        scale=scale, n_blocks=mb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,      # block tables + context lengths
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda i, j, bt, ln: (i, 0, 0)),
+            pl.BlockSpec((None, bs, kh, d),
+                         lambda i, j, bt, ln: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((None, bs, kh, d),
+                         lambda i, j, bt, ln: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda i, j, bt, ln: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      q[:, None].reshape(b, h, d), k_pool, v_pool)
+    return out
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, ctx_lens, q_positions,
+                    *, scale: Optional[float] = None):
+    """Dispatch paged attention for a [B, T, H, D] query slice: the T=1
+    decode step rides the single-query kernel path, multi-token prefill
+    chunks ride the masked-dense path."""
+    if q.shape[1] == 1:
+        return paged_decode_attention(
+            q[:, 0], k_pool, v_pool, block_tables, ctx_lens,
+            scale=scale)[:, None]
+    return paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                     ctx_lens, q_positions, scale=scale)
+
+
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                      dq_acc, delta_ref, *, block_k: int, causal: bool,
                      scale: float, n_kv_blocks: int):

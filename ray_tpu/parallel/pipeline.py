@@ -20,7 +20,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ray_tpu.parallel.mesh import shard_map_compat
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -92,12 +93,12 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                             jnp.zeros_like(outputs))
         return jax.lax.psum(outputs, axis)
 
-    fn = shard_map(per_stage, mesh=mesh,
-                   in_specs=(jax.tree.map(lambda _: param_spec, stage_params,
-                                          is_leaf=lambda x: x is None),
-                             io_spec),
-                   out_specs=io_spec,
-                   check_vma=False)
+    fn = shard_map_compat(
+        per_stage, mesh,
+        in_specs=(jax.tree.map(lambda _: param_spec, stage_params,
+                               is_leaf=lambda x: x is None),
+                  io_spec),
+        out_specs=io_spec)
     return fn(stage_params, microbatches)
 
 

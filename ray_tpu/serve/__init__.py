@@ -20,4 +20,5 @@ from ray_tpu.serve.api import (  # noqa: F401
 )
 from ray_tpu.serve.asgi import ingress  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.llm import LLMDeployment  # noqa: F401
 from ray_tpu.serve._private import DeploymentHandle  # noqa: F401

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
@@ -608,7 +609,8 @@ class DeploymentHandle:
 
     def _call(self, method, args, kwargs):
         self._refresh()
-        deadline = time.monotonic() + 60
+        wait_s = GLOBAL_CONFIG.serve_backpressure_timeout_s
+        deadline = time.monotonic() + wait_s
         while True:
             pick = self._pick_replica()
             if pick is not None:
@@ -618,7 +620,7 @@ class DeploymentHandle:
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"no replica of {self._name!r} under its "
-                    f"max_concurrent_queries cap within 60s")
+                    f"max_concurrent_queries cap within {wait_s:g}s")
             time.sleep(0.01)  # every replica saturated: backpressure
 
     def stream(self, *args, **kwargs):
@@ -629,7 +631,8 @@ class DeploymentHandle:
         Replica-pinned: every chunk comes from the replica that started
         the stream."""
         self._refresh()
-        deadline = time.monotonic() + 60
+        wait_s = GLOBAL_CONFIG.serve_backpressure_timeout_s
+        deadline = time.monotonic() + wait_s
         while True:
             pick = self._pick_replica()
             if pick is not None:
@@ -637,7 +640,7 @@ class DeploymentHandle:
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"no replica of {self._name!r} under its "
-                    f"max_concurrent_queries cap within 60s")
+                    f"max_concurrent_queries cap within {wait_s:g}s")
             time.sleep(0.01)
         replica, key = pick
         try:

@@ -86,7 +86,20 @@ def batch(_fn=None, *, max_batch_size: int = 10,
         holder: dict = {"queue": None}
 
         @functools.wraps(fn)
-        def wrapped(*args):
+        def wrapped(*args, **kwargs):
+            if kwargs:
+                # The batch function receives ONE list argument; there is
+                # no sound way to batch per-call keyword arguments, and
+                # silently dropping them corrupts results.
+                raise TypeError(
+                    f"@serve.batch function {fn.__name__!r} called with "
+                    f"keyword arguments {sorted(kwargs)} — batched calls "
+                    f"accept a single positional item")
+            if len(args) not in (1, 2):
+                raise TypeError(
+                    f"@serve.batch function {fn.__name__!r} takes one "
+                    f"positional item (plus self for methods), got "
+                    f"{len(args)} positional arguments")
             if holder["queue"] is None:
                 holder["queue"] = _BatchQueue(fn, max_batch_size,
                                               batch_wait_timeout_s)
@@ -94,8 +107,13 @@ def batch(_fn=None, *, max_batch_size: int = 10,
             # Support both free functions fn(items) and methods
             # self.fn(items): the batched element is the LAST positional.
             item = args[-1]
-            if len(args) == 2:  # bound method: rebind fn with self once
-                queue._fn = fn.__get__(args[0], type(args[0]))
+            if len(args) == 2:
+                # Bound method: bind fn to self ONCE, under the queue lock
+                # — a concurrent _flush must never observe a half-swapped
+                # callable, and rebinding every call would race submit().
+                with queue._lock:
+                    if queue._fn is queue._orig_fn:
+                        queue._fn = fn.__get__(args[0], type(args[0]))
             return queue.submit(item)
 
         return wrapped

@@ -1,0 +1,69 @@
+"""LLM serving: serve-plane front end for the continuous-batching engine.
+
+One InferenceEngine per replica.  Every serve request — streaming or
+not — submits into the replica's shared lane array, so concurrent
+requests batch onto the same jitted decode step instead of running the
+model once per request; tokens flow back through the existing serve
+stream-ticket path (`handle.options("generate").stream(...)` pulls them
+incrementally, replica-pinned).
+"""
+
+from typing import List, Optional
+
+from ray_tpu.serve.api import deployment
+
+
+@deployment(name="llm", max_concurrent_queries=64)
+class LLMDeployment:
+    """Replica callable wrapping an InferenceEngine.
+
+    Usage::
+
+        app = serve.LLMDeployment.bind(model="gpt", config="nano",
+                                       max_lanes=8)
+        handle = serve.run(app)
+        for tok in handle.options("generate").stream([1, 2, 3],
+                                                     max_new_tokens=16):
+            ...                      # token ids, streamed as generated
+        handle.remote([1, 2, 3]).result()   # non-streaming: full list
+    """
+
+    def __init__(self, model="gpt", config="nano", params=None, *,
+                 max_lanes: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: int = 32, seed: int = 0):
+        from ray_tpu.inference import InferenceEngine  # jax: replica-only
+        self._engine = InferenceEngine(
+            model, config, params, max_lanes=max_lanes,
+            block_size=block_size, num_blocks=num_blocks,
+            max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
+            seed=seed)
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, eos_id: Optional[int] = None):
+        """Streaming entry point: a generator, so serve hands the caller
+        a stream ticket and each token is pulled as the engine emits it."""
+        handle = self._engine.submit(prompt, max_new_tokens,
+                                     temperature=temperature,
+                                     eos_id=eos_id)
+        for tok in handle:
+            yield int(tok)
+
+    def __call__(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None) -> List[int]:
+        """Non-streaming: block until the sequence finishes."""
+        return self._engine.generate(prompt, max_new_tokens,
+                                     temperature=temperature,
+                                     eos_id=eos_id)
+
+    def stats(self) -> dict:
+        """Engine occupancy — lanes in use, queue depth, free KV blocks."""
+        eng = self._engine
+        return {
+            "active": eng.num_active,
+            "waiting": eng.num_waiting,
+            "max_lanes": eng.max_lanes,
+            "free_blocks": eng.cache.allocator.num_free,
+        }

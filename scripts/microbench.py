@@ -169,6 +169,31 @@ def main():
           f"(median of {ROUNDS}x500, range "
           f"{min(rates):,.0f}-{max(rates):,.0f})")
 
+    # --- inference: continuous-batching decode step ------------------------
+    # Steady-state decode-step rate of the paged-KV engine (nano model so
+    # the number tracks scheduler + cache-update overhead, not matmul
+    # time).  One step advances EVERY live lane, so aggregate tokens/s =
+    # ops_s * lanes — the lane sweep shows how close a batched step stays
+    # to a single-lane step (the continuous-batching win).
+    from ray_tpu.inference import InferenceEngine
+
+    for lanes in (1, 8, 32):
+        eng = InferenceEngine("gpt", "nano", max_lanes=lanes, block_size=16,
+                              prefill_chunk=8, auto_start=False)
+
+        def decode_steps(n, eng=eng, lanes=lanes):
+            # n+1 tokens = prefill-step sample + exactly n decode steps,
+            # so every lane finishes inside the timed region (no drain
+            # tail polluting the rate).
+            for _ in range(lanes):
+                eng.submit(list(range(8)), max_new_tokens=n + 1)
+            eng.step()                    # prefill + first sampled token
+            for _ in range(n):
+                eng.step()
+
+        timeit(f"decode_step_lanes{lanes}", decode_steps, 64, results)
+        eng.shutdown()
+
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MICROBENCH.json")
     with open(out, "w") as f:
