@@ -294,6 +294,7 @@ class CoreWorker:
         s.register("CoreWorker", "RemoveBorrow", self._rpc_remove_borrow)
         s.register("CoreWorker", "AddLocation", self._rpc_add_location)
         s.register("CoreWorker", "StackTrace", self._rpc_stack_trace)
+        s.register("CoreWorker", "Metrics", self._rpc_metrics)
         s.register("CoreWorker", "Ping", self._rpc_ping)
         s.register("CoreWorker", "NativePort", self._rpc_native_port)
         s.register("CoreWorker", "NodeDead", self._rpc_node_dead)
@@ -385,6 +386,13 @@ class CoreWorker:
         scripts.py:1798)."""
         from ray_tpu._private.stack_dump import dump_threads
         return {"pid": os.getpid(), "threads": dump_threads()}
+
+    async def _rpc_metrics(self, req):
+        """This worker's util.metrics registry, pulled by hostd into the
+        node-level scrape — application metrics (serve replica engines,
+        user Counters/Gauges) live here, not in the daemon."""
+        from ray_tpu.util import metrics as mt
+        return {"pid": os.getpid(), "metrics": mt.collect()}
 
     # ---- execution services ----
 

@@ -1149,11 +1149,29 @@ class NodeDaemon:
                 logger.exception("spill sweep failed")
 
     async def get_metrics(self, req):
-        """Process-local metric snapshot (reference: per-node agent scrape
-        path, _private/metrics_agent.py)."""
+        """Node-level metric snapshot (reference: per-node agent scrape
+        path, _private/metrics_agent.py): the daemon's own registry plus
+        every live worker's, merged.  Application metrics live in worker
+        processes (e.g. serve replica inference engines export prefix
+        cache hit rates), so a hostd-only scrape would miss them.
+        Worker probes run concurrently and failures are skipped — a
+        wedged worker must not take down the node scrape."""
         from ray_tpu.util import metrics as mt
         _metrics()["store_used_bytes"].set(self.store.stats()["used"])
-        return {"metrics": mt.collect(), "node_id": self.node_id.hex()}
+        merged = mt.collect()
+        handles = [h for h in self.workers.values() if h.address]
+
+        async def probe(handle):
+            try:
+                reply = await self.pool.get(handle.address).call(
+                    "CoreWorker", "Metrics", {}, timeout=2)
+                return reply.get("metrics") or {}
+            except Exception:
+                return {}
+
+        for snap in await asyncio.gather(*[probe(h) for h in handles]):
+            mt.merge_snapshot(merged, snap)
+        return {"metrics": merged, "node_id": self.node_id.hex()}
 
     async def stack_traces(self, req):
         """Aggregate live thread stacks from this node's workers plus the

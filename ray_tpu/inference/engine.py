@@ -3,19 +3,31 @@
 One jitted step advances a fixed-capacity LANE array: every live
 sequence owns a lane, new requests are admitted into lanes the moment
 their previous occupant finishes (mid-flight — no batch barrier), and
-padding lanes ride along masked.  Two compiled shapes total: the pure
-decode step (T=1, single-query paged attention — the Pallas kernel
-path) and the mixed step (T=prefill_chunk) used whenever any lane is
-still prefilling; in a mixed step decoding lanes keep advancing with
-one valid token, so prefill chunks interleave with decode instead of
-stalling the batch.  Throughput therefore scales with concurrent
-requests instead of resetting per batch — the property bench_decode.py
-measures.
+padding lanes ride along masked.  Two compiled step shapes total — the
+pure decode step (T=1, single-query paged attention — the Pallas kernel
+path) and the prefill step (T=prefill_chunk) — and when both
+populations are live they dispatch SEPARATELY each scheduler
+iteration: decode lanes advance at T=1 cost instead of being charged a
+whole prefill chunk of FLOPs just because some other lane is still
+prefilling.
 
-The engine is host-driven: block allocation, admission, sampling
-dispatch and stream fan-out are Python; the model math is one
-jax.jit'ed call per step with pools donated on TPU (in-place cache
-update).
+Admission rides the prefix cache (kv_cache.py): the longest
+block-aligned cached prefix of a prompt is adopted by reference instead
+of re-prefilled, so shared system prompts / few-shot templates /
+multi-turn history cost their FLOPs once.  Newly-full blocks are sealed
+into the content-addressed index as the write cursor crosses them —
+mid-prefill included.
+
+Sampling is part of the jitted step: greedy is argmax, temperature
+sampling draws from a per-lane PRNG key folded from (request seed,
+tokens produced), so sampled output is reproducible per request seed
+regardless of batch composition, and the per-step device->host transfer
+is one int32 per lane — never the [B, V] logits.
+
+The engine is host-driven: block allocation, admission and stream
+fan-out are Python; the model math (sampling included) is one jax.jit'ed
+call per dispatched population with pools donated on TPU (in-place
+cache update).
 """
 
 from __future__ import annotations
@@ -24,16 +36,46 @@ import collections
 import itertools
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.inference.kv_cache import PagedKVCache
+from ray_tpu.util.metrics import Counter, Gauge
 
 _DONE = object()
+
+_MET = None
+
+
+def _metrics() -> dict:
+    global _MET
+    if _MET is None:
+        _MET = {
+            "hit_tokens": Counter(
+                "inference_prefix_hit_tokens",
+                "Prompt tokens served from the KV prefix cache"),
+            "miss_tokens": Counter(
+                "inference_prefix_miss_tokens",
+                "Prompt tokens prefilled from scratch"),
+            "hits": Counter(
+                "inference_prefix_hits",
+                "Admissions that reused at least one cached block"),
+            "misses": Counter(
+                "inference_prefix_misses",
+                "Admissions with no cached prefix"),
+            "evicted": Counter(
+                "inference_kv_blocks_evicted",
+                "Cached KV blocks reclaimed under pool pressure"),
+            "queue_depth": Gauge(
+                "inference_waiting_requests",
+                "Requests queued behind lane admission"),
+        }
+    return _MET
 
 
 @dataclass
@@ -43,10 +85,12 @@ class _Request:
     max_new_tokens: int
     temperature: float = 0.0
     eos_id: Optional[int] = None
+    seed: int = 0
     out: "queue.Queue" = field(default_factory=queue.Queue)
-    fed: int = 0            # prompt tokens written to the cache so far
+    fed: int = 0            # prompt tokens in the cache (prefilled OR reused)
     produced: int = 0
     last_token: int = 0
+    emitted: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
 
     @property
@@ -71,10 +115,28 @@ class GenerationHandle:
         return item
 
     def tokens(self, timeout: Optional[float] = None) -> List[int]:
-        """Block until the request finishes; returns all generated ids."""
-        out = []
+        """Block until the request finishes; returns all generated ids.
+
+        `timeout` is an OVERALL deadline for the whole generation, not a
+        per-token gap: if the request has not finished `timeout` seconds
+        from this call, TimeoutError is raised (never queue.Empty)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[int] = []
         while True:
-            item = self._req.out.get(timeout=timeout)
+            if deadline is None:
+                item = self._req.out.get()
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"generation did not finish within {timeout}s "
+                        f"({len(out)} token(s) received)")
+                try:
+                    item = self._req.out.get(timeout=remaining)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"generation did not finish within {timeout}s "
+                        f"({len(out)} token(s) received)") from None
             if item is _DONE:
                 return out
             out.append(item)
@@ -102,7 +164,9 @@ class InferenceEngine:
     `auto_start=True` (default) runs the scheduler on a daemon thread —
     submit() returns a streaming GenerationHandle immediately.  With
     auto_start=False the caller drives `step()` (deterministic tests,
-    microbenchmarks).
+    microbenchmarks).  `prefix_cache=False` disables content-addressed
+    block reuse (every prompt prefills from token zero — the cold
+    baseline bench_prefix.py measures against).
     """
 
     def __init__(self, model="gpt", config="nano", params=None, *,
@@ -110,7 +174,7 @@ class InferenceEngine:
                  num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: int = 32, seed: int = 0,
-                 auto_start: bool = True):
+                 prefix_cache: bool = True, auto_start: bool = True):
         self.model = _resolve_model(model)
         self.config = (self.model.CONFIGS[config] if isinstance(config, str)
                        else config)
@@ -120,6 +184,7 @@ class InferenceEngine:
         self.params = params
         self.max_lanes = max_lanes
         self.prefill_chunk = prefill_chunk
+        self.seed = seed
         max_seq_len = min(max_seq_len or self.config.max_seq_len,
                           self.config.max_seq_len)
         if num_blocks is None:
@@ -127,12 +192,13 @@ class InferenceEngine:
         self.cache = PagedKVCache.for_model(
             self.model, self.config, num_blocks=num_blocks,
             block_size=block_size, max_lanes=max_lanes,
-            max_seq_len=max_seq_len)
+            max_seq_len=max_seq_len, prefix_cache=prefix_cache)
         self._lanes: List[Optional[_Request]] = [None] * max_lanes
         self._waiting: "collections.deque[_Request]" = collections.deque()
         self._rid = itertools.count(1)
-        self._rng = np.random.default_rng(seed)
-        self._step_fns = {}
+        self._step_fns: Dict = {}
+        self._step_impls: Dict = {}   # un-jitted twins (shape introspection)
+        self._evictions_reported = 0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._thread: Optional[threading.Thread] = None
@@ -142,16 +208,27 @@ class InferenceEngine:
     # ---------------- public API ----------------
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
-               temperature: float = 0.0,
-               eos_id: Optional[int] = None) -> GenerationHandle:
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               seed: Optional[int] = None) -> GenerationHandle:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        vocab = self.config.vocab_size
+        for t in prompt:
+            if not 0 <= t < vocab:
+                raise ValueError(
+                    f"prompt token id {t} out of range for vocab_size "
+                    f"{vocab}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if len(prompt) > self.cache.max_seq_len:
             raise ValueError("prompt longer than max_seq_len")
-        req = _Request(rid=next(self._rid), prompt=prompt,
+        rid = next(self._rid)
+        req = _Request(rid=rid, prompt=prompt,
                        max_new_tokens=max_new_tokens,
-                       temperature=temperature, eos_id=eos_id)
+                       temperature=temperature, eos_id=eos_id,
+                       seed=seed if seed is not None else self.seed + rid)
         with self._work:
             if self._stopped:
                 raise RuntimeError("engine is shut down")
@@ -162,11 +239,11 @@ class InferenceEngine:
         return GenerationHandle(req)
 
     def generate(self, prompt, max_new_tokens: int = 16, *,
-                 temperature: float = 0.0,
-                 eos_id: Optional[int] = None) -> List[int]:
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: Optional[int] = None) -> List[int]:
         """Blocking convenience wrapper: submit + drain."""
         h = self.submit(prompt, max_new_tokens, temperature=temperature,
-                        eos_id=eos_id)
+                        eos_id=eos_id, seed=seed)
         if not self._auto:
             while self.step():
                 pass
@@ -195,6 +272,22 @@ class InferenceEngine:
     def num_waiting(self) -> int:
         return len(self._waiting)
 
+    def stats(self) -> dict:
+        """Engine occupancy + prefix-cache effectiveness counters."""
+        cs = self.cache.stats
+        return {
+            "active": self.num_active,
+            "waiting": self.num_waiting,
+            "max_lanes": self.max_lanes,
+            "free_blocks": self.cache.allocator.num_free,
+            "cached_blocks": self.cache.num_indexed_blocks,
+            "prefix_hits": cs["hits"],
+            "prefix_misses": cs["misses"],
+            "prefix_hit_tokens": cs["hit_tokens"],
+            "prefix_miss_tokens": cs["miss_tokens"],
+            "blocks_evicted": self.cache.allocator.evictions,
+        }
+
     # ---------------- scheduler ----------------
 
     def _ensure_thread(self):
@@ -215,48 +308,96 @@ class InferenceEngine:
                     return
             self.step()
 
+    def _final_len(self, req) -> int:
+        return min(len(req.prompt) + req.max_new_tokens,
+                   self.cache.max_seq_len)
+
+    def _growth_reserve(self) -> int:
+        """Blocks every LIVE lane may still claim before finishing (its
+        worst-case final length minus what it already owns).  Admission
+        must leave this much unclaimed or a decode step's block-boundary
+        growth can exhaust the pool mid-flight — with no preemption, the
+        only safe policy is never to admit past the worst case."""
+        reserve = 0
+        for lane, req in enumerate(self._lanes):
+            if req is None:
+                continue
+            reserve += (self.cache.blocks_needed(self._final_len(req))
+                        - len(self.cache.lane_blocks(lane)))
+        return reserve
+
     def _admit(self):
         """Fill free lanes from the FIFO queue — admission control is
-        block-level: a request enters only when its whole prompt fits
-        the pool (plus one block of decode headroom)."""
+        block-level: a request enters only when its worst-case final
+        length fits alongside every live lane's worst case, counting
+        cached prefix blocks as references, not allocations."""
+        met = _metrics()
         for lane in range(self.max_lanes):
             if self._lanes[lane] is not None or not self._waiting:
                 continue
             req = self._waiting[0]
-            need = self.cache.blocks_needed(len(req.prompt)) + 1
-            if not self.cache.allocator.can_alloc(need):
+            growth = (self.cache.blocks_needed(self._final_len(req))
+                      - self.cache.blocks_needed(len(req.prompt)))
+            if not self.cache.can_admit_prefix(
+                    req.prompt,
+                    headroom_blocks=self._growth_reserve() + growth):
                 break  # FIFO: don't starve the head with later requests
+            reused = self.cache.adopt_prefix(lane, req.prompt)
             self._waiting.popleft()
-            self.cache.alloc_lane(lane, len(req.prompt))
+            req.fed = reused
             self._lanes[lane] = req
+            met["hit_tokens"].inc(reused)
+            met["miss_tokens"].inc(len(req.prompt) - reused)
+            met["hits" if reused else "misses"].inc()
+        met["queue_depth"].set(len(self._waiting))
+        evictions = self.cache.allocator.evictions
+        if evictions > self._evictions_reported:
+            met["evicted"].inc(evictions - self._evictions_reported)
+            self._evictions_reported = evictions
 
     def step(self) -> bool:
-        """One scheduler iteration: admit, then one jitted model step
-        advancing every live lane.  Returns False when fully idle."""
+        """One scheduler iteration: admit, then advance every live lane.
+        Decode lanes and prefilling lanes dispatch as SEPARATE jitted
+        steps (T=1 and T=prefill_chunk) so neither population pays the
+        other's FLOP shape.  Returns False when fully idle."""
         with self._lock:
             self._admit()
             live = [(i, r) for i, r in enumerate(self._lanes)
                     if r is not None]
             if not live:
                 return False
-            t = (self.prefill_chunk
-                 if any(r.prefilling for _, r in live) else 1)
-            batch, chunks = self._build_batch(live, t)
-        next_tok, logits = self._run_step(t, *batch)
+            plans = []
+            decode = [(i, r) for i, r in live if not r.prefilling]
+            if decode:
+                plans.append((decode,) + self._build_batch(decode, 1))
+            prefill = [(i, r) for i, r in live if r.prefilling]
+            if prefill:
+                plans.append((prefill,)
+                             + self._build_batch(prefill, self.prefill_chunk))
+        done = []
+        for lanes, batch, chunks in plans:
+            next_tok = self._run_step(batch)
+            done.append((lanes, chunks, np.asarray(next_tok)))
         with self._work:
-            self._commit(live, chunks, np.asarray(next_tok), logits)
+            for lanes, chunks, toks in done:
+                self._commit(lanes, chunks, toks)
             self._work.notify()
         return True
 
     def _build_batch(self, live, t):
-        """Host-side assembly of the fixed-shape lane arrays."""
+        """Host-side assembly of the fixed-shape lane arrays for one
+        population (lanes not in `live` ride along fully masked)."""
         n = self.max_lanes
         tokens = np.zeros((n, t), np.int32)
         positions = np.zeros((n, t), np.int32)
         valid = np.zeros((n, t), bool)
         ctx_lens = np.ones((n,), np.int32)
         gather = np.zeros((n,), np.int32)
+        temps = np.zeros((n,), np.float32)
+        seeds = np.zeros((n,), np.uint32)
+        counters = np.zeros((n,), np.int32)
         chunks = {}
+        sample = False
         for lane, req in live:
             start = int(self.cache.seq_lens[lane])
             if req.prefilling:
@@ -269,66 +410,87 @@ class InferenceEngine:
             valid[lane, :chunk] = True
             ctx_lens[lane] = start + chunk
             gather[lane] = chunk - 1
+            temps[lane] = req.temperature
+            seeds[lane] = req.seed & 0xFFFFFFFF
+            counters[lane] = req.produced
+            sample = sample or req.temperature > 0
             chunks[lane] = chunk
             # Table entries must exist before the step writes K/V.
             self.cache.ensure_capacity(lane, start + chunk)
-        return (jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(valid), self.cache.device_tables(),
-                jnp.asarray(ctx_lens), jnp.asarray(gather)), chunks
+        batch = (t, sample,
+                 (jnp.asarray(tokens), jnp.asarray(positions),
+                  jnp.asarray(valid), self.cache.device_tables(),
+                  jnp.asarray(ctx_lens), jnp.asarray(gather),
+                  jnp.asarray(temps), jnp.asarray(seeds),
+                  jnp.asarray(counters)))
+        return batch, chunks
 
-    def _run_step(self, t, tokens, positions, valid, tables, ctx_lens,
-                  gather):
-        fn = self._step_fns.get(t)
+    def _run_step(self, batch):
+        t, sample, args = batch
+        key = (t, sample)
+        fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._step_fns[t] = self._make_step_fn()
-        next_tok, logits, k, v = fn(self.params, self.cache.k, self.cache.v,
-                                    tokens, positions, valid, tables,
-                                    ctx_lens, gather)
+            fn = self._step_fns[key] = self._make_step_fn(sample)
+        next_tok, k, v = fn(self.params, self.cache.k, self.cache.v, *args)
         self.cache.update_pools(k, v)
-        return next_tok, logits
+        return next_tok
 
-    def _make_step_fn(self):
+    def _make_step_fn(self, sample: bool):
         model, config = self.model, self.config
 
         def step(params, k, v, tokens, positions, valid, tables, ctx_lens,
-                 gather):
+                 gather, temps, seeds, counters):
             x, k, v = model.forward_cached(
                 params, tokens, positions, valid, k, v, tables, ctx_lens,
                 config)
             # Only each lane's last valid position reaches the lm head —
-            # a prefill chunk never materializes [B, T, V].
+            # a prefill chunk never materializes [B, T, V], and the
+            # logits never leave the device: sampling happens HERE and
+            # the step's only non-pool output is one token id per lane.
             xg = jnp.take_along_axis(
                 x, gather[:, None, None].astype(jnp.int32), axis=1)[:, 0]
             logits = model.lm_head(params, xg, config)       # [B, V]
-            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return next_tok, logits, k, v
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if not sample:
+                return greedy, k, v
 
+            def draw(row, temp, seed, counter):
+                # Key = f(request seed, tokens produced): reproducible
+                # per request regardless of lane index or who else is
+                # in the batch.
+                key = jax.random.fold_in(jax.random.key(seed), counter)
+                z = row.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+                return jax.random.categorical(key, z).astype(jnp.int32)
+
+            sampled = jax.vmap(draw)(logits, temps, seeds, counters)
+            next_tok = jnp.where(temps > 0, sampled, greedy)
+            return next_tok, k, v
+
+        self._step_impls[sample] = step
         # Donating the pools makes the cache update in-place on TPU; CPU
         # ignores donation with a warning, so only ask for it on TPU.
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def _commit(self, live, chunks, next_tok, logits):
-        """Apply one step's results: advance prefill cursors, sample,
-        stream tokens, finish + free lanes."""
-        logits_np = None
+    def _commit(self, live, chunks, next_tok):
+        """Apply one dispatch's results: advance prefill cursors, seal
+        newly-full blocks into the prefix index, stream sampled tokens,
+        finish + free lanes."""
         for lane, req in live:
             if self._lanes[lane] is not req:
                 continue  # shutdown() cleared the lane mid-step
             if req.prefilling:
                 req.fed += chunks[lane]
                 self.cache.seq_lens[lane] += chunks[lane]
+                self.cache.seal_full_blocks(lane, req.prompt)
                 if req.prefilling:
                     continue  # more prompt to go; nothing sampled yet
             else:
                 self.cache.seq_lens[lane] += 1
-            if req.temperature > 0:
-                if logits_np is None:
-                    logits_np = np.asarray(logits, np.float32)
-                tok = self._sample(logits_np[lane], req.temperature)
-            else:
-                tok = int(next_tok[lane])
+                self.cache.seal_full_blocks(lane, req.prompt + req.emitted)
+            tok = int(next_tok[lane])
             req.last_token = tok
+            req.emitted.append(tok)
             req.produced += 1
             req.out.put(tok)
             if req.eos_id is not None and tok == req.eos_id:
@@ -341,10 +503,3 @@ class InferenceEngine:
                 req.out.put(_DONE)
                 self.cache.free_lane(lane)
                 self._lanes[lane] = None
-
-    def _sample(self, row: np.ndarray, temperature: float) -> int:
-        z = row / max(temperature, 1e-6)
-        z = z - z.max()
-        p = np.exp(z)
-        p /= p.sum()
-        return int(self._rng.choice(len(p), p=p))

@@ -32,38 +32,37 @@ class LLMDeployment:
                  max_lanes: int = 8, block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
-                 prefill_chunk: int = 32, seed: int = 0):
+                 prefill_chunk: int = 32, seed: int = 0,
+                 prefix_cache: bool = True):
         from ray_tpu.inference import InferenceEngine  # jax: replica-only
         self._engine = InferenceEngine(
             model, config, params, max_lanes=max_lanes,
             block_size=block_size, num_blocks=num_blocks,
             max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
-            seed=seed)
+            seed=seed, prefix_cache=prefix_cache)
 
     def generate(self, prompt, max_new_tokens: int = 16,
-                 temperature: float = 0.0, eos_id: Optional[int] = None):
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: Optional[int] = None):
         """Streaming entry point: a generator, so serve hands the caller
         a stream ticket and each token is pulled as the engine emits it."""
         handle = self._engine.submit(prompt, max_new_tokens,
                                      temperature=temperature,
-                                     eos_id=eos_id)
+                                     eos_id=eos_id, seed=seed)
         for tok in handle:
             yield int(tok)
 
     def __call__(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0,
-                 eos_id: Optional[int] = None) -> List[int]:
+                 eos_id: Optional[int] = None,
+                 seed: Optional[int] = None) -> List[int]:
         """Non-streaming: block until the sequence finishes."""
         return self._engine.generate(prompt, max_new_tokens,
                                      temperature=temperature,
-                                     eos_id=eos_id)
+                                     eos_id=eos_id, seed=seed)
 
     def stats(self) -> dict:
-        """Engine occupancy — lanes in use, queue depth, free KV blocks."""
-        eng = self._engine
-        return {
-            "active": eng.num_active,
-            "waiting": eng.num_waiting,
-            "max_lanes": eng.max_lanes,
-            "free_blocks": eng.cache.allocator.num_free,
-        }
+        """Engine occupancy + prefix-cache counters (the same numbers the
+        engine exports through util.metrics, so `cli metrics` scrapes
+        them from the replica process)."""
+        return self._engine.stats()

@@ -108,6 +108,40 @@ def collect() -> Dict[str, dict]:
     return out
 
 
+def merge_snapshot(into: Dict[str, dict], other: Dict[str, dict]) -> None:
+    """Fold one collect() snapshot into another, in place.  Series with
+    identical tags combine by type: counters and gauges sum, histogram
+    summaries sum count/sum and extend min/max.  Used by hostd to merge
+    worker-process registries (e.g. serve replica engines) into the
+    node-level scrape."""
+    for name, m in other.items():
+        dst = into.get(name)
+        if dst is None:
+            into[name] = {
+                "type": m["type"],
+                "description": m["description"],
+                "tag_keys": list(m["tag_keys"]),
+                "series": [dict(s) for s in m["series"]],
+            }
+            continue
+        by_tags = {tuple(sorted(s["tags"].items())): s
+                   for s in dst["series"]}
+        for s in m["series"]:
+            key = tuple(sorted(s["tags"].items()))
+            cur = by_tags.get(key)
+            if cur is None:
+                dst["series"].append(dict(s))
+                continue
+            if isinstance(s["value"], dict):  # histogram summary
+                cv, sv = cur["value"], s["value"]
+                cv["count"] += sv["count"]
+                cv["sum"] += sv["sum"]
+                cv["min"] = min(cv["min"], sv["min"])
+                cv["max"] = max(cv["max"], sv["max"])
+            else:
+                cur["value"] += s["value"]
+
+
 def prometheus_text(snapshot: Optional[Dict[str, dict]] = None,
                     extra_tags: Optional[Dict[str, str]] = None) -> str:
     """Render a collect() snapshot in Prometheus exposition format."""

@@ -194,6 +194,44 @@ def main():
         timeit(f"decode_step_lanes{lanes}", decode_steps, 64, results)
         eng.shutdown()
 
+    # --- inference: prefix-cache admission (prefill hit vs miss) -----------
+    # Full request latency for a 112-token prompt whose first 96 tokens
+    # are sealed in the content-addressed block index (admission adopts
+    # them by reference; one chunk prefills) vs a never-seen prompt
+    # (every chunk prefills).  The hit/miss ratio is the FLOP savings
+    # prefix sharing buys on shared-system-prompt traffic — see
+    # bench_prefix.py for the TTFT view at serving scale.
+    import itertools
+    uid = itertools.count(1)
+
+    def _prefix_engine():
+        return InferenceEngine("gpt", "nano", max_lanes=2, block_size=16,
+                               num_blocks=64, prefill_chunk=32,
+                               auto_start=False)
+
+    eng = _prefix_engine()
+    vocab = eng.config.vocab_size
+    shared = [(3 * j + 1) % vocab for j in range(96)]
+    eng.generate(shared + [5] * 16, max_new_tokens=1)  # seal the prefix
+
+    def prefill_hit(n, eng=eng):
+        for _ in range(n):
+            tail = [(13 * next(uid) + j) % vocab for j in range(16)]
+            eng.generate(shared + tail, max_new_tokens=1)
+
+    timeit("prefill_hit", prefill_hit, 32, results)
+    eng.shutdown()
+
+    eng = _prefix_engine()
+
+    def prefill_miss(n, eng=eng):
+        for _ in range(n):
+            p = [(13 * next(uid) + j) % vocab for j in range(112)]
+            eng.generate(p, max_new_tokens=1)
+
+    timeit("prefill_miss", prefill_miss, 32, results)
+    eng.shutdown()
+
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MICROBENCH.json")
     with open(out, "w") as f:

@@ -1,7 +1,13 @@
-"""Inference engine tests: paged KV cache invariants, cached-decode vs
-full-forward logits equivalence (GPT + Llama/GQA), the paged attention
-kernel against its dense reference, continuous-batching lane admission,
-and end-to-end streaming generation through serve."""
+"""Inference engine tests: paged KV cache invariants, content-addressed
+prefix caching (seal/match/adopt/evict + token-exactness vs a cold
+engine), cached-decode vs full-forward logits equivalence (GPT +
+Llama/GQA), the paged attention kernel against its dense reference,
+continuous-batching lane admission and pool-exhaustion FIFO, in-step
+sampling determinism, and end-to-end streaming generation through
+serve."""
+
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -71,6 +77,80 @@ def test_cache_admission_control():
     assert cache.can_admit(16)
     cache.alloc_lane(0, prompt_len=12)         # 3 of 4 blocks
     assert cache.can_admit(4) and not cache.can_admit(5)
+
+
+def test_allocator_refcount_and_lru_eviction():
+    evicted = []
+    a = BlockAllocator(3, on_evict=evicted.append)
+    b = a.alloc(2)
+    a.mark_cached(b[0])
+    a.mark_cached(b[1])
+    a.free(b)                       # cached blocks park evictable, not free
+    assert a.num_free == 3          # evictable still counts as capacity
+    assert a.is_evictable(b[0]) and a.is_evictable(b[1])
+    a.incref(b[1])                  # prefix reuse revives an evictable block
+    assert not a.is_evictable(b[1]) and a.refcount(b[1]) == 1
+    # Allocating past the plain-free supply evicts LRU-first (b[0]) and
+    # fires the index-drop hook; the live share of b[1] is untouchable.
+    got = a.alloc(2)
+    assert evicted == [b[0]]
+    assert a.evictions == 1
+    assert b[1] not in got
+    a.free([b[1]] + got)
+    assert a.num_free == 3
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache: seal / match / adopt / evict
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_seal_match_adopt():
+    cache = PagedKVCache(n_layers=1, kv_heads=1, head_dim=4, num_blocks=8,
+                         block_size=4, max_lanes=2, max_seq_len=32)
+    toks = list(range(1, 13))                    # 12 tokens = 3 full blocks
+    cache.alloc_lane(0, 12)
+    cache.seq_lens[0] = 12
+    cache.seal_full_blocks(0, toks)
+    assert cache.num_indexed_blocks == 3
+    # The match is capped so at least one prompt token always prefills
+    # (its logits seed the first sampled token).
+    assert len(cache.match_prefix(toks)) == 2
+    assert cache.match_prefix(toks + [99]) == cache.lane_blocks(0)[:3]
+    # A diverging block breaks the chain at the divergence point.
+    assert len(cache.match_prefix(toks[:4] + [77] + toks[5:] + [99])) == 1
+    # Adoption takes refcounted shares of blocks a LIVE lane still owns —
+    # mid-flight sharing, no copy.
+    reused = cache.adopt_prefix(1, toks + [99, 98])
+    assert reused == 12
+    shared = cache.lane_blocks(0)[:3]
+    assert cache.lane_blocks(1)[:3] == shared
+    assert all(cache.allocator.refcount(b) == 2 for b in shared)
+    cache.free_lane(0)
+    assert all(cache.allocator.refcount(b) == 1 for b in shared)
+    cache.free_lane(1)
+    # Finished sequences leave sealed blocks indexed at refcount 0: still
+    # counted free, still matchable.
+    assert cache.allocator.num_free == 8
+    assert cache.num_indexed_blocks == 3
+    assert len(cache.match_prefix(toks + [99])) == 3
+
+
+def test_prefix_cache_lru_eviction_under_pressure():
+    cache = PagedKVCache(n_layers=1, kv_heads=1, head_dim=4, num_blocks=4,
+                         block_size=4, max_lanes=2, max_seq_len=16)
+    toks = list(range(1, 9))                     # 8 tokens = 2 blocks
+    cache.alloc_lane(0, 8)
+    cache.seq_lens[0] = 8
+    cache.seal_full_blocks(0, toks)
+    cache.free_lane(0)
+    assert cache.num_indexed_blocks == 2
+    assert cache.allocator.num_free == 4
+    # A 16-token request wants the whole pool: plain-free blocks first,
+    # then the cached pair is reclaimed LRU and drops out of the index.
+    cache.alloc_lane(1, 16)
+    assert cache.allocator.evictions == 2
+    assert cache.num_indexed_blocks == 0
+    assert cache.match_prefix(toks + [9]) == []
 
 
 # ---------------------------------------------------------------------------
@@ -222,6 +302,145 @@ def test_engine_temperature_sampling_and_eos():
 
 
 # ---------------------------------------------------------------------------
+# Prefix reuse: token-exactness vs a cold engine
+# ---------------------------------------------------------------------------
+
+def test_prefix_reuse_token_exact_vs_cold():
+    warm = InferenceEngine("gpt", "nano", max_lanes=2, block_size=8,
+                           prefill_chunk=8, auto_start=False, seed=0)
+    cold = InferenceEngine("gpt", "nano", params=warm.params, max_lanes=2,
+                           block_size=8, prefill_chunk=8, auto_start=False,
+                           seed=0, prefix_cache=False)
+    prefix = list(range(1, 25))                  # 24 shared tokens
+    p1, p2 = prefix + [30, 31], prefix + [40, 41, 42]
+
+    a1 = warm.generate(p1, max_new_tokens=6)     # seals the prefix
+    assert warm.stats()["prefix_hits"] == 0
+    a2 = warm.generate(p2, max_new_tokens=6)     # admits via the cache
+    assert warm.stats()["prefix_hits"] == 1
+    assert warm.stats()["prefix_hit_tokens"] == 24
+    # Greedy output with prefix reuse is identical to full prefill.
+    assert cold.generate(p1, max_new_tokens=6) == a1
+    assert cold.generate(p2, max_new_tokens=6) == a2
+    # Seeded sampling too: the PRNG key depends only on (seed, produced).
+    s_warm = warm.generate(p2, max_new_tokens=6, temperature=0.9, seed=123)
+    s_cold = cold.generate(p2, max_new_tokens=6, temperature=0.9, seed=123)
+    assert warm.stats()["prefix_hits"] == 2
+    assert s_warm == s_cold
+
+
+def test_sampled_output_independent_of_batch_composition():
+    eng = InferenceEngine("gpt", "nano", max_lanes=4, block_size=8,
+                          prefill_chunk=8, auto_start=False, seed=0)
+    prompt = [2, 3, 4, 5, 6]
+    solo = eng.generate(prompt, max_new_tokens=6, temperature=0.8, seed=99)
+    # Same request inside a full, heterogeneous batch (different prompts,
+    # temperatures, greedy neighbours) must sample the same tokens.
+    h = eng.submit(prompt, max_new_tokens=6, temperature=0.8, seed=99)
+    eng.submit([9, 8, 7], max_new_tokens=6, temperature=1.3, seed=5)
+    eng.submit([1, 1, 2, 3], max_new_tokens=4)
+    eng.submit([4, 4], max_new_tokens=8, temperature=0.4, seed=99)
+    while eng.step():
+        pass
+    assert h.tokens() == solo
+
+
+# ---------------------------------------------------------------------------
+# Admission under pool exhaustion
+# ---------------------------------------------------------------------------
+
+def test_admission_fifo_head_not_starved_by_smaller_requests():
+    # Pool of 6 blocks x 4 tokens.  r1 fits; r2 (20 tokens = 5 blocks + 1
+    # headroom) cannot fit while r1 is live; r3 (1 block + headroom)
+    # COULD fit but must wait behind r2 — FIFO admission never starves
+    # the head.
+    eng = InferenceEngine("gpt", "nano", max_lanes=3, block_size=4,
+                          num_blocks=6, max_seq_len=24, prefill_chunk=4,
+                          auto_start=False, seed=0)
+    h1 = eng.submit(list(range(1, 9)), max_new_tokens=8)
+    h2 = eng.submit(list(range(1, 21)), max_new_tokens=2)
+    h3 = eng.submit([7, 7, 7, 7], max_new_tokens=2)
+    eng.step()
+    assert eng.num_active == 1 and eng.num_waiting == 2
+    order = []
+    while eng.step():
+        for h, name in ((h2, "r2"), (h3, "r3")):
+            if h.finish_reason and name not in order:
+                order.append(name)
+    # r2 entered (a lane freed mid-flight was reused) and finished before
+    # r3 was admitted.
+    assert order == ["r2", "r3"]
+    assert len(h1.tokens()) == 8
+    assert len(h2.tokens()) == 2
+    assert len(h3.tokens()) == 2
+    assert eng.cache.allocator.num_free == eng.cache.allocator.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# Satellites: submit validation, tokens() deadline, no [B, V] transfer
+# ---------------------------------------------------------------------------
+
+def test_submit_validates_inputs():
+    eng = InferenceEngine("gpt", "nano", max_lanes=1, auto_start=False)
+    vocab = eng.config.vocab_size
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([])
+    with pytest.raises(ValueError, match="out of range"):
+        eng.submit([1, vocab])
+    with pytest.raises(ValueError, match="out of range"):
+        eng.submit([-1])
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1], max_new_tokens=0)
+
+
+def test_tokens_timeout_is_overall_deadline():
+    from ray_tpu.inference.engine import GenerationHandle, _Request
+    req = _Request(rid=1, prompt=[1], max_new_tokens=100)
+    h = GenerationHandle(req)
+
+    def feeder():   # a token every 50ms — each gap alone beats 0.4s
+        for i in range(100):
+            time.sleep(0.05)
+            req.out.put(i)
+
+    threading.Thread(target=feeder, daemon=True).start()
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):   # and never queue.Empty
+        h.tokens(timeout=0.4)
+    # Per-token semantics would stream all 100 tokens (~5s) without
+    # raising; the overall deadline fires at ~0.4s.
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_sampled_step_keeps_logits_on_device():
+    eng = InferenceEngine("gpt", "nano", max_lanes=2, block_size=8,
+                          max_seq_len=32, prefill_chunk=8,
+                          auto_start=False, seed=0)
+    h = eng.submit([1, 2, 3, 4], max_new_tokens=3, temperature=0.7, seed=1)
+    while eng.step():
+        pass
+    assert len(h.tokens()) == 3
+    assert True in eng._step_impls      # the sampling step really ran
+    vocab = eng.config.vocab_size
+    b = eng.max_lanes
+    for t in (1, eng.prefill_chunk):
+        for impl in eng._step_impls.values():
+            out = jax.eval_shape(
+                impl, eng.params, eng.cache.k, eng.cache.v,
+                jnp.zeros((b, t), jnp.int32), jnp.zeros((b, t), jnp.int32),
+                jnp.zeros((b, t), bool), eng.cache.device_tables(),
+                jnp.ones((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.float32), jnp.zeros((b,), jnp.uint32),
+                jnp.zeros((b,), jnp.int32))
+            next_tok = jax.tree_util.tree_leaves(out)[0]
+            assert next_tok.shape == (b,)   # one int per lane comes home
+            # No step output carries a vocab-sized dim: sampling happened
+            # in-graph and the [B, V] logits never left the device.
+            for leaf in jax.tree_util.tree_leaves(out):
+                assert vocab not in leaf.shape
+
+
+# ---------------------------------------------------------------------------
 # Serve integration: streaming generation end-to-end
 # ---------------------------------------------------------------------------
 
@@ -250,4 +469,27 @@ def test_llm_deployment_streams_tokens(cluster):
     assert handle.remote(prompt, 6).result(timeout=60) == streamed
     stats = handle.stats.remote().result(timeout=60)
     assert stats["active"] == 0 and stats["max_lanes"] == 4
+    serve.delete("llm")
+
+
+def test_llm_replica_metrics_scraped_through_cli_path(cluster):
+    from ray_tpu import serve, state
+    handle = serve.run(serve.LLMDeployment.bind(
+        model="gpt", config="nano", max_lanes=2, block_size=8,
+        prefill_chunk=4))
+    prompt = list(range(1, 18))
+    first = handle.remote(prompt, 4).result(timeout=120)
+    second = handle.remote(prompt, 4).result(timeout=120)
+    assert first == second
+    # The engine lives in a serve replica (a worker process); its
+    # counters must reach the node-level scrape `cli metrics` renders —
+    # hostd pulls worker registries over the CoreWorker Metrics RPC and
+    # merges them into its own snapshot.
+    text = state.prometheus_metrics()
+    assert "inference_prefix_hit_tokens" in text
+    assert "inference_prefix_miss_tokens" in text
+    assert "inference_waiting_requests" in text
+    stats = handle.stats.remote().result(timeout=60)
+    assert stats["prefix_hits"] >= 1        # second request reused blocks
+    assert stats["prefix_hit_tokens"] >= 16
     serve.delete("llm")
