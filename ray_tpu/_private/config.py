@@ -164,6 +164,14 @@ _D("chaos_kill_worker_at", 0, int,
    "task-execution index at which a scripted worker kill fires")
 _D("chaos_kill_hostd", 0.0, float,
    "probability hostd kills itself at a heartbeat tick")
+_D("chaos_ckpt_kill", 0.0, float,
+   "probability the checkpoint writer kills its process right before the "
+   "COMMIT rename (data fully written, directory left torn)")
+_D("chaos_ckpt_kill_salts", "", str,
+   "scripted mid-save kills: csv of worker spawn ordinals whose "
+   "checkpoint writer dies (see fault_injection.kill_ckpt_commit)")
+_D("chaos_ckpt_kill_at", 0, int,
+   "save ordinal at which the scripted mid-save kill fires")
 
 
 GLOBAL_CONFIG = RayTpuConfig()

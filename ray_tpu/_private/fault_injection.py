@@ -173,6 +173,31 @@ class ChaosController:
         return self.should(
             "hostd", GLOBAL_CONFIG.chaos_kill_hostd, "kill")
 
+    def kill_ckpt_commit(self) -> bool:
+        """Kill this process mid-checkpoint-save: the async writer draws
+        this right before the COMMIT rename, when every shard file is on
+        disk but the directory is still torn — the worst instant for a
+        crash, and exactly what restore_latest() must survive.
+
+        Same two modes as kill_worker: scripted (`chaos_ckpt_kill_salts`
+        lists worker spawn ordinals; a listed worker dies at its
+        `chaos_ckpt_kill_at`-th save — deterministic AND convergent,
+        since the respawned worker carries a fresh ordinal) or
+        probabilistic (`chaos_ckpt_kill` per save).
+        """
+        cfg = GLOBAL_CONFIG
+        salts = str(cfg.chaos_ckpt_kill_salts or "")
+        if salts and self.salt:
+            listed = self.salt in [s.strip() for s in salts.split(",")]
+            with self._lock:
+                n = self._next_index("ckpt")
+                if listed and n == int(cfg.chaos_ckpt_kill_at):
+                    self._faults += 1
+                    self.schedule.append(("ckpt", n, "kill"))
+                    return True
+            return False
+        return self.should("ckpt", cfg.chaos_ckpt_kill, "kill")
+
 
 _chaos: Optional[ChaosController] = None
 _chaos_lock = threading.Lock()

@@ -3,7 +3,7 @@
 Reference parity: python/ray/air/ (SURVEY.md §2.3 "Ray AIR glue").
 """
 
-from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.checkpoint import Checkpoint, cleanup_tmp  # noqa: F401
 from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,

@@ -2,8 +2,13 @@
 
 Reference parity: python/ray/air/checkpoint.py:63 (Checkpoint with
 from_dict/to_dict/from_directory/to_directory/uri forms).  TPU idiom: the
-dict form holds host numpy pytrees (device arrays are fetched before
-checkpointing — orbax-style async device-to-host saving hooks in later).
+dict form holds host numpy pytrees; sharded directories written by
+`ray_tpu.checkpoint` (orbax-style async device-to-host saving) interop
+losslessly via `from_sharded_dir`/`to_pytree`.
+
+Temporary directories minted by `to_directory(path=None)` are tracked in
+a module registry: `Checkpoint.delete()` reclaims one checkpoint's
+disk, `cleanup_tmp()` sweeps everything this process created.
 """
 
 from __future__ import annotations
@@ -12,11 +17,18 @@ import os
 import pickle
 import shutil
 import tempfile
+import threading
 import uuid
 from typing import Any, Optional
 
 _DICT_FILE = "checkpoint.pkl"
 _FILES_KEY = "_checkpoint_files"   # dict key holding packed directory files
+
+# Every tmp dir handed out by to_directory(path=None), so tests and
+# long-lived drivers can reclaim them (they used to accumulate under
+# /tmp/ray_tpu_ckpt for the life of the machine).
+_TMP_REGISTRY: set = set()
+_TMP_LOCK = threading.Lock()
 
 
 class Checkpoint:
@@ -26,6 +38,7 @@ class Checkpoint:
             raise ValueError("exactly one of data/directory required")
         self._data = data
         self._dir = directory
+        self._tmp_dirs: list = []
 
     # -------- constructors --------
 
@@ -39,15 +52,55 @@ class Checkpoint:
             raise ValueError(f"not a directory: {path}")
         return cls(directory=path)
 
+    @classmethod
+    def from_sharded_dir(cls, path: str,
+                         validate: bool = True) -> "Checkpoint":
+        """Wrap a `ray_tpu.checkpoint` sharded directory.  With
+        `validate`, the directory must hold a manifest AND a COMMIT
+        marker (pass False only for handles to still-in-flight saves)."""
+        from ray_tpu.checkpoint import is_committed
+        from ray_tpu.checkpoint.manifest import has_manifest
+        if validate:
+            if not has_manifest(path):
+                raise ValueError(f"not a sharded checkpoint: {path}")
+            if not is_committed(path):
+                raise ValueError(
+                    f"sharded checkpoint {path} has no COMMIT marker "
+                    f"(torn or still being written)")
+        return cls(directory=path)
+
     # -------- accessors --------
+
+    @property
+    def is_sharded(self) -> bool:
+        """True for directory checkpoints in the sharded-manifest format."""
+        if self._dir is None:
+            return False
+        from ray_tpu.checkpoint.manifest import has_manifest
+        return has_manifest(self._dir)
+
+    def to_pytree(self, *, mesh=None, shardings=None) -> Any:
+        """Lossless interop with the sharded format: re-materialize the
+        saved pytree (numpy by default; pass `mesh`/`shardings` to
+        restore jax arrays under the CURRENT topology).  Dict-form
+        checkpoints return their dict unchanged."""
+        if self.is_sharded:
+            from ray_tpu.checkpoint import restore_sharded
+            return restore_sharded(self._dir, mesh=mesh, shardings=shardings)
+        return self.to_dict()
 
     def to_dict(self) -> dict:
         """Dict form.  A directory checkpoint made from arbitrary files
         (e.g. orbax output) round-trips: every file is packed under the
         reserved _FILES_KEY (reference: air/checkpoint.py dict<->dir packs
-        the full directory, _checkpoint.py _pack)."""
+        the full directory, _checkpoint.py _pack).  Sharded directories
+        restore through their manifest instead — host numpy pytree out,
+        not an opaque byte blob."""
         if self._data is not None:
             return dict(self._data)
+        if self.is_sharded:
+            tree = self.to_pytree()
+            return tree if isinstance(tree, dict) else {"state": tree}
         pkl = os.path.join(self._dir, _DICT_FILE)
         data: dict = {}
         if os.path.isfile(pkl):
@@ -71,6 +124,9 @@ class Checkpoint:
         if path is None:
             path = os.path.join(tempfile.gettempdir(), "ray_tpu_ckpt",
                                 uuid.uuid4().hex[:12])
+            with _TMP_LOCK:
+                _TMP_REGISTRY.add(path)
+            self._tmp_dirs.append(path)
         os.makedirs(path, exist_ok=True)
         if self._dir is not None:
             if os.path.abspath(self._dir) != os.path.abspath(path):
@@ -94,11 +150,40 @@ class Checkpoint:
                 os.replace(tmpf, dest)
         return path
 
+    def delete(self) -> None:
+        """Reclaim this checkpoint's disk: its backing directory (if
+        directory-form) and every tmp dir its to_directory(None) calls
+        minted."""
+        doomed = list(self._tmp_dirs)
+        if self._dir is not None:
+            doomed.append(self._dir)
+        for path in doomed:
+            shutil.rmtree(path, ignore_errors=True)
+            with _TMP_LOCK:
+                _TMP_REGISTRY.discard(path)
+        self._tmp_dirs.clear()
+
     def __repr__(self):
         kind = "dict" if self._data is not None else f"dir={self._dir}"
         return f"Checkpoint({kind})"
 
     def __reduce__(self):
-        # Ship as dict form so checkpoints survive crossing process
-        # boundaries even when the directory is node-local.
+        # Sharded checkpoints ship as their (shared-filesystem) path —
+        # packing shard files into a dict would both defeat the point
+        # and race an in-flight save.  Plain checkpoints ship as dict
+        # form so they survive crossing process boundaries even when
+        # the directory is node-local.
+        if self.is_sharded:
+            return (Checkpoint.from_sharded_dir, (self._dir, False))
         return (Checkpoint.from_dict, (self.to_dict(),))
+
+
+def cleanup_tmp() -> int:
+    """Remove every tmp checkpoint dir this process created via
+    to_directory(path=None); returns how many were swept."""
+    with _TMP_LOCK:
+        doomed = list(_TMP_REGISTRY)
+        _TMP_REGISTRY.clear()
+    for path in doomed:
+        shutil.rmtree(path, ignore_errors=True)
+    return len(doomed)

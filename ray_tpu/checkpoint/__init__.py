@@ -1,0 +1,38 @@
+"""ray_tpu.checkpoint — distributed sharded async checkpointing.
+
+Orbax-shaped, ray_tpu-native: each host writes only its addressable
+shards of a jax pytree (replicated params deduped to one writer), a JSON
+manifest records the global tree, and a crash-safe COMMIT marker makes
+torn directories impossible to restore from.  The async path overlaps
+serialization/I/O with training; `CheckpointManager` adds step-indexed
+layout, keep-last-K / keep-best retention, and GC.
+
+    from ray_tpu import checkpoint as ckpt
+
+    mgr = ckpt.CheckpointManager(root, keep_last_k=3)
+    handle = mgr.save(step, {"params": params, "opt_state": opt_state})
+    ...                                   # training continues immediately
+    mgr.wait_until_finished()             # explicit barrier when needed
+
+    state = mgr.restore_latest(mesh=mesh)  # elastic: ANY current mesh
+
+No reference counterpart — Ray delegates checkpointing to hosted
+frameworks; here (as with sharding) it is a core subsystem.
+"""
+
+from ray_tpu.checkpoint.async_writer import (  # noqa: F401
+    AsyncCheckpointer,
+    CheckpointWriteError,
+    SaveHandle,
+)
+from ray_tpu.checkpoint.manager import CheckpointManager  # noqa: F401
+from ray_tpu.checkpoint.manifest import (  # noqa: F401
+    COMMIT_FILE,
+    MANIFEST_FILE,
+)
+from ray_tpu.checkpoint.sharded import (  # noqa: F401
+    checkpoint_metadata,
+    is_committed,
+    restore_sharded,
+    save_sharded,
+)

@@ -1,0 +1,135 @@
+"""Async save path: device-to-host at the step boundary, I/O off-thread.
+
+`AsyncCheckpointer.save()` does the orbax-style split: the blocking part
+is only the device-to-host shard fetch (`sharded.stage`), after which the
+training step loop can continue mutating the live arrays; serialization,
+file writes, fsyncs, and the commit rename run on a background writer
+thread against the host snapshot.
+
+Staleness is bounded two ways: `wait_until_finished()` is an explicit
+barrier, and each `save()` force-joins the previous one first — at most
+ONE checkpoint is ever in flight, so a crash loses at most the newest
+save (the previous one is already committed).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu.checkpoint import sharded
+
+
+class CheckpointWriteError(RuntimeError):
+    """A background checkpoint write failed (raised at the next barrier:
+    wait_until_finished() or the force-join inside the next save())."""
+
+
+class SaveHandle:
+    """Ticket for one (possibly in-flight) checkpoint write.
+
+    Cheap to pickle: crossing a process boundary (session.report ships
+    handles from training workers to the driver) keeps only (directory,
+    step) — the receiving side observes progress through the COMMIT
+    marker on the shared filesystem, never through the origin thread.
+    """
+
+    def __init__(self, directory: str, step: Optional[int] = None):
+        self.directory = directory
+        self.step = step
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        """True once the local writer thread finished (success or not)."""
+        return self._event.is_set()
+
+    def committed(self) -> bool:
+        """True once the COMMIT marker exists — the only signal that is
+        meaningful across processes."""
+        return sharded.is_committed(self.directory)
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"checkpoint write to {self.directory} still in flight "
+                f"after {timeout}s")
+        if self._error is not None:
+            raise CheckpointWriteError(
+                f"checkpoint write to {self.directory} failed"
+            ) from self._error
+        return self.directory
+
+    def __reduce__(self):
+        return (_remote_handle, (self.directory, self.step))
+
+    def __repr__(self):
+        state = ("committed" if self.committed()
+                 else "done" if self.done() else "in-flight")
+        return f"SaveHandle({self.directory}, step={self.step}, {state})"
+
+
+def _remote_handle(directory: str, step) -> "SaveHandle":
+    h = SaveHandle(directory, step)
+    h._event.set()   # no local writer on this side; committed() is truth
+    return h
+
+
+class AsyncCheckpointer:
+    """One background writer; at most one save in flight."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._handle: Optional[SaveHandle] = None
+        self._lock = threading.Lock()
+
+    def save(self, directory: str, tree: Any, *, step: Optional[int] = None,
+             metrics: Optional[dict] = None, save_id: str = "0",
+             sync: bool = False, commit: bool = True) -> SaveHandle:
+        """Snapshot `tree` to host and hand the write to the background
+        thread; returns as soon as the snapshot exists.  Force-joins any
+        previous in-flight save first (bounding staleness to one step);
+        `sync=True` degrades to a fully blocking save."""
+        with self._lock:
+            self.wait_until_finished()
+            staged = sharded.stage(tree, save_id=save_id, step=step,
+                                   metrics=metrics)
+            handle = SaveHandle(directory, step)
+
+            def _write():
+                try:
+                    sharded.write_staged(staged, directory, commit=commit)
+                except BaseException as e:  # noqa: BLE001
+                    handle._error = e
+                finally:
+                    handle._event.set()
+
+            if sync:
+                _write()
+                self._handle = handle
+                if handle._error is not None:
+                    handle.wait(0)
+            else:
+                t = threading.Thread(
+                    target=_write, daemon=True,
+                    name=f"ckpt-writer-{step if step is not None else ''}")
+                self._thread = t
+                self._handle = handle
+                t.start()
+            return handle
+
+    def wait_until_finished(self) -> None:
+        """Barrier: block until the in-flight write (if any) hits disk;
+        re-raises its failure, once."""
+        t, h = self._thread, self._handle
+        if t is not None:
+            t.join()
+            self._thread = None
+        if h is not None and h.done() and h._error is not None:
+            self._handle = None
+            h.wait(0)   # raises CheckpointWriteError
+
+    @property
+    def in_flight(self) -> Optional[SaveHandle]:
+        h = self._handle
+        return h if h is not None and not h.done() else None

@@ -1,0 +1,204 @@
+"""Manifest codec for sharded checkpoints: pytree structure as JSON.
+
+A sharded checkpoint directory holds one `manifest.json` plus one raw
+binary file per *unique* array chunk.  The manifest records everything
+needed to re-materialize the tree on a DIFFERENT topology: the tree
+skeleton (dict/list/tuple/namedtuple nesting with scalars inlined), and
+per-array global shape, dtype, logical partition spec, and the chunk ->
+file map with byte sizes (the commit-time inventory).
+
+Orbax keeps this metadata in a msgpack'd "checkpoint" + per-array
+TensorStore specs; here it is one human-readable JSON file, which is
+also what makes torn directories diagnosable by `ls` + `cat`.
+
+No jax import at module level — the numpy-only restore path (and the
+manager's directory scans) must work on hosts without an initialized
+backend.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+FORMAT = "ray_tpu.sharded_ckpt.v1"
+MANIFEST_FILE = "manifest.json"
+COMMIT_FILE = "COMMIT"
+
+_SCALARS = (bool, int, float, str, type(None))
+
+
+class LeafRef:
+    """Placeholder standing where array leaf `id` goes in a decoded
+    skeleton — lets callers tree-map shardings onto the saved structure
+    before any data is read."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, id: int):
+        self.id = id
+
+    def __repr__(self):
+        return f"LeafRef({self.id})"
+
+
+def _is_array(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def encode_tree(tree: Any) -> Tuple[dict, List[Any]]:
+    """(skeleton, leaves): JSON-able skeleton with array leaves replaced
+    by {"kind": "array", "id": i}; `leaves[i]` is the original array."""
+    leaves: List[Any] = []
+
+    def enc(node, path):
+        if _is_array(node):
+            i = len(leaves)
+            leaves.append(node)
+            return {"kind": "array", "id": i, "path": path}
+        if isinstance(node, _SCALARS):
+            return {"kind": "scalar", "value": node}
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            cls = type(node)
+            return {"kind": "namedtuple",
+                    "cls": f"{cls.__module__}:{cls.__qualname__}",
+                    "fields": list(node._fields),
+                    "items": [enc(v, f"{path}.{f}")
+                              for f, v in zip(node._fields, node)]}
+        if isinstance(node, dict):
+            bad = [k for k in node if not isinstance(k, str)]
+            if bad:
+                raise TypeError(
+                    f"sharded checkpoint dict keys must be str, got "
+                    f"{bad[0]!r} at {path or '<root>'}")
+            return {"kind": "dict",
+                    "items": {k: enc(v, f"{path}.{k}" if path else k)
+                              for k, v in node.items()}}
+        if isinstance(node, tuple):
+            return {"kind": "tuple",
+                    "items": [enc(v, f"{path}[{i}]")
+                              for i, v in enumerate(node)]}
+        if isinstance(node, list):
+            return {"kind": "list",
+                    "items": [enc(v, f"{path}[{i}]")
+                              for i, v in enumerate(node)]}
+        raise TypeError(
+            f"unsupported pytree node {type(node).__name__} at "
+            f"{path or '<root>'} — sharded checkpoints support "
+            f"dict/list/tuple/namedtuple containers, array leaves, and "
+            f"python scalars")
+
+    return enc(tree, ""), leaves
+
+
+def decode_tree(skeleton: dict, leaf_values: Dict[int, Any]) -> Any:
+    """Rebuild the tree; array placeholders resolve through
+    `leaf_values` (pass {i: LeafRef(i)} to get the bare structure)."""
+
+    def dec(node):
+        kind = node["kind"]
+        if kind == "array":
+            return leaf_values[node["id"]]
+        if kind == "scalar":
+            return node["value"]
+        if kind == "dict":
+            return {k: dec(v) for k, v in node["items"].items()}
+        if kind == "list":
+            return [dec(v) for v in node["items"]]
+        if kind == "tuple":
+            return tuple(dec(v) for v in node["items"])
+        if kind == "namedtuple":
+            items = [dec(v) for v in node["items"]]
+            mod, _, qual = node["cls"].partition(":")
+            try:
+                obj = importlib.import_module(mod)
+                for part in qual.split("."):
+                    obj = getattr(obj, part)
+                return obj(*items)
+            except Exception:
+                # The defining class moved/vanished: degrade to a plain
+                # tuple (field order preserved) rather than failing the
+                # whole restore.
+                return tuple(items)
+        raise ValueError(f"unknown skeleton node kind {kind!r}")
+
+    return dec(skeleton)
+
+
+def skeleton_refs(skeleton: dict) -> Any:
+    """The saved tree with LeafRef placeholders at every array leaf."""
+    ids: Dict[int, LeafRef] = {}
+
+    def collect(node):
+        if node["kind"] == "array":
+            ids[node["id"]] = LeafRef(node["id"])
+        elif node["kind"] == "dict":
+            for v in node["items"].values():
+                collect(v)
+        elif node["kind"] in ("list", "tuple", "namedtuple"):
+            for v in node["items"]:
+                collect(v)
+
+    collect(skeleton)
+    return decode_tree(skeleton, ids)
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    """np.dtype by name, reaching into ml_dtypes for the TPU low-precision
+    types (bfloat16, float8_*) numpy doesn't define."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# ---------------------------------------------------------------------------
+# Durable small-file writes
+# ---------------------------------------------------------------------------
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename into it survives power loss (no-op
+    on platforms that refuse O_RDONLY dir fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_bytes_atomic(path: str, blob: bytes) -> None:
+    """tmp-file + fsync + atomic rename: the file either exists complete
+    or not at all."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_json_atomic(path: str, obj: Any) -> None:
+    write_bytes_atomic(path, json.dumps(obj, indent=1).encode())
+
+
+def read_manifest(path: str) -> dict:
+    with open(os.path.join(path, MANIFEST_FILE)) as f:
+        man = json.load(f)
+    if man.get("format") != FORMAT:
+        raise ValueError(
+            f"{path}: not a {FORMAT} checkpoint "
+            f"(format={man.get('format')!r})")
+    return man
+
+
+def has_manifest(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, MANIFEST_FILE))

@@ -1,0 +1,404 @@
+"""Sharded pytree save/restore: each host writes only its shards.
+
+Save layout (one directory per checkpoint):
+
+    checkpoint_000042/
+      manifest.json        tree skeleton + per-array shape/dtype/spec +
+                           chunk->file inventory (written by process 0)
+      a0_c0.bin            raw C-order bytes of array 0, chunk 0
+      a0_c1.bin            ...one file per UNIQUE chunk: a shard that is
+      a1_c0.bin            replicated across devices/hosts is written once,
+                           by the process holding its replica_id==0 shard
+      DONE.0.<save_id>     per-process completion markers
+      DONE.1.<save_id>
+      COMMIT               atomic commit marker — written only after every
+                           process' DONE marker is present AND the chunk
+                           inventory verifies; a directory without COMMIT
+                           is torn and is never restored from
+
+Crash safety: every file lands via tmp + fsync + atomic rename, and the
+COMMIT rename is the linearization point — kill the process anywhere
+before it and the directory is ignored (and later GC'd) by the manager.
+
+Multi-host commit needs no barrier: each process, after writing its own
+DONE marker, checks whether it completed the set and, if so, verifies
+the inventory and performs the commit rename (idempotent — replace).
+`save_id` disambiguates incarnations: markers from a dead run that
+crashed into the same directory carry a different save_id and are
+ignored, and process 0 clears such torn leftovers before re-staging.
+
+Elastic restore: the manifest records GLOBAL shapes, so the tree can be
+re-materialized under any current mesh/sharding — each device's shard is
+assembled from whichever saved chunks overlap it.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.checkpoint.manifest import (
+    COMMIT_FILE, FORMAT, MANIFEST_FILE, LeafRef, decode_tree, encode_tree,
+    fsync_dir, read_manifest, resolve_dtype, skeleton_refs,
+    write_bytes_atomic, write_json_atomic)
+
+Index = Tuple[Tuple[int, int], ...]   # ((start, stop) per dim)
+
+
+def _process_info() -> Tuple[int, int]:
+    """(process_index, process_count) — from the jax.distributed fabric
+    when jax is live in this process, else (0, 1).  sys.modules guard:
+    a host saving a plain numpy tree must not drag a backend up."""
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return 0, 1
+    try:
+        return jx.process_index(), jx.process_count()
+    except Exception:
+        return 0, 1
+
+
+def _normalize_index(idx, shape) -> Index:
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _index_shape(index: Index) -> Tuple[int, ...]:
+    return tuple(stop - start for start, stop in index)
+
+
+def _spec_json(arr) -> Optional[list]:
+    """Logical partition spec as JSON: one entry per dim, each None or a
+    list of mesh-axis names.  Recorded for elastic re-sharding; restore
+    re-binds the names to whatever axes the CURRENT mesh has."""
+    sharding = getattr(arr, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append([entry])
+        else:
+            out.append([str(a) for a in entry])
+    return out
+
+
+@dataclass
+class Staged:
+    """A device-to-host snapshot ready for the (background) writer."""
+
+    manifest: dict
+    local_chunks: List[Tuple[str, np.ndarray]]
+    process_index: int
+    process_count: int
+    save_id: str = "0"
+    directory: str = ""
+    committed: bool = field(default=False)
+
+
+def stage(tree: Any, *, save_id: str = "0", step: Optional[int] = None,
+          metrics: Optional[dict] = None) -> Staged:
+    """The synchronous half of a save: fetch this host's addressable
+    shards to host memory and build the manifest.  Runs at the step
+    boundary; everything after (serialization, I/O, commit) can happen
+    on a background thread against the snapshot."""
+    pidx, pcount = _process_info()
+    skeleton, leaves = encode_tree(tree)
+    arrays = []
+    local: List[Tuple[str, np.ndarray]] = []
+    for i, arr in enumerate(leaves):
+        dtype = np.dtype(arr.dtype)
+        shape = tuple(int(s) for s in arr.shape)
+        sharding = getattr(arr, "sharding", None)
+        if sharding is not None and hasattr(arr, "addressable_shards"):
+            # Unique chunk set over the GLOBAL array — identical on every
+            # host, so the manifest (written by process 0) can inventory
+            # chunks other hosts write.
+            index_map = sharding.devices_indices_map(shape)
+            unique = sorted({_normalize_index(idx, shape)
+                             for idx in index_map.values()})
+            ordinal = {idx: n for n, idx in enumerate(unique)}
+            seen: set = set()
+            for shard in arr.addressable_shards:
+                if shard.replica_id != 0:
+                    continue    # replicated shard: exactly one global owner
+                idx = _normalize_index(shard.index, shape)
+                if idx in seen:
+                    continue
+                seen.add(idx)
+                local.append((f"a{i}_c{ordinal[idx]}.bin",
+                              np.asarray(shard.data)))
+        else:
+            # Host array (numpy): one full-extent chunk, owned by rank 0.
+            unique = [tuple((0, d) for d in shape)]
+            if pidx == 0:
+                local.append((f"a{i}_c0.bin", np.asarray(arr)))
+        arrays.append({
+            "id": i,
+            "path": _leaf_path(skeleton, i),
+            "shape": list(shape),
+            "dtype": dtype.name,
+            "spec": _spec_json(arr),
+            "chunks": [{
+                "file": f"a{i}_c{n}.bin",
+                "index": [[s, e] for s, e in idx],
+                "nbytes": int(math.prod(_index_shape(idx)) * dtype.itemsize),
+            } for n, idx in enumerate(unique)],
+        })
+    manifest = {
+        "format": FORMAT,
+        "save_id": str(save_id),
+        "process_count": pcount,
+        "step": step,
+        "metrics": dict(metrics) if metrics else {},
+        "tree": skeleton,
+        "arrays": arrays,
+    }
+    return Staged(manifest=manifest, local_chunks=local,
+                  process_index=pidx, process_count=pcount,
+                  save_id=str(save_id))
+
+
+def _leaf_path(skeleton: dict, leaf_id: int) -> str:
+    stack = [skeleton]
+    while stack:
+        node = stack.pop()
+        kind = node["kind"]
+        if kind == "array" and node["id"] == leaf_id:
+            return node["path"]
+        if kind == "dict":
+            stack.extend(node["items"].values())
+        elif kind in ("list", "tuple", "namedtuple"):
+            stack.extend(node["items"])
+    return ""
+
+
+def write_staged(staged: Staged, path: str, *, commit: bool = True) -> str:
+    """The I/O half of a save (background-thread safe): write chunks,
+    manifest, DONE marker; then attempt the commit rename."""
+    staged.directory = path
+    if staged.process_index == 0 and os.path.isdir(path) \
+            and not is_committed(path):
+        # A torn directory from a dead incarnation: clear it rather than
+        # letting its stale DONE markers/chunks alias into this save.
+        try:
+            stale = read_manifest(path).get("save_id")
+        except Exception:
+            stale = None
+        if stale != staged.save_id:
+            shutil.rmtree(path, ignore_errors=True)
+    os.makedirs(path, exist_ok=True)
+    for fname, data in staged.local_chunks:
+        data = np.ascontiguousarray(data)
+        write_bytes_atomic(os.path.join(path, fname), data.tobytes())
+    if staged.process_index == 0:
+        write_json_atomic(os.path.join(path, MANIFEST_FILE), staged.manifest)
+    write_bytes_atomic(
+        os.path.join(path, f"DONE.{staged.process_index}.{staged.save_id}"),
+        b"")
+    fsync_dir(path)
+    if commit:
+        staged.committed = maybe_commit(path, staged.save_id,
+                                        staged.process_count)
+    return path
+
+
+def maybe_commit(path: str, save_id: str, process_count: int) -> bool:
+    """Write COMMIT iff every process' DONE marker (for THIS save_id) is
+    present and the manifest's chunk inventory verifies.  Idempotent and
+    safe to race: os.replace makes the marker appear exactly once."""
+    if is_committed(path):
+        return True
+    try:
+        man = read_manifest(path)
+    except Exception:
+        return False
+    if man.get("save_id") != save_id:
+        return False
+    for i in range(process_count):
+        if not os.path.isfile(os.path.join(path, f"DONE.{i}.{save_id}")):
+            return False
+    for entry in man["arrays"]:
+        for chunk in entry["chunks"]:
+            f = os.path.join(path, chunk["file"])
+            try:
+                if os.path.getsize(f) != chunk["nbytes"]:
+                    return False
+            except OSError:
+                return False
+    # Chaos interposition: "kill mid-async-save" lands HERE — after the
+    # data is fully written but before the commit rename, the worst
+    # possible instant.  A restore must never see this directory.
+    from ray_tpu._private.fault_injection import get_chaos
+    chaos = get_chaos()
+    if chaos is not None and chaos.kill_ckpt_commit():
+        os._exit(1)
+    write_bytes_atomic(os.path.join(path, COMMIT_FILE),
+                       b'{"save_id": "%s"}\n' % save_id.encode())
+    fsync_dir(path)
+    return True
+
+
+def is_committed(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, COMMIT_FILE))
+
+
+def save_sharded(path: str, tree: Any, *, save_id: str = "0",
+                 step: Optional[int] = None, metrics: Optional[dict] = None,
+                 commit: bool = True) -> str:
+    """Synchronous sharded save (the async path runs the same two halves
+    on either side of a thread hop — see async_writer.AsyncCheckpointer).
+
+    `commit=False` is for tests that need a deliberately torn directory.
+    """
+    staged = stage(tree, save_id=save_id, step=step, metrics=metrics)
+    return write_staged(staged, path, commit=commit)
+
+
+# ---------------------------------------------------------------------------
+# Restore
+# ---------------------------------------------------------------------------
+
+
+class _LeafReader:
+    """Assembles arbitrary index windows of one saved array from its
+    chunk files (memory-mapped, so restoring a small shard of a large
+    array reads only the overlapping bytes)."""
+
+    def __init__(self, directory: str, entry: dict):
+        self.dir = directory
+        self.shape = tuple(entry["shape"])
+        self.dtype = resolve_dtype(entry["dtype"])
+        self.chunks = [(tuple((s, e) for s, e in c["index"]), c["file"])
+                       for c in entry["chunks"]]
+        self._maps: Dict[str, np.ndarray] = {}
+
+    def _chunk_data(self, index: Index, fname: str) -> np.ndarray:
+        m = self._maps.get(fname)
+        if m is None:
+            full = os.path.join(self.dir, fname)
+            shape = _index_shape(index)
+            if math.prod(shape) == 0:
+                m = np.empty(shape, self.dtype)
+            elif len(shape) == 0:
+                with open(full, "rb") as f:
+                    m = np.frombuffer(f.read(), self.dtype).reshape(())
+            else:
+                m = np.memmap(full, dtype=self.dtype, mode="r", shape=shape)
+            self._maps[fname] = m
+        return m
+
+    def read(self, index) -> np.ndarray:
+        """Materialize the window `index` (tuple of slices) as a host
+        array, gathering from every overlapping chunk."""
+        req = _normalize_index(index, self.shape)
+        out = np.empty(_index_shape(req), self.dtype)
+        if out.size == 0:
+            return out
+        for cidx, fname in self.chunks:
+            inter = tuple((max(rs, cs), min(re, ce))
+                          for (rs, re), (cs, ce) in zip(req, cidx))
+            if any(s >= e for s, e in inter):
+                continue
+            src = self._chunk_data(cidx, fname)
+            src_sl = tuple(slice(s - cs, e - cs)
+                           for (s, e), (cs, _) in zip(inter, cidx))
+            dst_sl = tuple(slice(s - rs, e - rs)
+                           for (s, e), (rs, _) in zip(inter, req))
+            out[dst_sl] = src[src_sl]
+        return out
+
+    def read_full(self) -> np.ndarray:
+        return self.read(tuple(slice(0, d) for d in self.shape))
+
+
+def _spec_for_mesh(entry: dict, mesh):
+    """Re-bind the SAVED partition spec to the CURRENT mesh: axis names
+    that don't exist (or have size 1) on this mesh are dropped, so a
+    tree saved on a 4-device ("data","tensor") mesh restores onto a
+    2-device ("data",) mesh with the tensor split simply gone."""
+    from jax.sharding import PartitionSpec as P
+    spec = entry.get("spec")
+    if spec is None:
+        return P()
+    out = []
+    for dim in spec:
+        axes = tuple(a for a in (dim or [])
+                     if mesh.shape.get(a, 1) > 1)
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def restore_sharded(path: str, *, mesh=None, shardings=None,
+                    allow_uncommitted: bool = False) -> Any:
+    """Re-materialize a saved pytree from `path`.
+
+    - default: host numpy arrays (replicated view of the global tree)
+    - ``mesh=``: jax arrays, each re-sharded onto `mesh` by re-binding
+      its saved logical spec (elastic restore across device counts)
+    - ``shardings=``: a single Sharding or a pytree of Shardings
+      congruent with the saved structure — full caller control
+
+    Only committed directories restore unless `allow_uncommitted`.
+    """
+    if not allow_uncommitted and not is_committed(path):
+        raise FileNotFoundError(
+            f"{path}: no COMMIT marker — checkpoint is torn or still "
+            f"being written (pass allow_uncommitted=True to override)")
+    man = read_manifest(path)
+    per_leaf_sharding: Dict[int, Any] = {}
+    if shardings is not None:
+        import jax
+        from jax.sharding import Sharding
+        if isinstance(shardings, Sharding):
+            per_leaf_sharding = {e["id"]: shardings for e in man["arrays"]}
+        else:
+            refs = skeleton_refs(man["tree"])
+
+            def record(ref, sh):
+                if isinstance(ref, LeafRef):
+                    per_leaf_sharding[ref.id] = sh
+
+            jax.tree.map(record, refs, shardings)
+    leaf_values: Dict[int, Any] = {}
+    for entry in man["arrays"]:
+        reader = _LeafReader(path, entry)
+        if mesh is None and entry["id"] not in per_leaf_sharding:
+            leaf_values[entry["id"]] = reader.read_full()
+            continue
+        import jax
+        from jax.sharding import NamedSharding
+        sharding = per_leaf_sharding.get(entry["id"])
+        if sharding is None:
+            sharding = NamedSharding(mesh, _spec_for_mesh(entry, mesh))
+        leaf_values[entry["id"]] = jax.make_array_from_callback(
+            reader.shape, sharding, reader.read)
+    return decode_tree(man["tree"], leaf_values)
+
+
+def checkpoint_metadata(path: str) -> dict:
+    """step/metrics/save_id/process_count of a saved directory, without
+    touching any chunk data."""
+    man = read_manifest(path)
+    return {k: man.get(k) for k in
+            ("step", "metrics", "save_id", "process_count")}

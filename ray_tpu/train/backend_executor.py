@@ -34,6 +34,13 @@ class BackendExecutor:
         self._max_failures = max_failures
         self._num_failures = 0
         self.worker_group: Optional[WorkerGroup] = None
+        # Optional ray_tpu.checkpoint.CheckpointManager over the run's
+        # storage root: workers learn its root through TrainContext, and
+        # elastic restart resumes from its latest COMMITTED step.
+        self.checkpoint_manager = None
+
+    def set_checkpoint_manager(self, manager) -> None:
+        self.checkpoint_manager = manager
 
     def start(self):
         self.worker_group = WorkerGroup(
@@ -50,13 +57,17 @@ class BackendExecutor:
         local = wg.local_ranks()
         node_ranks = wg.node_ranks()
         refs = []
+        ckpt_root = (self.checkpoint_manager.root
+                     if self.checkpoint_manager is not None else "")
         for rank, worker in enumerate(wg.workers):
             ctx = TrainContext(
                 world_rank=rank,
                 world_size=len(wg),
                 local_rank=local[rank][0],
                 local_world_size=local[rank][1],
-                node_rank=node_ranks[rank])
+                node_rank=node_ranks[rank],
+                checkpoint_root=ckpt_root,
+                restart_count=self._num_failures)
             per_worker = {name: shards[rank] for name, shards
                           in (dataset_shards or {}).items()}
             refs.append(worker.actor.init_session.remote(
@@ -115,6 +126,24 @@ class BackendExecutor:
     def can_restart(self) -> bool:
         return (self._max_failures == -1
                 or self._num_failures < self._max_failures)
+
+    def latest_committed_checkpoint(self) -> Optional[Checkpoint]:
+        """The newest COMMITTED step under the checkpoint manager, as a
+        Checkpoint — what an elastic restart resumes from.  An async
+        save the dead gang never committed is invisible here by
+        construction (no COMMIT marker), so a restart can never resume
+        from a torn checkpoint."""
+        mgr = self.checkpoint_manager
+        if mgr is None:
+            return None
+        try:
+            mgr.wait_until_finished()   # drain any driver-side writer
+        except Exception as e:
+            logger.warning("async checkpoint write failed: %s", e)
+        step = mgr.latest_step()
+        if step is None:
+            return None
+        return Checkpoint.from_sharded_dir(mgr.step_dir(step))
 
     def restart(self):
         """Elastic restart: tear the gang down, rebuild, re-rendezvous

@@ -111,9 +111,11 @@ class DataParallelTrainer(BaseTrainer):
         executor = BackendExecutor(
             self._backend_config, self.scaling_config,
             max_failures=self.run_config.failure_config.max_failures)
+        manager = self._manager = self._make_checkpoint_manager()
+        executor.set_checkpoint_manager(manager)
         train_fn = self._bind_train_fn()
         history: List[dict] = []
-        last_checkpoint = self._resume_from
+        last_checkpoint = self._resolve_resume(manager)
         error: Optional[BaseException] = None
 
         # Per-worker streaming ingest: each worker iterates only ITS
@@ -139,7 +141,7 @@ class DataParallelTrainer(BaseTrainer):
                         if ckpts:
                             last_checkpoint = ckpts[0]
                             self._persist_checkpoint(last_checkpoint,
-                                                     len(history))
+                                                     len(history), metrics)
                         history.append(metrics)
                     executor.finish_training()
                     break
@@ -148,16 +150,31 @@ class DataParallelTrainer(BaseTrainer):
                         raise
                     if executor.can_restart():
                         executor.restart()
+                        # Elastic resume point: the latest COMMITTED step
+                        # — an async save the dead gang never finished has
+                        # no COMMIT marker and is skipped by construction.
+                        committed = executor.latest_committed_checkpoint()
+                        if committed is not None:
+                            last_checkpoint = committed
                         continue
-                    error = e if not isinstance(e, TrainingFailedError) \
-                        else e
+                    # Surface the real worker exception, not the gang
+                    # wrapper around it.
+                    error = e.__cause__ \
+                        if (isinstance(e, TrainingFailedError)
+                            and e.__cause__ is not None) else e
                     break
         finally:
             executor.shutdown()
+            if manager is not None:
+                try:
+                    manager.wait_until_finished()
+                except Exception as ckpt_err:
+                    if error is None:
+                        error = ckpt_err
 
         return Result(
             metrics=history[-1] if history else None,
-            checkpoint=last_checkpoint,
+            checkpoint=self._finalize_checkpoint(last_checkpoint, manager),
             error=error,
             metrics_history=history)
 
@@ -175,13 +192,82 @@ class DataParallelTrainer(BaseTrainer):
 
         return bound
 
-    def _persist_checkpoint(self, checkpoint: Checkpoint, step: int):
+    def _make_checkpoint_manager(self):
+        """CheckpointManager over storage_path/name (None when the run
+        has no persistent storage).  CheckpointConfig maps to retention:
+        num_to_keep bounds keep-best when a score attribute is set
+        (reference semantics), keep-last otherwise."""
         root = self.run_config.storage_path
         if not root:
-            return
+            return None
+        from ray_tpu.checkpoint import CheckpointManager
+        cc = self.run_config.checkpoint_config
         name = self.run_config.name or "train_run"
-        path = os.path.join(root, name, f"checkpoint_{step:06d}")
-        checkpoint.to_directory(path)
+        if cc.checkpoint_score_attribute is not None:
+            keep_last, keep_best = None, cc.num_to_keep
+        else:
+            keep_last, keep_best = cc.num_to_keep, None
+        return CheckpointManager(
+            os.path.join(root, name),
+            keep_last_k=keep_last, keep_best_k=keep_best,
+            best_metric=cc.checkpoint_score_attribute,
+            best_mode=cc.checkpoint_score_order)
+
+    def _resolve_resume(self, manager):
+        """resume_from_checkpoint routed through the manager: "latest"
+        (or "auto") resumes from the newest committed step in storage; a
+        SaveHandle resolves to its directory once committed."""
+        resume = self._resume_from
+        from ray_tpu.checkpoint import SaveHandle
+        if isinstance(resume, str):
+            if resume not in ("latest", "auto"):
+                raise ValueError(
+                    f"resume_from_checkpoint string form must be "
+                    f"'latest'/'auto', got {resume!r}")
+            if manager is None:
+                raise ValueError(
+                    "resume_from_checkpoint='latest' requires "
+                    "RunConfig(storage_path=...)")
+            return manager.latest_checkpoint()
+        if isinstance(resume, SaveHandle):
+            return self._finalize_checkpoint(resume, manager)
+        return resume
+
+    def _persist_checkpoint(self, checkpoint, step: int,
+                            metrics: Optional[dict] = None):
+        """Route a reported checkpoint through the manager.  A
+        SaveHandle means a worker already wrote sharded data under the
+        manager root (its commit marker lands asynchronously) — only
+        retention bookkeeping remains.  A dict-form Checkpoint is saved
+        by the driver, asynchronously: the report loop never blocks on
+        serialization or I/O."""
+        manager = self._manager
+        if manager is None:
+            return
+        from ray_tpu.checkpoint import SaveHandle
+        if isinstance(checkpoint, SaveHandle):
+            manager.track(checkpoint.step if checkpoint.step is not None
+                          else step, metrics)
+        elif isinstance(checkpoint, Checkpoint) and checkpoint.is_sharded:
+            manager.track(step, metrics)
+        else:
+            manager.save(step, checkpoint.to_dict(), metrics=metrics)
+
+    def _finalize_checkpoint(self, checkpoint, manager):
+        """Result.checkpoint must be restorable by the caller: resolve a
+        SaveHandle to its committed directory (worker-side handles are
+        polled through the COMMIT marker on the shared filesystem)."""
+        from ray_tpu.checkpoint import SaveHandle
+        if not isinstance(checkpoint, SaveHandle):
+            return checkpoint
+        deadline = time.monotonic() + 60.0
+        while not checkpoint.committed() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if checkpoint.committed():
+            return Checkpoint.from_sharded_dir(checkpoint.directory)
+        # Never committed (writer died): fall back to the newest step
+        # that did.
+        return manager.latest_checkpoint() if manager is not None else None
 
 
 class TorchTrainer(DataParallelTrainer):

@@ -30,6 +30,12 @@ class TrainContext:
     node_rank: int
     experiment_name: str = ""
     trial_name: str = ""
+    # Sharded-checkpoint plumbing: where this run's CheckpointManager
+    # lives (empty = no persistent storage configured) and which elastic
+    # incarnation this worker belongs to (bumped per restart; save_id
+    # fodder so a new gang never aliases a dead gang's torn save).
+    checkpoint_root: str = ""
+    restart_count: int = 0
 
 
 class _TrainSession:
@@ -96,6 +102,11 @@ class _TrainSession:
 
     def finish(self, timeout: float = 60.0):
         self.thread.join(timeout)
+        # Drain this worker's async checkpoint writer: training is not
+        # "finished" while its last save could still be torn.
+        mgr = getattr(self, "_ckpt_manager", None)
+        if mgr is not None:
+            mgr.wait_until_finished()
         if self.error is not None:
             raise self.error
 
@@ -117,8 +128,34 @@ def get_session() -> "_TrainSession":
 # ---------------------------------------------------------------------------
 
 
-def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
+def report(metrics: dict, checkpoint=None) -> None:
+    """Stream one step's metrics (and optionally a checkpoint) to the
+    driver.  `checkpoint` may be an air.Checkpoint OR an async
+    ray_tpu.checkpoint.SaveHandle — a handle crosses to the driver as a
+    lightweight (directory, step) ticket, so reporting never blocks on
+    checkpoint serialization or I/O."""
     get_session().report(dict(metrics), checkpoint)
+
+
+def get_checkpoint_manager():
+    """This worker's CheckpointManager over the run's storage root
+    (requires RunConfig.storage_path on the trainer).  Its save_id is
+    derived from the elastic restart count, so saves from a restarted
+    gang never alias a dead gang's torn directories."""
+    sess = get_session()
+    mgr = getattr(sess, "_ckpt_manager", None)
+    if mgr is None:
+        root = sess.context.checkpoint_root
+        if not root:
+            raise RuntimeError(
+                "no checkpoint storage configured — pass "
+                "RunConfig(storage_path=...) to the trainer to use "
+                "sharded checkpointing")
+        from ray_tpu.checkpoint import CheckpointManager
+        mgr = CheckpointManager(
+            root, save_id=f"i{sess.context.restart_count}")
+        sess._ckpt_manager = mgr
+    return mgr
 
 
 def get_dataset_shard(name: str = "train"):

@@ -46,6 +46,51 @@ def timeit(name, fn, n, results, settle: float = 0.0):
           f"range {min(rates):,.0f}-{max(rates):,.0f})")
 
 
+def bench_checkpoint(results: dict):
+    """Sharded-checkpoint microbenches: full sync save, the stage
+    (device-to-host) half that is all an ASYNC save blocks the step loop
+    for, and committed-directory restore.  16 MiB payload so the numbers
+    track the checkpoint machinery, not disk bandwidth alone."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.checkpoint import restore_sharded, save_sharded, sharded
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    rows = (16 << 20) // (256 * 4)
+    rows -= rows % len(jax.devices())
+    state = {"w": jax.device_put(np.zeros((rows, 256), np.float32),
+                                 NamedSharding(mesh, P("data")))}
+    root = tempfile.mkdtemp(prefix="microbench_ckpt_")
+    try:
+        path = os.path.join(root, "ck")
+
+        def ckpt_save_sync(n):
+            for _ in range(n):
+                save_sharded(path, state)
+
+        timeit("ckpt_save_sync_16MiB", ckpt_save_sync, 5, results)
+
+        def ckpt_stage(n):
+            for _ in range(n):
+                sharded.stage(state)
+
+        timeit("ckpt_stage_16MiB", ckpt_stage, 20, results)
+
+        def ckpt_restore(n):
+            for _ in range(n):
+                jax.block_until_ready(
+                    restore_sharded(path, mesh=mesh)["w"])
+
+        timeit("ckpt_restore_16MiB", ckpt_restore, 10, results)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     ray_tpu.init(num_cpus=8, object_store_memory=256 << 20)
     results: dict = {}
@@ -231,6 +276,9 @@ def main():
 
     timeit("prefill_miss", prefill_miss, 32, results)
     eng.shutdown()
+
+    # --- checkpoint: sharded save / stage / restore ------------------------
+    bench_checkpoint(results)
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MICROBENCH.json")

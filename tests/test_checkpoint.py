@@ -1,0 +1,454 @@
+"""Sharded async-checkpoint subsystem tests (ray_tpu/checkpoint/).
+
+Coverage map (ISSUE acceptance criteria):
+
+- sharded save/restore roundtrip with host-local chunk dedup
+- elastic restore: save under a 4-device mesh, restore under 2- and
+  1-device meshes — token-exact values, re-bound shardings
+- crash-safe commit: uncommitted (torn) directories are never restored
+  and are GC'd once a committed step overtakes them
+- async save path: training overlaps I/O, wait_until_finished barrier,
+  forced join on the next save, background errors surface at barriers
+- CheckpointManager retention: keep-last-K and keep-best-by-metric
+- air.Checkpoint interop (from_sharded_dir / tmp-dir registry cleanup)
+- trainer e2e: workers reporting async SaveHandles through session
+"""
+
+import collections
+import glob
+import os
+import pickle
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import ray_tpu
+from ray_tpu.air import Checkpoint, CheckpointConfig, RunConfig, ScalingConfig
+from ray_tpu.air import checkpoint as air_checkpoint
+from ray_tpu.checkpoint import (
+    AsyncCheckpointer, CheckpointManager, CheckpointWriteError, COMMIT_FILE,
+    SaveHandle, checkpoint_metadata, is_committed, restore_sharded,
+    save_sharded, sharded)
+from ray_tpu.train import DataParallelTrainer
+
+OptState = collections.namedtuple("OptState", ["mu", "nu", "count"])
+
+
+def _mesh(n, axes=("data",), shape=None):
+    devs = np.array(jax.devices()[:n])
+    if shape is not None:
+        devs = devs.reshape(shape)
+    return Mesh(devs, axes)
+
+
+def _sample_tree(mesh):
+    """Train-state-shaped tree: sharded + replicated jax arrays, a
+    namedtuple (optax idiom), a host numpy array, python scalars."""
+    w = jax.device_put(
+        np.arange(32, dtype=np.float32).reshape(8, 4),
+        NamedSharding(mesh, P("data", "model")))
+    b = jax.device_put(np.arange(4, dtype=np.float32),
+                       NamedSharding(mesh, P()))
+    mu = jax.device_put(
+        np.arange(8, dtype=np.float32).reshape(8, 1) * 0.5,
+        NamedSharding(mesh, P("data")))
+    return {
+        "params": {"w": w, "b": b},
+        "opt_state": OptState(mu=mu, nu=np.full((3,), 2.5, np.float64),
+                              count=np.int32(7)),
+        "step": 42,
+        "tag": "run-a",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Sharded save/restore core
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_roundtrip_and_layout(tmp_path):
+    mesh = _mesh(4, ("data", "model"), (2, 2))
+    tree = _sample_tree(mesh)
+    path = str(tmp_path / "ck")
+    save_sharded(path, tree, save_id="i0", step=42,
+                 metrics={"loss": 0.25})
+
+    assert is_committed(path)
+    assert os.path.isfile(os.path.join(path, "manifest.json"))
+    assert os.path.isfile(os.path.join(path, COMMIT_FILE))
+    meta = checkpoint_metadata(path)
+    assert meta["step"] == 42
+    assert meta["metrics"] == {"loss": 0.25}
+    assert meta["save_id"] == "i0"
+
+    out = restore_sharded(path)   # default: host numpy tree
+    assert np.array_equal(out["params"]["w"],
+                          np.asarray(tree["params"]["w"]))
+    assert np.array_equal(out["params"]["b"],
+                          np.asarray(tree["params"]["b"]))
+    assert isinstance(out["opt_state"], OptState)   # class reconstructed
+    assert np.array_equal(out["opt_state"].mu,
+                          np.asarray(tree["opt_state"].mu))
+    assert np.array_equal(out["opt_state"].nu, tree["opt_state"].nu)
+    assert out["step"] == 42 and out["tag"] == "run-a"
+
+
+def test_chunk_dedup_replicated_written_once(tmp_path):
+    """A fully replicated array produces exactly ONE chunk file; a
+    (2,2)-sharded array produces one per distinct shard."""
+    mesh = _mesh(4, ("data", "model"), (2, 2))
+    tree = {
+        "sharded": jax.device_put(
+            np.arange(16, dtype=np.float32).reshape(4, 4),
+            NamedSharding(mesh, P("data", "model"))),
+        "replicated": jax.device_put(np.arange(6, dtype=np.float32),
+                                     NamedSharding(mesh, P())),
+    }
+    path = str(tmp_path / "ck")
+    save_sharded(path, tree)
+    # Leaf ids follow dict insertion order: a0 = sharded, a1 = replicated.
+    assert len(glob.glob(os.path.join(path, "a0_c*.bin"))) == 4
+    assert len(glob.glob(os.path.join(path, "a1_c*.bin"))) == 1
+    out = restore_sharded(path)
+    assert np.array_equal(out["sharded"], np.asarray(tree["sharded"]))
+    assert np.array_equal(out["replicated"],
+                          np.asarray(tree["replicated"]))
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    mesh = _mesh(2)
+    x = jax.device_put(jnp.arange(16, dtype=jnp.bfloat16).reshape(8, 2),
+                       NamedSharding(mesh, P("data")))
+    path = str(tmp_path / "ck")
+    save_sharded(path, {"x": x})
+    out = restore_sharded(path)
+    assert str(out["x"].dtype) == "bfloat16"
+    assert np.array_equal(out["x"], np.asarray(x))
+
+
+def test_elastic_restore_across_device_counts(tmp_path):
+    """Acceptance criterion: a checkpoint saved under one mesh restores
+    token-exactly under a different device count, with its saved logical
+    spec re-bound to the current mesh's axes."""
+    mesh4 = _mesh(4, ("data", "model"), (2, 2))
+    tree = _sample_tree(mesh4)
+    path = str(tmp_path / "ck")
+    save_sharded(path, tree)
+    want_w = np.asarray(tree["params"]["w"])
+    want_mu = np.asarray(tree["opt_state"].mu)
+
+    # 2-device restore: "model" axis is gone -> w comes back P("data").
+    mesh2 = _mesh(2, ("data",))
+    out2 = restore_sharded(path, mesh=mesh2)
+    w2 = out2["params"]["w"]
+    assert w2.sharding.mesh.devices.size == 2
+    assert w2.sharding.spec == P("data")
+    assert np.array_equal(np.asarray(w2), want_w)
+    assert out2["opt_state"].mu.sharding.spec == P("data")
+    assert np.array_equal(np.asarray(out2["opt_state"].mu), want_mu)
+    assert np.array_equal(np.asarray(out2["params"]["b"]),
+                          np.asarray(tree["params"]["b"]))
+    assert out2["step"] == 42
+
+    # 1-device restore: every axis drops -> fully replicated.
+    mesh1 = _mesh(1, ("data",))
+    out1 = restore_sharded(path, mesh=mesh1)
+    assert out1["params"]["w"].sharding.spec == P()
+    assert np.array_equal(np.asarray(out1["params"]["w"]), want_w)
+    assert np.array_equal(np.asarray(out1["opt_state"].mu), want_mu)
+
+
+def test_restore_with_explicit_sharding(tmp_path):
+    """shardings= gives the caller full control: a single Sharding
+    applies to every leaf regardless of what was saved."""
+    mesh4 = _mesh(4, ("data", "model"), (2, 2))
+    tree = {"w": jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+        NamedSharding(mesh4, P("data", "model")))}
+    path = str(tmp_path / "ck")
+    save_sharded(path, tree)
+    mesh2 = _mesh(2, ("x",))
+    sh = NamedSharding(mesh2, P(None, "x"))
+    out = restore_sharded(path, shardings=sh)
+    assert out["w"].sharding.spec == P(None, "x")
+    assert np.array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+
+
+def test_uncommitted_directory_never_restores(tmp_path):
+    path = str(tmp_path / "torn")
+    save_sharded(path, {"x": np.arange(4)}, commit=False)
+    assert not is_committed(path)
+    assert os.path.isfile(os.path.join(path, "manifest.json"))
+    with pytest.raises(FileNotFoundError, match="COMMIT"):
+        restore_sharded(path)
+    # Explicit override for forensics.
+    out = restore_sharded(path, allow_uncommitted=True)
+    assert np.array_equal(out["x"], np.arange(4))
+
+
+# ---------------------------------------------------------------------------
+# Async writer
+# ---------------------------------------------------------------------------
+
+
+def test_async_save_overlaps_caller(tmp_path, monkeypatch):
+    """save() returns while the write is still in flight (the step loop
+    keeps running); wait_until_finished() is the barrier."""
+    gate = threading.Event()
+    orig = sharded.write_staged
+
+    def gated_write(staged, path, *, commit=True):
+        gate.wait(10)
+        return orig(staged, path, commit=commit)
+
+    monkeypatch.setattr(sharded, "write_staged", gated_write)
+    ckptr = AsyncCheckpointer()
+    path = str(tmp_path / "ck")
+    h = ckptr.save(path, {"x": np.arange(8)}, step=1)
+    # Caller is back while the writer is gated: overlap proven.
+    assert not h.done()
+    assert not h.committed()
+    assert ckptr.in_flight is h
+    gate.set()
+    ckptr.wait_until_finished()
+    assert h.done() and h.committed()
+    assert ckptr.in_flight is None
+    assert h.wait(0) == path
+
+
+def test_async_save_forced_join_one_in_flight(tmp_path, monkeypatch):
+    """The next save() force-joins the previous write — at most one
+    checkpoint is ever in flight."""
+    orig = sharded.write_staged
+
+    def slow_write(staged, path, *, commit=True):
+        time.sleep(0.3)
+        return orig(staged, path, commit=commit)
+
+    monkeypatch.setattr(sharded, "write_staged", slow_write)
+    ckptr = AsyncCheckpointer()
+    h1 = ckptr.save(str(tmp_path / "ck1"), {"x": np.arange(4)}, step=1)
+    assert not h1.done()
+    h2 = ckptr.save(str(tmp_path / "ck2"), {"x": np.arange(4)}, step=2)
+    # save() only returned after joining h1's writer.
+    assert h1.done() and h1.committed()
+    h2.wait(10)
+    assert h2.committed()
+
+
+def test_async_write_error_surfaces_at_barrier(tmp_path, monkeypatch):
+    def broken_write(staged, path, *, commit=True):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(sharded, "write_staged", broken_write)
+    ckptr = AsyncCheckpointer()
+    h = ckptr.save(str(tmp_path / "ck"), {"x": np.arange(4)}, step=1)
+    with pytest.raises(CheckpointWriteError):
+        ckptr.wait_until_finished()
+    assert h.done() and not h.committed()
+    # The error is raised once; the writer is usable again after.
+    monkeypatch.setattr(sharded, "write_staged", sharded.write_staged)
+    monkeypatch.undo()
+    h2 = ckptr.save(str(tmp_path / "ck2"), {"x": np.arange(4)}, step=2,
+                    sync=True)
+    assert h2.committed()
+
+
+def test_save_handle_pickles_light(tmp_path):
+    """A handle crosses process boundaries as (directory, step); on the
+    far side committed() reads the COMMIT marker, not the origin thread."""
+    ckptr = AsyncCheckpointer()
+    path = str(tmp_path / "ck")
+    h = ckptr.save(path, {"x": np.arange(4)}, step=9, sync=True)
+    remote = pickle.loads(pickle.dumps(h))
+    assert isinstance(remote, SaveHandle)
+    assert remote.directory == path and remote.step == 9
+    assert remote.done() and remote.committed()
+    # A handle to a torn save reports not-committed on the far side.
+    torn = str(tmp_path / "torn")
+    save_sharded(torn, {"x": np.arange(2)}, commit=False)
+    remote2 = pickle.loads(pickle.dumps(SaveHandle(torn, 1)))
+    assert not remote2.committed()
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: layout, retention, GC
+# ---------------------------------------------------------------------------
+
+
+def test_manager_keep_last_k(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=2)
+    for step in range(5):
+        mgr.save(step, {"x": np.full((4,), step)}, sync=True)
+    assert mgr.steps() == [3, 4]
+    assert mgr.latest_step() == 4
+    out = mgr.restore_latest()
+    assert np.array_equal(out["x"], np.full((4,), 4))
+    # The evicted directories are really gone.
+    assert sorted(os.listdir(tmp_path)) == [
+        "checkpoint_000003", "checkpoint_000004"]
+
+
+def test_manager_keep_best_by_metric(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_best_k=2,
+                            best_metric="acc", best_mode="max")
+    accs = {0: 0.1, 1: 0.9, 2: 0.5, 3: 0.8, 4: 0.2}
+    for step, acc in accs.items():
+        mgr.save(step, {"x": np.full((2,), step)}, metrics={"acc": acc},
+                 sync=True)
+    # Best two by acc (steps 1, 3) plus the latest (4) survive.
+    assert mgr.steps() == [1, 3, 4]
+
+    # keep-best survives a restart: a FRESH manager reads metrics back
+    # from the manifests, not from in-memory state.
+    mgr2 = CheckpointManager(str(tmp_path), keep_best_k=2,
+                             best_metric="acc", best_mode="max")
+    assert mgr2.metrics_for(1) == {"acc": 0.9}
+    mgr2.save(5, {"x": np.full((2,), 5)}, metrics={"acc": 0.0}, sync=True)
+    assert mgr2.steps() == [1, 3, 5]
+
+
+def test_manager_gc_torn_dirs_and_latest_skips_them(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_id="i0")
+    mgr.save(1, {"x": np.arange(3)}, sync=True)
+    # A torn save at step 2 (crash before COMMIT) ...
+    save_sharded(mgr.step_dir(2), {"x": np.arange(3)}, save_id="i0",
+                 commit=False)
+    assert mgr.latest_step() == 1          # ... is invisible
+    out = mgr.restore_latest()
+    assert np.array_equal(out["x"], np.arange(3))
+    # A torn dir AHEAD of every committed step is preserved (it may be a
+    # peer's in-flight save); one at or behind the frontier is GC'd.
+    removed = mgr.gc()
+    assert removed == []
+    mgr.save(3, {"x": np.arange(3)}, sync=True)
+    assert not os.path.isdir(mgr.step_dir(2))
+    assert mgr.steps() == [1, 3]
+
+
+def test_manager_async_handles_and_barrier(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=1)
+    handles = [mgr.save(step, {"x": np.full((8,), step)})
+               for step in range(3)]
+    mgr.wait_until_finished()
+    assert all(h.committed() or not os.path.isdir(h.directory)
+               for h in handles)
+    assert mgr.steps() == [2]              # retention ran at the barrier
+    assert np.array_equal(mgr.restore_latest()["x"], np.full((8,), 2))
+
+
+# ---------------------------------------------------------------------------
+# air.Checkpoint interop + tmp-dir lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_air_checkpoint_sharded_interop(tmp_path):
+    mesh = _mesh(2)
+    tree = {"w": jax.device_put(np.arange(8, dtype=np.float32),
+                                NamedSharding(mesh, P("data"))),
+            "step": 3}
+    path = str(tmp_path / "ck")
+    save_sharded(path, tree)
+
+    ckpt = Checkpoint.from_sharded_dir(path)
+    assert ckpt.is_sharded
+    assert ckpt.to_dict()["step"] == 3
+    assert np.array_equal(ckpt.to_dict()["w"], np.arange(8))
+    # Elastic path through the air layer too.
+    out = ckpt.to_pytree(mesh=_mesh(1))
+    assert np.array_equal(np.asarray(out["w"]), np.arange(8))
+
+    # Pickling ships the path, never a packed byte blob.
+    clone = pickle.loads(pickle.dumps(ckpt))
+    assert clone._dir == path and clone.is_sharded
+
+    # A torn directory is rejected at construction.
+    torn = str(tmp_path / "torn")
+    save_sharded(torn, {"x": np.arange(2)}, commit=False)
+    with pytest.raises(ValueError, match="COMMIT"):
+        Checkpoint.from_sharded_dir(torn)
+
+
+def test_checkpoint_tmp_registry_and_cleanup(tmp_path):
+    """Satellite: to_directory(None) registers its tmp dir; delete()
+    reclaims one checkpoint, cleanup_tmp() sweeps the rest."""
+    air_checkpoint.cleanup_tmp()   # start from a clean registry
+    a = Checkpoint.from_dict({"x": 1})
+    b = Checkpoint.from_dict({"y": 2})
+    pa, pb = a.to_directory(), b.to_directory()
+    assert os.path.isdir(pa) and os.path.isdir(pb)
+    assert Checkpoint.from_directory(pa).to_dict()["x"] == 1
+
+    a.delete()
+    assert not os.path.exists(pa)
+    assert os.path.isdir(pb)               # delete() is per-checkpoint
+    assert air_checkpoint.cleanup_tmp() == 1
+    assert not os.path.exists(pb)
+    assert air_checkpoint.cleanup_tmp() == 0
+
+
+# ---------------------------------------------------------------------------
+# Trainer e2e: workers report async SaveHandles
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8, object_store_memory=64 << 20)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_trainer_worker_async_sharded_checkpoints(cluster, tmp_path):
+    """The full wiring: a worker saves sharded checkpoints through
+    session.get_checkpoint_manager(), reports the async handle, the
+    driver tracks retention, and Result.checkpoint restores."""
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu.train import session
+
+        mgr = session.get_checkpoint_manager()
+        start = 0
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            start = int(ckpt.to_dict()["step"]) + 1
+        for step in range(start, 4):
+            state = {"w": np.full((8,), float(step)), "step": step}
+            handle = mgr.save(step, state, metrics={"loss": 1.0 / (step + 1)})
+            session.report({"step": step}, checkpoint=handle)
+
+    run = RunConfig(name="sharded_run", storage_path=str(tmp_path),
+                    checkpoint_config=CheckpointConfig(num_to_keep=2))
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1), run_config=run)
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    assert result.checkpoint is not None and result.checkpoint.is_sharded
+    final = result.checkpoint.to_dict()
+    assert final["step"] == 3
+    assert np.array_equal(final["w"], np.full((8,), 3.0))
+    # Retention (num_to_keep=2) applied under storage_path/name.
+    root = tmp_path / "sharded_run"
+    kept = sorted(p.name for p in root.iterdir())
+    assert kept == ["checkpoint_000002", "checkpoint_000003"]
+    assert all(is_committed(str(root / p)) for p in kept)
+
+    # Second run resumes from storage via resume_from_checkpoint="latest".
+    trainer2 = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1), run_config=run,
+        resume_from_checkpoint="latest")
+    result2 = trainer2.fit()
+    assert result2.error is None
+    assert result2.metrics_history == []   # start=4: nothing left
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-v", "-x"]))

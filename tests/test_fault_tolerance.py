@@ -301,6 +301,75 @@ def test_object_loss_lineage_reconstruction(chaos_cluster):
 
 
 # ---------------------------------------------------------------------------
+# Scenario 5: worker killed mid-async-checkpoint-save -> resume from the
+# last COMMITTED step, never a torn one
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 404,
+      # Scripted: the first spawned worker's checkpoint writer dies at
+      # its 3rd save (ordinal 2) — right after the shard data is on disk
+      # but BEFORE the COMMIT rename, leaving checkpoint_000002 torn.
+      # Its replacement (a fresh spawn ordinal) saves unharmed.
+      "chaos_ckpt_kill_salts": "1",
+      "chaos_ckpt_kill_at": 2}],
+    indirect=True)
+def test_worker_killed_mid_async_save_resumes_from_committed(
+        chaos_cluster, tmp_path):
+    """ISSUE acceptance criterion: chaos-killing a worker mid-save must
+    leave restore_latest() pointing at the previous committed step; the
+    elastic restart resumes there and the run still completes."""
+    from ray_tpu.air import (
+        FailureConfig, RunConfig, ScalingConfig)
+    from ray_tpu.checkpoint import is_committed
+    from ray_tpu.train import DataParallelTrainer
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu.train import session
+
+        mgr = session.get_checkpoint_manager()
+        start = 0
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            start = int(ckpt.to_dict()["step"]) + 1
+        for step in range(start, 6):
+            state = {"w": np.full((16,), float(step)), "step": step}
+            handle = mgr.save(step, state)
+            # Serialize save->report so the scripted kill lands at a
+            # deterministic step (the writer is async; without the wait
+            # the os._exit could race the next report's RPC).
+            handle._event.wait(30)
+            session.report({"step": step, "resumed_from": start},
+                           checkpoint=handle)
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="chaos_ckpt", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None
+    # Steps 0 and 1 committed; the save of step 2 died pre-COMMIT, so the
+    # restarted gang resumed from committed step 1 (start == 2) and the
+    # run still reached the end.
+    assert result.metrics["step"] == 5
+    assert result.metrics["resumed_from"] == 2
+    resumes = {m["resumed_from"] for m in result.metrics_history}
+    assert resumes == {0, 2}
+    # Every surviving directory is committed — the torn step-2 dir was
+    # either overwritten by the new incarnation or GC'd, never restored.
+    root = tmp_path / "chaos_ckpt"
+    assert sorted(p.name for p in root.iterdir())[-1] == "checkpoint_000005"
+    for p in root.iterdir():
+        assert is_committed(str(p)), f"torn directory survived: {p}"
+    final = result.checkpoint.to_dict()
+    assert final["step"] == 5
+
+
+# ---------------------------------------------------------------------------
 # Node-death propagation plumbing (unit level)
 # ---------------------------------------------------------------------------
 
