@@ -1,0 +1,119 @@
+"""Serve failover benchmark: request survival under replica chaos.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Runs the same streamed-generation workload twice against an
+LLMDeployment while probabilistic `chaos_kill_replica` randomly
+`os._exit(1)`s replicas mid-stream: once with no failover policy
+(replica death surfaces to the caller) and once with the
+`llm_stream_resume` policy (the handle resubmits with the produced
+tokens appended to the prompt).  Reports the with-failover success
+rate; `vs_baseline` is the ratio over the no-failover success rate —
+how many requests failover rescues.  p99 latency for both modes rides
+along so the healing cost is visible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def _run_mode(args, failover):
+    """One cluster lifetime: deploy, fire the workload, tear down.
+
+    Returns (successes, failures, per-request latencies in seconds)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.serve import _private as sp
+
+    ray_tpu.init(num_cpus=4, _system_config={
+        "chaos_enabled": True,
+        "chaos_seed": args.seed,
+        "chaos_kill_replica": args.kill_p,
+    })
+    serve.start()
+    try:
+        app = serve.LLMDeployment.options(
+            name="llm_ft_bench", num_replicas=args.replicas).bind(
+                model="gpt", config="nano", max_lanes=4, seed=0)
+        handle = serve.run(app).options("generate", failover=failover)
+        # Warmup (compiles the step shapes on each replica before timing).
+        for _ in range(args.replicas):
+            try:
+                list(handle.stream([1, 2, 3], 2))
+            except Exception:
+                pass
+
+        latencies, outcomes = [], []
+
+        def one(i):
+            prompt = [(5 * i + j) % 50 + 1 for j in range(4)]
+            t0 = time.perf_counter()
+            try:
+                toks = list(handle.stream(prompt, args.new_tokens))
+                ok = len(toks) == args.new_tokens
+            except Exception:
+                ok = False
+            latencies.append(time.perf_counter() - t0)
+            outcomes.append(ok)
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            list(pool.map(one, range(args.requests)))
+        return sum(outcomes), len(outcomes) - sum(outcomes), latencies
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+            with sp._router_states_lock:
+                sp._router_states.clear()
+            GLOBAL_CONFIG.invalidate_cache()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--new-tokens", type=int, default=12)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--kill-p", type=float, default=0.02,
+                    help="per-serve-event replica kill probability")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    from ray_tpu.serve.llm import llm_stream_resume
+
+    ok_plain, fail_plain, lat_plain = _run_mode(args, failover=None)
+    ok_fo, fail_fo, lat_fo = _run_mode(args, failover=llm_stream_resume)
+
+    rate_plain = ok_plain / max(1, ok_plain + fail_plain)
+    rate_fo = ok_fo / max(1, ok_fo + fail_fo)
+
+    print(json.dumps({
+        "metric": "serve_failover_success_rate",
+        "value": round(rate_fo, 4),
+        "unit": "fraction",
+        "vs_baseline": round(rate_fo / max(rate_plain, 1e-9), 3),
+        "success_rate_no_failover": round(rate_plain, 4),
+        "p99_latency_ms_failover": round(
+            _percentile(lat_fo, 0.99) * 1000, 1),
+        "p99_latency_ms_no_failover": round(
+            _percentile(lat_plain, 0.99) * 1000, 1),
+        "requests": args.requests,
+        "kill_p": args.kill_p,
+    }))
+
+
+if __name__ == "__main__":
+    main()

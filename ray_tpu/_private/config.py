@@ -124,6 +124,22 @@ _D("serve_controller_threads", 64, int,
 _D("serve_backpressure_timeout_s", 60.0, float,
    "how long a handle waits for a replica under its "
    "max_concurrent_queries cap before raising TimeoutError")
+_D("serve_drain_deadline_s", 30.0, float,
+   "how long a DRAINING replica may finish its in-flight requests "
+   "before the controller force-kills it")
+_D("serve_queue_length", 128, int,
+   "default per-deployment admission queue bound: callers waiting for a "
+   "replica slot beyond this fast-fail with ServeOverloadedError "
+   "(0 = unbounded, legacy backpressure-wait behavior)")
+_D("serve_retry_after_hint_s", 1.0, float,
+   "retry-after hint carried by ServeOverloadedError when a request "
+   "is shed at the admission queue")
+_D("serve_request_deadline_s", 0.0, float,
+   "default end-to-end deadline for every serve request (admission + "
+   "execution + retries); 0 = none.  Per-call override: "
+   "handle.options(timeout_s=...)")
+_D("serve_failover_attempts", 2, int,
+   "max mid-stream failover resubmissions per streaming request")
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")
@@ -172,6 +188,15 @@ _D("chaos_ckpt_kill_salts", "", str,
    "checkpoint writer dies (see fault_injection.kill_ckpt_commit)")
 _D("chaos_ckpt_kill_at", 0, int,
    "save ordinal at which the scripted mid-save kill fires")
+_D("chaos_kill_replica", 0.0, float,
+   "probability a serve replica kills its process at a serve-plane "
+   "event (request dispatch or stream-chunk pull)")
+_D("chaos_kill_replica_salts", "", str,
+   "scripted replica kills: csv of worker spawn ordinals (or '*' for "
+   "any serve replica process) that die at their chaos_kill_replica_at-"
+   "th serve-plane event (see fault_injection.kill_replica)")
+_D("chaos_kill_replica_at", 0, int,
+   "serve-plane event index at which the scripted replica kill fires")
 
 
 GLOBAL_CONFIG = RayTpuConfig()

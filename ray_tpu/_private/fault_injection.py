@@ -168,6 +168,37 @@ class ChaosController:
             return False
         return self.should("proc", cfg.chaos_kill_worker, "kill")
 
+    def kill_replica(self) -> bool:
+        """Kill this serve replica process at a serve-plane event (a
+        request dispatch or a stream-chunk pull) — the mid-generation
+        death the serve failover path must absorb.
+
+        Same two modes as kill_worker: scripted
+        (`chaos_kill_replica_salts` lists worker spawn ordinals, or
+        ``*`` for "any serve replica process"; a listed replica dies at
+        its `chaos_kill_replica_at`-th serve event) or probabilistic
+        (`chaos_kill_replica` per event).  Unlike kill_worker, the
+        scripted mode DOES respect `chaos_max_faults`: with the ``*``
+        wildcard every replacement replica re-arms at the same event
+        ordinal, so the faults budget is what makes a scripted scenario
+        convergent."""
+        cfg = GLOBAL_CONFIG
+        salts = str(cfg.chaos_kill_replica_salts or "")
+        if salts:
+            listed = (salts.strip() == "*"
+                      or (self.salt and self.salt in
+                          [s.strip() for s in salts.split(",")]))
+            with self._lock:
+                n = self._next_index("serve")
+                if (listed and n == int(cfg.chaos_kill_replica_at)
+                        and not (self.max_faults
+                                 and self._faults >= self.max_faults)):
+                    self._faults += 1
+                    self.schedule.append(("serve", n, "kill"))
+                    return True
+            return False
+        return self.should("serve", cfg.chaos_kill_replica, "kill")
+
     def kill_hostd(self) -> bool:
         """Kill this node daemon at the next heartbeat."""
         return self.should(

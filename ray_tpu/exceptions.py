@@ -58,6 +58,45 @@ class ActorUnavailableError(ActorError):
     """The actor is restarting; the call may be retried."""
 
 
+class ServeOverloadedError(RayTpuError):
+    """Every replica of a deployment is saturated AND its bounded
+    admission queue is full: the request was shed instead of queued.
+
+    Carries a ``retry_after_s`` hint so well-behaved clients back off
+    instead of hammering an overloaded deployment (the serving-plane
+    analogue of HTTP 503 + Retry-After)."""
+
+    def __init__(self, deployment: str = "", retry_after_s: float = 1.0,
+                 queued: int = 0, limit: int = 0):
+        self.deployment = deployment
+        self.retry_after_s = float(retry_after_s)
+        self.queued = queued
+        self.limit = limit
+        super().__init__(
+            f"deployment {deployment!r} overloaded: {queued} request(s) "
+            f"already queued (limit {limit}); retry after "
+            f"{self.retry_after_s:g}s")
+
+    def __reduce__(self):
+        return (type(self), (self.deployment, self.retry_after_s,
+                             self.queued, self.limit))
+
+
+class ReplicaStreamLostError(RayTpuError):
+    """A serve replica no longer knows the requested stream id — it was
+    restarted (losing all in-progress generators) between two chunk
+    pulls.  The handle treats this exactly like replica death: heal and
+    resubmit under the stream's failover policy."""
+
+    def __init__(self, stream_id: int = 0):
+        self.stream_id = stream_id
+        super().__init__(
+            f"stream {stream_id} lost: replica restarted mid-stream")
+
+    def __reduce__(self):
+        return (type(self), (self.stream_id,))
+
+
 class ObjectLostError(RayTpuError):
     """An object was evicted/lost and could not be reconstructed."""
 
@@ -98,6 +137,8 @@ __all__ = [
     "ActorError",
     "ActorDiedError",
     "ActorUnavailableError",
+    "ServeOverloadedError",
+    "ReplicaStreamLostError",
     "ObjectLostError",
     "ObjectStoreFullError",
     "RuntimeEnvSetupError",

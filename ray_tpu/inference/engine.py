@@ -87,6 +87,12 @@ class _Request:
     eos_id: Optional[int] = None
     seed: int = 0
     out: "queue.Queue" = field(default_factory=queue.Queue)
+    # Sampling-counter base: a request resumed after a mid-stream
+    # failover re-prefills prompt+produced but must keep drawing from
+    # fold_in(seed, OVERALL position) to stay seed-consistent with the
+    # unfaulted run.
+    sample_offset: int = 0
+    deadline: Optional[float] = None   # monotonic; lane evicted past it
     fed: int = 0            # prompt tokens in the cache (prefilled OR reused)
     produced: int = 0
     last_token: int = 0
@@ -102,8 +108,17 @@ class GenerationHandle:
     """Streaming view of one request: iterate to receive token ids as
     the engine emits them (the serve stream-ticket path pulls these)."""
 
-    def __init__(self, req: _Request):
+    def __init__(self, req: _Request, engine: "InferenceEngine" = None):
         self._req = req
+        self._engine = engine
+
+    def cancel(self) -> bool:
+        """Abort the request: evict its engine lane (or dequeue it) and
+        unblock any consumer with end-of-stream.  Idempotent; False if
+        the request had already finished."""
+        if self._engine is None:
+            return False
+        return self._engine.cancel(self._req)
 
     def __iter__(self):
         return self
@@ -119,7 +134,9 @@ class GenerationHandle:
 
         `timeout` is an OVERALL deadline for the whole generation, not a
         per-token gap: if the request has not finished `timeout` seconds
-        from this call, TimeoutError is raised (never queue.Empty)."""
+        from this call, the request is CANCELLED (its lane evicted — a
+        vanished consumer must not leave the engine generating for
+        nobody) and TimeoutError is raised (never queue.Empty)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         out: List[int] = []
         while True:
@@ -128,12 +145,14 @@ class GenerationHandle:
             else:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    self.cancel()
                     raise TimeoutError(
                         f"generation did not finish within {timeout}s "
                         f"({len(out)} token(s) received)")
                 try:
                     item = self._req.out.get(timeout=remaining)
                 except queue.Empty:
+                    self.cancel()
                     raise TimeoutError(
                         f"generation did not finish within {timeout}s "
                         f"({len(out)} token(s) received)") from None
@@ -209,7 +228,8 @@ class InferenceEngine:
 
     def submit(self, prompt, max_new_tokens: int = 16, *,
                temperature: float = 0.0, eos_id: Optional[int] = None,
-               seed: Optional[int] = None) -> GenerationHandle:
+               seed: Optional[int] = None, sample_offset: int = 0,
+               deadline_s: Optional[float] = None) -> GenerationHandle:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -228,7 +248,10 @@ class InferenceEngine:
         req = _Request(rid=rid, prompt=prompt,
                        max_new_tokens=max_new_tokens,
                        temperature=temperature, eos_id=eos_id,
-                       seed=seed if seed is not None else self.seed + rid)
+                       seed=seed if seed is not None else self.seed + rid,
+                       sample_offset=int(sample_offset),
+                       deadline=(None if deadline_s is None
+                                 else time.monotonic() + deadline_s))
         with self._work:
             if self._stopped:
                 raise RuntimeError("engine is shut down")
@@ -236,7 +259,7 @@ class InferenceEngine:
             self._work.notify()
         if self._auto:
             self._ensure_thread()
-        return GenerationHandle(req)
+        return GenerationHandle(req, self)
 
     def generate(self, prompt, max_new_tokens: int = 16, *,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
@@ -248,6 +271,49 @@ class InferenceEngine:
             while self.step():
                 pass
         return h.tokens()
+
+    def cancel(self, req: "_Request") -> bool:
+        """Abort one request: dequeue it if still waiting, or evict its
+        lane (freeing the KV blocks) if live.  The consumer is unblocked
+        with end-of-stream; finish_reason becomes "cancelled".  False if
+        the request had already finished (idempotent)."""
+        with self._work:
+            try:
+                self._waiting.remove(req)
+            except ValueError:
+                pass
+            else:
+                req.finish_reason = "cancelled"
+                req.out.put(_DONE)
+                return True
+            for lane, r in enumerate(self._lanes):
+                if r is req:
+                    req.finish_reason = "cancelled"
+                    req.out.put(_DONE)
+                    self.cache.free_lane(lane)
+                    self._lanes[lane] = None
+                    return True
+        return False
+
+    def _expire_deadlines(self) -> None:
+        """Evict every lane (and drop every queued request) whose
+        deadline lapsed — the consumer is gone or has given up, so
+        spending decode steps on it only steals FLOPs from live lanes.
+        Caller holds the lock."""
+        now = time.monotonic()
+        for lane, req in enumerate(self._lanes):
+            if req is not None and req.deadline is not None \
+                    and now > req.deadline:
+                req.finish_reason = "deadline"
+                req.out.put(_DONE)
+                self.cache.free_lane(lane)
+                self._lanes[lane] = None
+        expired = [r for r in self._waiting
+                   if r.deadline is not None and now > r.deadline]
+        for req in expired:
+            self._waiting.remove(req)
+            req.finish_reason = "deadline"
+            req.out.put(_DONE)
 
     def shutdown(self) -> None:
         with self._work:
@@ -361,6 +427,7 @@ class InferenceEngine:
         steps (T=1 and T=prefill_chunk) so neither population pays the
         other's FLOP shape.  Returns False when fully idle."""
         with self._lock:
+            self._expire_deadlines()
             self._admit()
             live = [(i, r) for i, r in enumerate(self._lanes)
                     if r is not None]
@@ -412,7 +479,7 @@ class InferenceEngine:
             gather[lane] = chunk - 1
             temps[lane] = req.temperature
             seeds[lane] = req.seed & 0xFFFFFFFF
-            counters[lane] = req.produced
+            counters[lane] = req.produced + req.sample_offset
             sample = sample or req.temperature > 0
             chunks[lane] = chunk
             # Table entries must exist before the step writes K/V.
@@ -478,7 +545,7 @@ class InferenceEngine:
         finish + free lanes."""
         for lane, req in live:
             if self._lanes[lane] is not req:
-                continue  # shutdown() cleared the lane mid-step
+                continue  # shutdown()/cancel() cleared the lane mid-step
             if req.prefilling:
                 req.fed += chunks[lane]
                 self.cache.seq_lens[lane] += chunks[lane]

@@ -2,11 +2,17 @@
 
 Reference parity: python/ray/serve/ (SURVEY.md §2.3): controller actor with
 deployment reconciliation, replica actors hosting user callables, handle
-router with max_concurrent_queries backpressure + failure healing, HTTP
+router with power-of-two-choices routing, max_concurrent_queries
+backpressure, bounded-queue load shedding + failure healing, graceful
+replica draining, mid-stream failover, per-request deadlines, HTTP
 ingress proxy, deployment-graph composition via .bind(), @serve.batch
 dynamic batching.
 """
 
+from ray_tpu.exceptions import (  # noqa: F401
+    ReplicaStreamLostError,
+    ServeOverloadedError,
+)
 from ray_tpu.serve.api import (  # noqa: F401
     Application,
     Deployment,
@@ -20,5 +26,5 @@ from ray_tpu.serve.api import (  # noqa: F401
 )
 from ray_tpu.serve.asgi import ingress  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
-from ray_tpu.serve.llm import LLMDeployment  # noqa: F401
+from ray_tpu.serve.llm import LLMDeployment, llm_stream_resume  # noqa: F401
 from ray_tpu.serve._private import DeploymentHandle  # noqa: F401

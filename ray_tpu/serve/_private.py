@@ -2,16 +2,38 @@
 
 Reference parity: python/ray/serve/_private/ — ServeController
 (controller.py:71) reconciles DeploymentState (deployment_state.py:1006);
-replicas host user code (replica.py:268); Router round-robins with
+replicas host user code (replica.py:268); Router picks replicas with
 max_concurrent_queries backpressure (router.py:224); HTTPProxy is the
 ASGI ingress (http_proxy.py:434).  Config propagation here is pull-based
 with revalidation on failure (the reference uses long-poll; same
 eventual-consistency contract, no blocked actor threads).
+
+Graceful degradation (reference: serve's replica graceful_shutdown_* +
+DeploymentResponseGenerator retry semantics):
+
+- Replica lifecycle STARTING -> RUNNING -> DRAINING -> DEAD.  Downscale,
+  redeploy, delete and shutdown move victims to DRAINING: out of the
+  routing table immediately, killed only once ``ongoing_requests()``
+  quiesces or ``serve_drain_deadline_s`` lapses.
+- Mid-stream failover: ``DeploymentHandle.stream``/``stream_async``
+  record delivered chunks; on replica loss they heal the replica set and
+  resubmit under the handle's failover policy ("replay" skips already-
+  delivered chunks; a callable policy rewrites the request — the LLM
+  path appends produced tokens to the prompt so the prefix cache makes
+  re-prefill cheap and the resumed stream is token-exact).
+- Deadline propagation: a per-request deadline bounds admission waits,
+  travels to the replica (which aborts not-yet-started work and evicts
+  expired streams), and stops retries/failovers.
+- Load shedding: a bounded per-deployment admission queue fast-fails
+  with ServeOverloadedError (+ retry-after hint) instead of stacking
+  unbounded waiters, and ``_pick_replica`` is power-of-two-choices on
+  in-flight counts.
 """
 
 from __future__ import annotations
 
 import itertools
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -19,9 +41,75 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.exceptions import (
+    ActorDiedError, ActorUnavailableError, ReplicaStreamLostError,
+    ServeOverloadedError, TaskError)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
+
+# Replica lifecycle states (reference: serve ReplicaState).
+REPLICA_STARTING = "STARTING"
+REPLICA_RUNNING = "RUNNING"
+REPLICA_DRAINING = "DRAINING"
+REPLICA_DEAD = "DEAD"
+
+_SERVE_MET = None
+
+
+def _serve_metrics() -> dict:
+    global _SERVE_MET
+    if _SERVE_MET is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+        _SERVE_MET = {
+            "drained": Counter(
+                "serve_replicas_drained",
+                "Replicas retired after graceful draining"),
+            "drain_deadline_kills": Counter(
+                "serve_drain_deadline_kills",
+                "Draining replicas force-killed at the drain deadline"),
+            "draining": Gauge(
+                "serve_draining_replicas",
+                "Replicas currently in the DRAINING state"),
+            "shed": Counter(
+                "serve_requests_shed",
+                "Requests fast-failed with ServeOverloadedError at the "
+                "admission queue"),
+            "failovers": Counter(
+                "serve_stream_failovers",
+                "Streaming requests resubmitted after replica loss"),
+            "retries": Counter(
+                "serve_request_retries",
+                "Unary requests retried through a healed replica set"),
+        }
+    return _SERVE_MET
+
+
+def _is_replica_loss(e: BaseException) -> bool:
+    """True for errors that mean "the replica (or its stream state) is
+    gone" — the triggers for heal + resubmit.  A ReplicaStreamLostError
+    raised replica-side crosses the wire wrapped in TaskError, so the
+    traceback string is checked too."""
+    if isinstance(e, (ActorDiedError, ActorUnavailableError,
+                      ReplicaStreamLostError)):
+        return True
+    if isinstance(e, TaskError):
+        return "ReplicaStreamLostError" in (e.traceback_str or "")
+    return False
+
+
+def _chaos_kill_point() -> None:
+    """Serve-plane chaos interposition: a replica process draws one
+    deterministic kill verdict per serve event (request dispatch or
+    stream-chunk pull) — see fault_injection.kill_replica."""
+    from ray_tpu._private.fault_injection import get_chaos
+    chaos = get_chaos()
+    if chaos is not None and chaos.kill_replica():
+        import logging
+        import os
+        logging.getLogger("ray_tpu").warning(
+            "chaos: killing serve replica process")
+        os._exit(1)
 
 
 @dataclass
@@ -48,6 +136,10 @@ class DeploymentConfig:
     user_config: Any = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     version: int = 0
+    # Bound on requests WAITING for a replica slot (per deployment, per
+    # client process) before ServeOverloadedError sheds the excess.
+    # None = the serve_queue_length config default; 0 = unbounded.
+    queue_limit: Optional[int] = None
 
 
 @ray_tpu.remote
@@ -83,11 +175,23 @@ class ReplicaActor:
         # construction, replica-pinned by the router.
         self._streams: dict = {}
         self._stream_ids = itertools.count(1)
+        # sid -> absolute monotonic deadline (or None) for deadline
+        # enforcement between chunk pulls.
+        self._stream_deadlines: dict = {}
+        # Streams cancelled while their sync generator was mid-pull on
+        # the thread pool (generators cannot be closed while running);
+        # the in-flight next_chunk closes them once the pull returns.
+        self._cancelled: set = set()
+        # method name -> whether its signature accepts `_deadline_s`
+        # (deadline-aware deployments get the remaining budget passed in).
+        self._deadline_aware: dict = {}
 
     async def handle_request(self, method_name, args, kwargs,
-                             stream: bool = False):
+                             stream: bool = False,
+                             deadline_s: Optional[float] = None):
         import asyncio
         import inspect
+        _chaos_kill_point()
         self._ongoing += 1  # loop-thread only: no lock needed
         try:
             target = self._callable
@@ -96,6 +200,27 @@ class ReplicaActor:
             elif not callable(target):
                 raise TypeError("deployment object is not callable")
             kwargs = kwargs or {}
+            deadline = None
+            if deadline_s is not None:
+                if deadline_s <= 0:
+                    # Already past the request deadline before any work
+                    # started: abort pre-dispatch instead of burning a
+                    # replica slot on a result nobody will wait for.
+                    raise TimeoutError(
+                        f"request deadline exceeded before "
+                        f"{method_name or '__call__'!r} started")
+                deadline = time.monotonic() + deadline_s
+                mname = method_name or "__call__"
+                aware = self._deadline_aware.get(mname)
+                if aware is None:
+                    try:
+                        aware = ("_deadline_s"
+                                 in inspect.signature(target).parameters)
+                    except (TypeError, ValueError):
+                        aware = False
+                    self._deadline_aware[mname] = aware
+                if aware:
+                    kwargs["_deadline_s"] = deadline_s
             if inspect.isasyncgenfunction(target) or inspect.isgeneratorfunction(target):
                 if not stream:
                     # Non-streaming caller (handle.remote(), plain HTTP
@@ -114,6 +239,7 @@ class ReplicaActor:
                 gen = target(*args, **kwargs)
                 sid = next(self._stream_ids)
                 self._streams[sid] = gen
+                self._stream_deadlines[sid] = deadline
                 self._ongoing += 1   # held until stream end
                 return {"__serve_stream__": sid}
             if inspect.iscoroutinefunction(target) or (
@@ -135,12 +261,25 @@ class ReplicaActor:
     async def next_chunk(self, sid: int):
         """Pull ONE chunk of stream `sid`: {"chunk": value} or
         {"done": True}.  Sync generators advance on the thread pool so
-        they cannot stall the replica loop."""
+        they cannot stall the replica loop.  An UNKNOWN sid means this
+        replica restarted and lost its in-memory streams — raise
+        ReplicaStreamLostError so the handle fails over instead of
+        silently truncating the stream with a fake "done"."""
         import asyncio
         import inspect
+        _chaos_kill_point()
         gen = self._streams.get(sid)
         if gen is None:
-            return {"done": True}
+            raise ReplicaStreamLostError(sid)
+        deadline = self._stream_deadlines.get(sid)
+        if deadline is not None and time.monotonic() > deadline:
+            # Past the request deadline: abort replica-side — closing
+            # the generator runs its cleanup (the LLM path cancels its
+            # GenerationHandle on GeneratorExit, evicting the engine
+            # lane) even if the consumer has already given up.
+            await self.cancel_stream(sid)
+            raise TimeoutError(
+                f"stream {sid}: request deadline exceeded")
         try:
             if inspect.isasyncgen(gen):
                 chunk = await gen.__anext__()
@@ -155,6 +294,15 @@ class ReplicaActor:
                 loop = asyncio.get_running_loop()
                 alive, chunk = await loop.run_in_executor(self._pool,
                                                           _pull)
+                if sid in self._cancelled:
+                    # cancel_stream caught this generator mid-pull and
+                    # could not close it; it is suspended now.
+                    self._cancelled.discard(sid)
+                    try:
+                        gen.close()
+                    except Exception:
+                        pass
+                    return {"done": True}
                 if not alive:
                     self._finish_stream(sid)
                     return {"done": True}
@@ -174,12 +322,18 @@ class ReplicaActor:
                     await gen.aclose()
                 else:
                     gen.close()
+            except ValueError:
+                # Sync generator currently executing on the thread pool:
+                # close() is illegal mid-frame.  Tombstone the sid; the
+                # in-flight next_chunk closes it when the pull returns.
+                self._cancelled.add(sid)
             except Exception:
                 pass
             self._finish_stream(sid)
         return True
 
     def _finish_stream(self, sid: int) -> None:
+        self._stream_deadlines.pop(sid, None)
         if self._streams.pop(sid, None) is not None:
             self._ongoing -= 1
 
@@ -215,6 +369,12 @@ class ServeController:
         self._stopped = False
         # name -> (desired_replicas, since_monotonic) scale intent
         self._scale_intent: Dict[str, tuple] = {}
+        # Graceful-drain records, appended whenever a replica leaves the
+        # routing table with work possibly in flight:
+        # {"name", "replica", "since", "deadline", "zero_streak"}
+        self._draining: List[dict] = []
+        self._drained_total = 0
+        self._drain_deadline_kills = 0
 
     def _bump_version(self):
         with self._version_cv:
@@ -252,8 +412,95 @@ class ServeController:
                 self._autoscale_pass()
             except Exception:
                 pass
+            try:
+                self._drain_pass()
+            except Exception:
+                pass
             time.sleep(interval_s)
         return True
+
+    # ---------------- graceful draining ----------------
+
+    def _drain_replica(self, name: str, replica) -> None:
+        """Move one replica to DRAINING: the caller has already removed
+        it from the routing table; it keeps serving its in-flight
+        requests and streams, and _drain_pass kills it only once
+        ongoing_requests() quiesces (or the drain deadline lapses)."""
+        key = replica._actor_id.binary()
+        rec = {"name": name, "replica": replica,
+               "since": time.monotonic(),
+               "deadline": (time.monotonic()
+                            + GLOBAL_CONFIG.serve_drain_deadline_s),
+               "zero_streak": 0}
+        with self._lock:
+            if any(r["replica"]._actor_id.binary() == key
+                   for r in self._draining):
+                return  # already draining (reconcile/delete race)
+            self._draining.append(rec)
+            n = len(self._draining)
+        _serve_metrics()["draining"].set(n)
+
+    def _drain_pass(self, immediate: bool = False) -> int:
+        """One sweep over DRAINING replicas: fan out ongoing_requests()
+        probes, kill every replica that has quiesced or whose drain
+        deadline lapsed, and return how many are still draining.
+
+        Quiescence needs TWO consecutive zero observations — a single
+        zero can race a request dispatched by a router that has not yet
+        seen the post-drain routing table.  `immediate` (the shutdown
+        path) kills on the first zero."""
+        with self._lock:
+            records = list(self._draining)
+        if not records:
+            return 0
+        refs = [r["replica"].ongoing_requests.remote() for r in records]
+        try:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=5)
+        except Exception:
+            ready = []
+        ready_ids = {ref.id for ref in ready}
+        now = time.monotonic()
+        met = _serve_metrics()
+        for rec, ref in zip(records, refs):
+            kill = dead = False
+            if ref.id in ready_ids:
+                try:
+                    ongoing = ray_tpu.get(ref, timeout=5)
+                except Exception:
+                    dead = True  # died on its own: nothing left to drain
+                else:
+                    if ongoing <= 0:
+                        rec["zero_streak"] += 1
+                        if immediate or rec["zero_streak"] >= 2:
+                            kill = True
+                    else:
+                        rec["zero_streak"] = 0
+            if not dead and not kill and now >= rec["deadline"]:
+                kill = True
+                met["drain_deadline_kills"].inc()
+                self._drain_deadline_kills += 1
+            if not (kill or dead):
+                continue
+            if kill:
+                try:
+                    ray_tpu.kill(rec["replica"])
+                except Exception:
+                    pass
+            with self._lock:
+                if rec in self._draining:
+                    self._draining.remove(rec)
+                    self._drained_total += 1
+            met["drained"].inc()
+        with self._lock:
+            remaining = len(self._draining)
+        met["draining"].set(remaining)
+        return remaining
+
+    def drain_stats(self):
+        with self._lock:
+            return {"draining": len(self._draining),
+                    "drained_total": self._drained_total,
+                    "deadline_kills": self._drain_deadline_kills}
 
     def _autoscale_pass(self):
         with self._lock:
@@ -344,25 +591,48 @@ class ServeController:
                 replicas = list(entry["replicas"])
                 def_version = entry.setdefault("def_version", 0)
                 vers = dict(entry.setdefault("replica_vers", {}))
-            # ---- unlocked: health checks / kills / constructions ----
-            alive = []
+            # ---- unlocked: health checks / drains / constructions ----
+            to_drain = []  # leave routing now, die only after quiescing
+            candidates = []
             for r in replicas:
                 key = r._actor_id.binary()
                 if vers.get(key, def_version) != def_version:
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:
-                        pass
+                    # Stale code/config version: DRAIN, don't hard-kill —
+                    # requests in flight on the old version finish
+                    # (reference: rolling version replacement +
+                    # graceful_shutdown_wait_loop_s).
                     vers.pop(key, None)
+                    to_drain.append(r)
                     continue
+                candidates.append(r)
+            # Health sweep: fan the pings out and collect them with one
+            # bounded wait() instead of serial 10s-timeout gets (N dead
+            # replicas used to cost N*10s of controller stall).
+            ping_refs = [r.ping.remote() for r in candidates]
+            ready_ids = set()
+            if ping_refs:
                 try:
-                    ray_tpu.get(r.ping.remote(), timeout=10)
-                    alive.append(r)
+                    ready, _ = ray_tpu.wait(
+                        ping_refs, num_returns=len(ping_refs), timeout=10)
+                    ready_ids = {ref.id for ref in ready}
                 except Exception:
-                    vers.pop(key, None)
-            replicas = alive
+                    pass
+            replicas = []
+            for r, ref in zip(candidates, ping_refs):
+                ok = False
+                if ref.id in ready_ids:
+                    try:
+                        ray_tpu.get(ref, timeout=10)
+                        ok = True
+                    except Exception:
+                        ok = False
+                if ok:
+                    replicas.append(r)
+                else:
+                    vers.pop(r._actor_id.binary(), None)
             opts = dict(config.ray_actor_options)
-            while len(replicas) < config.num_replicas:
+            started = []
+            while len(replicas) + len(started) < config.num_replicas:
                 actor = ReplicaActor.options(
                     num_cpus=opts.get("num_cpus", 0.1),
                     num_tpus=opts.get("num_tpus"),
@@ -374,23 +644,34 @@ class ServeController:
                     max_concurrency=config.max_concurrent_queries,
                 ).remote(cls_or_fn, args, kwargs, config.user_config,
                          config.max_concurrent_queries)
-                replicas.append(actor)
+                started.append(actor)
                 vers[actor._actor_id.binary()] = def_version
             while len(replicas) > config.num_replicas:
+                # Downscale: victims drain instead of dropping their
+                # in-flight requests on the floor.
                 victim = replicas.pop()
                 vers.pop(victim._actor_id.binary(), None)
+                to_drain.append(victim)
+            # Verify new replicas constructed (surface user __init__
+            # errors) before committing them to the routing table; fan
+            # out first so N cold starts overlap.
+            verify = [r.ping.remote() for r in started]
+            if verify:
                 try:
-                    ray_tpu.kill(victim)
+                    ray_tpu.wait(verify, num_returns=len(verify),
+                                 timeout=120)
                 except Exception:
                     pass
-            # Verify new replicas constructed (surface user __init__
-            # errors) before committing them to the routing table.
-            for r in replicas:
-                ray_tpu.get(r.ping.remote(), timeout=120)
+            for ref in verify:
+                ray_tpu.get(ref, timeout=120)
+            replicas.extend(started)
             with self._lock:
                 entry = self._deployments.get(name)
                 if entry is None:
-                    for r in replicas:
+                    # Deployment deleted concurrently: its old replicas
+                    # are already draining via delete_deployment; the
+                    # freshly-started ones never served and die now.
+                    for r in started:
                         try:
                             ray_tpu.kill(r)
                         except Exception:
@@ -398,6 +679,10 @@ class ServeController:
                     return
                 entry["replicas"][:] = replicas
                 entry["replica_vers"] = vers
+                entry["states"] = {r._actor_id.binary(): REPLICA_RUNNING
+                                   for r in replicas}
+            for victim in to_drain:
+                self._drain_replica(name, victim)
 
     def get_routing(self, name: str):
         with self._lock:
@@ -407,25 +692,35 @@ class ServeController:
             return {"replicas": list(entry["replicas"]),
                     "max_concurrent_queries":
                         entry["config"].max_concurrent_queries,
+                    "queue_limit": entry["config"].queue_limit,
                     "version": self._version}
 
     def list_deployments(self):
         with self._lock:
-            return {name: {"num_replicas": len(e["replicas"]),
-                           "target": e["config"].num_replicas}
-                    for name, e in self._deployments.items()}
+            draining: Dict[str, int] = {}
+            for rec in self._draining:
+                draining[rec["name"]] = draining.get(rec["name"], 0) + 1
+            out = {}
+            for name, e in self._deployments.items():
+                states: Dict[str, int] = {}
+                for s in e.get("states", {}).values():
+                    states[s] = states.get(s, 0) + 1
+                states[REPLICA_DRAINING] = draining.get(name, 0)
+                out[name] = {"num_replicas": len(e["replicas"]),
+                             "target": e["config"].num_replicas,
+                             "states": states}
+            return out
 
     def delete_deployment(self, name: str):
         with self._lock:
             entry = self._deployments.pop(name, None)
         if entry is None:
             return False
-        for r in entry["replicas"]:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+        # Out of the routing table NOW; replicas finish their in-flight
+        # work and are reaped by the drain pump (or the drain deadline).
         self._bump_version()
+        for r in entry["replicas"]:
+            self._drain_replica(name, r)
         return True
 
     def heal(self, name: str):
@@ -440,6 +735,21 @@ class ServeController:
             self._version_cv.notify_all()
         for name in list(self._deployments):
             self.delete_deployment(name)
+        # Synchronous graceful drain: in-flight requests get until the
+        # drain deadline; whatever remains is force-killed so shutdown
+        # always terminates.
+        deadline = time.monotonic() + GLOBAL_CONFIG.serve_drain_deadline_s
+        while (self._drain_pass(immediate=True)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        with self._lock:
+            leftovers = list(self._draining)
+            self._draining.clear()
+        for rec in leftovers:
+            try:
+                ray_tpu.kill(rec["replica"])
+            except Exception:
+                pass
         return True
 
 
@@ -458,6 +768,10 @@ class _RouterState:
         self.rr = 0
         # In-flight counts keyed by stable replica identity (actor id).
         self.in_flight: Dict[bytes, int] = {}
+        # Requests waiting for a replica slot (the bounded admission
+        # queue load shedding is measured against).
+        self.pending = 0
+        self.queue_limit: Optional[int] = None
         self.fetched_at = 0.0
         self.known_version = -1
         self.poller: Optional[threading.Thread] = None
@@ -512,18 +826,44 @@ def _get_router_state(name: str) -> _RouterState:
         return st
 
 
-class DeploymentHandle:
-    """Client-side handle with round-robin + in-flight cap (reference:
-    handle.py over router.py:224-263).  Picklable: travels to replicas so
-    deployments can compose.  Routing state is shared per deployment."""
+_UNSET = object()
 
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+
+class DeploymentHandle:
+    """Client-side handle with power-of-two-choices routing + in-flight
+    cap (reference: handle.py over router.py:224-263).  Picklable:
+    travels to replicas so deployments can compose.  Routing state is
+    shared per deployment.
+
+    Per-handle request options (set via .options()):
+
+    - ``timeout_s``: request deadline.  Bounds admission waits, travels
+      to the replica (which aborts not-yet-started work and evicts
+      expired streams), and stops retries/failovers.  Defaults to the
+      ``serve_request_deadline_s`` config (0 = none).
+    - ``failover``: mid-stream failover policy for stream()/
+      stream_async().  None (default) surfaces replica loss to the
+      caller; ``"replay"`` resubmits the original request and skips
+      already-delivered chunks (requires a deterministic stream); a
+      callable ``policy(args, kwargs, received) -> (args, kwargs) |
+      None`` rewrites the request to resume where the dead replica
+      stopped (None = the stream was already complete)."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 timeout_s: Optional[float] = None, failover=None):
         self._name = deployment_name
         self._method = method_name
+        self._timeout_s = timeout_s
+        self._failover = failover
         self._state = _get_router_state(deployment_name)
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._name, method_name)
+    def options(self, method_name: Optional[str] = None, *,
+                timeout_s=_UNSET, failover=_UNSET) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._name,
+            method_name if method_name is not None else self._method,
+            self._timeout_s if timeout_s is _UNSET else timeout_s,
+            self._failover if failover is _UNSET else failover)
 
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -535,6 +875,7 @@ class DeploymentHandle:
         with st.lock:
             st.replicas = routing["replicas"]
             st.max_q = routing["max_concurrent_queries"]
+            st.queue_limit = routing.get("queue_limit")
             st.known_version = routing.get("version", -1)
             st.fetched_at = time.monotonic()
             alive = {r._actor_id.binary() for r in st.replicas}
@@ -593,13 +934,39 @@ class DeploymentHandle:
         return self._call(self._method, args, kwargs)
 
     def _pick_replica(self):
-        """One routing decision under the in-flight cap; returns
-        (replica, key) or None when every replica is saturated."""
+        """One routing decision under the in-flight cap: power-of-two-
+        choices on in-flight counts (reference: router.py's least-loaded
+        two-candidate sampling), ties rotated round-robin so idle
+        replicas still share traffic.  If both sampled replicas are
+        saturated, scan the rest — admission must succeed whenever ANY
+        replica is under its cap.  Returns (replica, key) or None when
+        every replica is saturated."""
         st = self._state
         with st.lock:
             n = len(st.replicas)
-            order = [(st.rr + i) % n for i in range(n)] if n else []
+            if n == 0:
+                return None
             st.rr += 1
+            if n == 1:
+                order = [0]
+            else:
+                i = random.randrange(n)
+                j = random.randrange(n - 1)
+                if j >= i:
+                    j += 1
+                fi = st.in_flight.get(st.replicas[i]._actor_id.binary(), 0)
+                fj = st.in_flight.get(st.replicas[j]._actor_id.binary(), 0)
+                if fi == fj:
+                    # Tie (the common idle case): deterministic round-
+                    # robin, so even a short sequential burst provably
+                    # spreads across replicas.
+                    start = st.rr % n
+                    order = [(start + k) % n for k in range(n)]
+                else:
+                    if fj < fi:
+                        i, j = j, i
+                    order = ([i, j]
+                             + [k for k in range(n) if k not in (i, j)])
             for idx in order:
                 key = st.replicas[idx]._actor_id.binary()
                 if st.in_flight.get(key, 0) < st.max_q:
@@ -607,47 +974,158 @@ class DeploymentHandle:
                     return st.replicas[idx], key
         return None
 
+    # ---------------- admission: bounded queue + shedding ----------------
+
+    def _request_deadline(self) -> Optional[float]:
+        t = self._timeout_s
+        if t is None:
+            cfg = GLOBAL_CONFIG.serve_request_deadline_s
+            t = cfg if cfg and cfg > 0 else None
+        return None if t is None else time.monotonic() + t
+
+    def _admission_enter(self) -> None:
+        """Count this request as queued; shed it with
+        ServeOverloadedError if the bounded per-deployment queue is
+        already full (graceful overload degradation: a fast, actionable
+        failure instead of an unbounded pile-up of waiters)."""
+        st = self._state
+        with st.lock:
+            limit = st.queue_limit
+            if limit is None:
+                limit = GLOBAL_CONFIG.serve_queue_length
+            if limit and st.pending >= limit:
+                _serve_metrics()["shed"].inc()
+                raise ServeOverloadedError(
+                    self._name, GLOBAL_CONFIG.serve_retry_after_hint_s,
+                    st.pending, limit)
+            st.pending += 1
+
+    def _admission_exit(self) -> None:
+        st = self._state
+        with st.lock:
+            st.pending = max(0, st.pending - 1)
+
+    def _wait_deadline(self, deadline: Optional[float]) -> float:
+        limit = time.monotonic() + GLOBAL_CONFIG.serve_backpressure_timeout_s
+        return limit if deadline is None else min(limit, deadline)
+
+    def _acquire_replica(self, deadline: Optional[float]):
+        """Admit one request: pick a replica under its cap, else wait in
+        the bounded queue until one frees up, the backpressure window
+        closes, or the request deadline passes."""
+        pick = self._pick_replica()
+        if pick is not None:
+            return pick
+        self._admission_enter()
+        try:
+            limit = self._wait_deadline(deadline)
+            while True:
+                pick = self._pick_replica()
+                if pick is not None:
+                    return pick
+                if time.monotonic() > limit:
+                    raise TimeoutError(
+                        f"no replica of {self._name!r} under its "
+                        f"max_concurrent_queries cap before the deadline")
+                time.sleep(0.01)  # every replica saturated: backpressure
+        finally:
+            self._admission_exit()
+
+    async def _acquire_replica_async(self, deadline: Optional[float]):
+        import asyncio
+        pick = self._pick_replica()
+        if pick is not None:
+            return pick
+        self._admission_enter()
+        try:
+            limit = self._wait_deadline(deadline)
+            while True:
+                pick = self._pick_replica()
+                if pick is not None:
+                    return pick
+                if time.monotonic() > limit:
+                    raise TimeoutError(
+                        f"no replica of {self._name!r} under its "
+                        f"max_concurrent_queries cap before the deadline")
+                await asyncio.sleep(0.005)
+        finally:
+            self._admission_exit()
+
+    @staticmethod
+    def _remaining(deadline: Optional[float]) -> Optional[float]:
+        """Deadline budget left, as handle_request's deadline_s arg."""
+        return None if deadline is None else deadline - time.monotonic()
+
+    @staticmethod
+    def _step_timeout(deadline: Optional[float]) -> float:
+        """Per-RPC timeout for one stream step, clipped to the request
+        deadline so an expired request stops waiting promptly."""
+        if deadline is None:
+            return 60.0
+        return max(0.1, min(60.0, deadline - time.monotonic()))
+
     def _call(self, method, args, kwargs):
         self._refresh()
-        wait_s = GLOBAL_CONFIG.serve_backpressure_timeout_s
-        deadline = time.monotonic() + wait_s
-        while True:
-            pick = self._pick_replica()
-            if pick is not None:
-                replica, key = pick
-                ref = replica.handle_request.remote(method, args, kwargs)
-                return _TrackedRef(ref, self, key, method, args, kwargs)
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica of {self._name!r} under its "
-                    f"max_concurrent_queries cap within {wait_s:g}s")
-            time.sleep(0.01)  # every replica saturated: backpressure
+        deadline = self._request_deadline()
+        replica, key = self._acquire_replica(deadline)
+        ref = replica.handle_request.remote(
+            method, args, kwargs, False, self._remaining(deadline))
+        return _TrackedRef(ref, self, key, method, args, kwargs,
+                           deadline=deadline)
 
     def stream(self, *args, **kwargs):
         """Synchronous streaming call: yields the chunks of a generator
         (or async-generator) deployment method INCREMENTALLY — each
         chunk is pulled from the replica on demand (reference: streaming
         DeploymentResponseGenerator over handle_request_streaming).
-        Replica-pinned: every chunk comes from the replica that started
-        the stream."""
-        self._refresh()
-        wait_s = GLOBAL_CONFIG.serve_backpressure_timeout_s
-        deadline = time.monotonic() + wait_s
+        Each attempt is replica-pinned; if the replica dies mid-stream
+        and this handle has a failover policy, the replica set is healed
+        and the request resubmitted (see the class docstring)."""
+        policy = self._failover
+        deadline = self._request_deadline()
+        received: List[Any] = []
+        cur_args, cur_kwargs = args, dict(kwargs)
+        skip = 0
+        attempts = 0
         while True:
-            pick = self._pick_replica()
-            if pick is not None:
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica of {self._name!r} under its "
-                    f"max_concurrent_queries cap within {wait_s:g}s")
-            time.sleep(0.01)
-        replica, key = pick
-        try:
-            req_ref = replica.handle_request.remote(self._method, args,
-                                                    kwargs, True)
             try:
-                ticket = ray_tpu.get(req_ref, timeout=60)
+                for chunk in self._stream_once(cur_args, cur_kwargs,
+                                               skip, deadline):
+                    received.append(chunk)
+                    yield chunk
+                return
+            except BaseException as e:
+                if policy is None or not _is_replica_loss(e):
+                    raise
+                attempts += 1
+                if attempts > GLOBAL_CONFIG.serve_failover_attempts:
+                    raise
+                if deadline is not None and time.monotonic() > deadline:
+                    raise
+                _serve_metrics()["failovers"].inc()
+                self._on_replica_error()
+                if callable(policy):
+                    resumed = policy(args, dict(kwargs), list(received))
+                    if resumed is None:
+                        return  # policy says the stream was complete
+                    cur_args, cur_kwargs = resumed
+                    skip = 0
+                else:  # "replay": rerun, swallow already-seen chunks
+                    cur_args, cur_kwargs = args, dict(kwargs)
+                    skip = len(received)
+
+    def _stream_once(self, args, kwargs, skip: int,
+                     deadline: Optional[float]):
+        """One replica-pinned streaming attempt; the first `skip` chunks
+        are swallowed (already delivered by a previous attempt)."""
+        self._refresh()
+        replica, key = self._acquire_replica(deadline)
+        try:
+            req_ref = replica.handle_request.remote(
+                self._method, args, kwargs, True, self._remaining(deadline))
+            try:
+                ticket = ray_tpu.get(req_ref,
+                                     timeout=self._step_timeout(deadline))
             except BaseException:
                 # The replica may still complete the call and register a
                 # stream whose sid we never learned — reap it so the
@@ -657,15 +1135,19 @@ class DeploymentHandle:
             if not (isinstance(ticket, dict)
                     and "__serve_stream__" in ticket):
                 # Non-generator method: degrade to a one-item stream.
-                yield ticket
+                if skip <= 0:
+                    yield ticket
                 return
             sid = ticket["__serve_stream__"]
             try:
                 while True:
                     out = ray_tpu.get(replica.next_chunk.remote(sid),
-                                      timeout=60)
+                                      timeout=self._step_timeout(deadline))
                     if out.get("done"):
                         return
+                    if skip > 0:
+                        skip -= 1
+                        continue
                     yield out["chunk"]
             except BaseException:
                 # Any abandonment (consumer close, get timeout, worker
@@ -682,44 +1164,83 @@ class DeploymentHandle:
     async def stream_async(self, method, args, kwargs, *,
                            timeout: float = 60.0):
         """Async streaming variant (the proxy's path): an async
-        generator over the method's chunks."""
-        import asyncio
-        self._refresh()
-        deadline = time.monotonic() + timeout
+        generator over the method's chunks, with the same failover
+        semantics as stream()."""
+        policy = self._failover
+        deadline = self._request_deadline()
+        received: List[Any] = []
+        cur_args, cur_kwargs = args, dict(kwargs or {})
+        skip = 0
+        attempts = 0
         while True:
-            pick = self._pick_replica()
-            if pick is not None:
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica of {self._name!r} under its "
-                    f"max_concurrent_queries cap within {timeout}s")
-            await asyncio.sleep(0.005)
-        replica, key = pick
+            try:
+                agen = self._stream_once_async(
+                    method, cur_args, cur_kwargs, skip, deadline, timeout)
+                async for chunk in agen:
+                    received.append(chunk)
+                    yield chunk
+                return
+            except BaseException as e:
+                if policy is None or not _is_replica_loss(e):
+                    raise
+                attempts += 1
+                if attempts > GLOBAL_CONFIG.serve_failover_attempts:
+                    raise
+                if deadline is not None and time.monotonic() > deadline:
+                    raise
+                _serve_metrics()["failovers"].inc()
+                self._on_replica_error()
+                if callable(policy):
+                    resumed = policy(args, dict(kwargs or {}),
+                                     list(received))
+                    if resumed is None:
+                        return
+                    cur_args, cur_kwargs = resumed
+                    skip = 0
+                else:
+                    cur_args, cur_kwargs = args, dict(kwargs or {})
+                    skip = len(received)
+
+    async def _stream_once_async(self, method, args, kwargs, skip: int,
+                                 deadline: Optional[float],
+                                 timeout: float):
+        import asyncio
+
+        def _step(base):
+            return (base if deadline is None
+                    else max(0.1, min(base, deadline - time.monotonic())))
+
+        self._refresh()
+        replica, key = await self._acquire_replica_async(deadline)
         try:
             # Per-step timeout: a wedged generator must not hold this
             # coroutine (and the in-flight slot) forever — mirror the
             # sync stream()'s bounded gets.
-            req_ref = replica.handle_request.remote(method, args, kwargs,
-                                                    True)
+            req_ref = replica.handle_request.remote(
+                method, args, kwargs, True, self._remaining(deadline))
             try:
                 ticket = await asyncio.wait_for(
-                    asyncio.wrap_future(req_ref.future()), timeout)
+                    asyncio.wrap_future(req_ref.future()), _step(timeout))
             except BaseException:
                 # Unknown-sid orphan (see stream()): reap off-loop.
                 _reap_orphan_stream(replica, req_ref)
                 raise
             if not (isinstance(ticket, dict)
                     and "__serve_stream__" in ticket):
-                yield ticket
+                if skip <= 0:
+                    yield ticket
                 return
             sid = ticket["__serve_stream__"]
             try:
                 while True:
                     out = await asyncio.wait_for(asyncio.wrap_future(
-                        replica.next_chunk.remote(sid).future()), timeout)
+                        replica.next_chunk.remote(sid).future()),
+                        _step(timeout))
                     if out.get("done"):
                         return
+                    if skip > 0:
+                        skip -= 1
+                        continue
                     yield out["chunk"]
             except BaseException:
                 # Same slot-release contract as the sync stream().
@@ -738,21 +1259,14 @@ class DeploymentHandle:
         router/replica without burning a thread per request)."""
         import asyncio
 
-        from ray_tpu.exceptions import ActorDiedError
-
-        self._refresh()
+        req_deadline = self._request_deadline()
         deadline = time.monotonic() + timeout
-        while True:
-            pick = self._pick_replica()
-            if pick is not None:
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica of {self._name!r} under its "
-                    f"max_concurrent_queries cap within {timeout}s")
-            await asyncio.sleep(0.005)
-        replica, key = pick
-        ref = replica.handle_request.remote(method, args, kwargs)
+        if req_deadline is not None:
+            deadline = min(deadline, req_deadline)
+        self._refresh()
+        replica, key = await self._acquire_replica_async(deadline)
+        ref = replica.handle_request.remote(
+            method, args, kwargs, False, deadline - time.monotonic())
         released = False
 
         def release(_=None):
@@ -777,8 +1291,10 @@ class DeploymentHandle:
             return result
         except ActorDiedError:
             release()
-            if _retried:
+            if _retried or (req_deadline is not None
+                            and time.monotonic() > req_deadline):
                 raise
+            _serve_metrics()["retries"].inc()
             self._on_replica_error()
             return await self.call_async(
                 method, args, kwargs,
@@ -805,7 +1321,9 @@ class DeploymentHandle:
         self._refresh(force=True)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, self._method))
+        # failover callables must be module-level (picklable) to travel.
+        return (DeploymentHandle, (self._name, self._method,
+                                   self._timeout_s, self._failover))
 
 
 class _MethodCaller:
@@ -819,15 +1337,18 @@ class _MethodCaller:
 
 class _TrackedRef:
     """Wraps the reply ref to release the in-flight slot on result() and
-    retry once through a healed replica set on replica death."""
+    retry once through a healed replica set on replica death (never past
+    the request deadline)."""
 
     def __init__(self, ref, handle: DeploymentHandle, key: bytes,
-                 method: str, args, kwargs, retried: bool = False):
+                 method: str, args, kwargs, retried: bool = False,
+                 deadline: Optional[float] = None):
         self._ref = ref
         self._handle = handle
         self._idx = key
         self._request = (method, args, kwargs)
         self._retried = retried
+        self._deadline = deadline
 
     def result(self, timeout: Optional[float] = None):
         from ray_tpu.exceptions import ActorDiedError, RayTpuTimeoutError
@@ -835,8 +1356,10 @@ class _TrackedRef:
             value = ray_tpu.get(self._ref, timeout=timeout)
         except ActorDiedError:
             self._handle._done(self._idx)
-            if self._retried:
+            if self._retried or (self._deadline is not None
+                                 and time.monotonic() > self._deadline):
                 raise
+            _serve_metrics()["retries"].inc()
             self._handle._on_replica_error()
             method, args, kwargs = self._request
             retry = self._handle._call(method, args, kwargs)

@@ -67,13 +67,16 @@ class Deployment:
                 max_concurrent_queries: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
                 user_config: Any = None,
-                autoscaling_config: Optional[dict] = None) -> "Deployment":
+                autoscaling_config: Optional[dict] = None,
+                queue_limit: Optional[int] = None) -> "Deployment":
         import copy
         cfg = copy.deepcopy(self._config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_concurrent_queries is not None:
             cfg.max_concurrent_queries = max_concurrent_queries
+        if queue_limit is not None:
+            cfg.queue_limit = queue_limit
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
         if user_config is not None:
@@ -95,8 +98,14 @@ def deployment(_cls_or_fn=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 100,
                ray_actor_options: Optional[dict] = None,
                user_config: Any = None,
-               autoscaling_config: Optional[dict] = None):
-    """@serve.deployment decorator."""
+               autoscaling_config: Optional[dict] = None,
+               queue_limit: Optional[int] = None):
+    """@serve.deployment decorator.
+
+    `queue_limit` bounds how many requests may WAIT for a replica slot
+    (per deployment, per client process) before the router sheds new
+    arrivals with ServeOverloadedError; None uses the
+    ``serve_queue_length`` config default, 0 disables shedding."""
 
     def wrap(cls_or_fn):
         dep_name = name or getattr(cls_or_fn, "__name__", "deployment")
@@ -110,7 +119,8 @@ def deployment(_cls_or_fn=None, *, name: Optional[str] = None,
             max_concurrent_queries=max_concurrent_queries,
             ray_actor_options=dict(ray_actor_options or {}),
             user_config=user_config,
-            autoscaling_config=auto)
+            autoscaling_config=auto,
+            queue_limit=queue_limit)
         return Deployment(cls_or_fn, dep_name, cfg)
 
     return wrap(_cls_or_fn) if _cls_or_fn is not None else wrap

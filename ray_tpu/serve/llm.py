@@ -6,11 +6,57 @@ requests batch onto the same jitted decode step instead of running the
 model once per request; tokens flow back through the existing serve
 stream-ticket path (`handle.options("generate").stream(...)` pulls them
 incrementally, replica-pinned).
+
+Mid-stream failover: pair the handle with the `llm_stream_resume`
+policy (``handle.options("generate", failover=llm_stream_resume)``) and
+a replica death mid-generation is absorbed by resubmitting with the
+already-produced tokens appended to the prompt.  The prefix cache makes
+the re-prefill cheap, and the resumed stream is token-exact for greedy
+decoding; sampled decoding is seed-consistent too when the request
+carries an explicit ``seed`` (the engine folds the per-step sampling key
+from (seed, produced+sample_offset), so the resumed request draws the
+same keys the dead replica would have drawn).
 """
 
 from typing import List, Optional
 
 from ray_tpu.serve.api import deployment
+
+
+def llm_stream_resume(args, kwargs, received):
+    """Failover policy for LLMDeployment.generate streams: resume the
+    generation where the dead replica stopped instead of replaying it.
+
+    Rewrites (args, kwargs) so the resubmitted request carries
+    ``prompt + received`` as its prompt, a decremented token budget, and
+    ``_produced_offset=len(received)`` to keep the in-jit sampling keys
+    aligned with the original request.  Returns None when the stream was
+    already complete (budget exhausted or EOS emitted), which ends the
+    stream cleanly instead of resubmitting a no-op request."""
+    args = list(args)
+    kwargs = dict(kwargs)
+    if args:
+        prompt = args.pop(0)
+    else:
+        prompt = kwargs.pop("prompt")
+    if args:
+        budget = args.pop(0)
+    else:
+        budget = kwargs.pop("max_new_tokens", 16)
+    # Anything left positionally maps onto generate()'s signature order.
+    for name, val in zip(("temperature", "eos_id", "seed"), args):
+        kwargs.setdefault(name, val)
+    received = [int(t) for t in received]
+    remaining = int(budget) - len(received)
+    if remaining <= 0:
+        return None
+    eos_id = kwargs.get("eos_id")
+    if eos_id is not None and received and received[-1] == int(eos_id):
+        return None
+    new_prompt = [int(t) for t in prompt] + received
+    kwargs["max_new_tokens"] = remaining
+    kwargs["_produced_offset"] = len(received)
+    return (new_prompt,), kwargs
 
 
 @deployment(name="llm", max_concurrent_queries=64)
@@ -43,23 +89,40 @@ class LLMDeployment:
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, _produced_offset: int = 0,
+                 _deadline_s: Optional[float] = None):
         """Streaming entry point: a generator, so serve hands the caller
-        a stream ticket and each token is pulled as the engine emits it."""
+        a stream ticket and each token is pulled as the engine emits it.
+
+        `_produced_offset` / `_deadline_s` are serve-plane plumbing:
+        the failover policy sets the offset so a resumed request samples
+        with the original request's key sequence, and the replica
+        injects the remaining deadline budget so the engine evicts the
+        lane (instead of decoding for nobody) once it lapses."""
         handle = self._engine.submit(prompt, max_new_tokens,
                                      temperature=temperature,
-                                     eos_id=eos_id, seed=seed)
-        for tok in handle:
-            yield int(tok)
+                                     eos_id=eos_id, seed=seed,
+                                     sample_offset=_produced_offset,
+                                     deadline_s=_deadline_s)
+        try:
+            for tok in handle:
+                yield int(tok)
+        finally:
+            # Consumer gone mid-stream (cancel, deadline, disconnect):
+            # evict the lane so the engine stops decoding for nobody.
+            handle.cancel()
 
     def __call__(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0,
                  eos_id: Optional[int] = None,
-                 seed: Optional[int] = None) -> List[int]:
-        """Non-streaming: block until the sequence finishes."""
-        return self._engine.generate(prompt, max_new_tokens,
+                 seed: Optional[int] = None,
+                 _deadline_s: Optional[float] = None) -> List[int]:
+        """Non-streaming: block until the sequence finishes (or the
+        propagated request deadline cancels it)."""
+        handle = self._engine.submit(prompt, max_new_tokens,
                                      temperature=temperature,
                                      eos_id=eos_id, seed=seed)
+        return handle.tokens(timeout=_deadline_s)
 
     def stats(self) -> dict:
         """Engine occupancy + prefix-cache counters (the same numbers the

@@ -108,6 +108,19 @@ def collect() -> Dict[str, dict]:
     return out
 
 
+def read(name: str, tags: Optional[Dict[str, str]] = None):
+    """Current value of one series of an in-process metric, or None if
+    the metric (or series) does not exist.  Tests and benches use this
+    to assert on counters (e.g. serve shed/failover counts) without
+    round-tripping through the exposition format."""
+    with _registry_lock:
+        m = _registry.get(name)
+    if m is None:
+        return None
+    with m._lock:
+        return m._values.get(m._key(tags))
+
+
 def merge_snapshot(into: Dict[str, dict], other: Dict[str, dict]) -> None:
     """Fold one collect() snapshot into another, in place.  Series with
     identical tags combine by type: counters and gauges sum, histogram

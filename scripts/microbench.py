@@ -91,6 +91,77 @@ def bench_checkpoint(results: dict):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_serve(results: dict):
+    """Serve fault-tolerance microbenches: a full mid-stream replica
+    kill + failover-resume cycle, and a graceful drain-on-downscale
+    cycle.  Both are wall-clock-per-recovery numbers (ops/s of whole
+    heal cycles), so regressions in reconcile latency, drain polling,
+    or the failover resubmit path all move them."""
+    from ray_tpu import serve
+    from ray_tpu.serve._private import CONTROLLER_NAME, SERVE_NAMESPACE
+
+    serve.start()
+    try:
+        @serve.deployment(name="mb_failover", num_replicas=1)
+        def chunks(n):
+            for i in range(n):
+                yield i
+
+        handle = serve.run(chunks.bind()).options(failover="replay")
+        assert list(handle.stream(4)) == list(range(4))  # warm replica
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+
+        def failover_resume(n):
+            # One op = stream 8 chunks, kill the replica after 2, let
+            # the handle heal (controller respawns) + resume via replay.
+            for _ in range(n):
+                got = []
+                for c in handle.stream(8):
+                    got.append(c)
+                    if len(got) == 2:
+                        routing = ray_tpu.get(
+                            controller.get_routing.remote("mb_failover"),
+                            timeout=30)
+                        ray_tpu.kill(routing["replicas"][0])
+                assert got == list(range(8))
+
+        timeit("serve_failover_resume", failover_resume, 3, results)
+        serve.delete("mb_failover")
+
+        @serve.deployment(name="mb_drain", num_replicas=1)
+        def nopd():
+            return 0
+
+        def _wait(pred, timeout=30.0):
+            deadline = time.perf_counter() + timeout
+            while time.perf_counter() < deadline:
+                if pred():
+                    return
+                time.sleep(0.05)
+            raise TimeoutError("serve_drain wait timed out")
+
+        serve.run(nopd.bind())
+
+        def drain_cycle(n):
+            # One op = scale 1->2 (wait both RUNNING), downscale 2->1,
+            # wait until the victim fully drains out of the table.
+            for _ in range(n):
+                serve.run(nopd.options(num_replicas=2).bind())
+                _wait(lambda: serve.status()["mb_drain"]["states"]
+                      .get("RUNNING", 0) == 2)
+                before = ray_tpu.get(
+                    controller.drain_stats.remote(), timeout=30)
+                serve.run(nopd.options(num_replicas=1).bind())
+                _wait(lambda: ray_tpu.get(
+                    controller.drain_stats.remote(), timeout=30)
+                    ["drained_total"] > before["drained_total"])
+
+        timeit("serve_drain", drain_cycle, 3, results)
+        serve.delete("mb_drain")
+    finally:
+        serve.shutdown()
+
+
 def main():
     ray_tpu.init(num_cpus=8, object_store_memory=256 << 20)
     results: dict = {}
@@ -279,6 +350,9 @@ def main():
 
     # --- checkpoint: sharded save / stage / restore ------------------------
     bench_checkpoint(results)
+
+    # --- serve: failover-resume + drain cycles -----------------------------
+    bench_serve(results)
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MICROBENCH.json")

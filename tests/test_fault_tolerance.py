@@ -370,6 +370,162 @@ def test_worker_killed_mid_async_save_resumes_from_committed(
 
 
 # ---------------------------------------------------------------------------
+# Scenario 6: serve-plane graceful degradation under chaos
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def serve_chaos_cluster(request):
+    """chaos_cluster + the serve control plane, torn down with the
+    process-local router states cleared (they cache replica handles
+    across cluster generations)."""
+    cfg = dict(getattr(request, "param", {}))
+    info = ray_tpu.init(num_cpus=4, object_store_memory=64 << 20,
+                        _system_config=cfg)
+    from ray_tpu import serve
+    serve.start()
+    try:
+        yield info
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        from ray_tpu.serve import _private as sp
+        with sp._router_states_lock:
+            sp._router_states.clear()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+def _metric(name):
+    from ray_tpu.util import metrics
+    return metrics.read(name) or 0.0
+
+
+@pytest.mark.parametrize(
+    "serve_chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 31,
+      # Scripted: EVERY serve replica process ("*") dies at its 4th
+      # serve event — the dispatch is event 0 and each token pull is one
+      # event, so the replica is killed mid-generation after streaming 3
+      # tokens.  The replacement incarnation re-arms at the same ordinal,
+      # so the 8-token request needs exactly two failovers (3 + 3 + 2
+      # tokens) — within the serve_failover_attempts default.
+      "chaos_kill_replica_salts": "*",
+      "chaos_kill_replica_at": 4,
+      "chaos_max_faults": 1}],
+    indirect=True)
+def test_replica_kill_mid_stream_resumes_token_exact(serve_chaos_cluster):
+    """ISSUE acceptance criterion: a scripted chaos_kill_replica mid-
+    generation is absorbed by the llm_stream_resume failover policy and
+    the streamed greedy output is token-exact with an unfaulted run."""
+    from ray_tpu import serve
+    from ray_tpu.inference import InferenceEngine
+
+    prompt, budget = [1, 2, 3], 8
+    # The unfaulted reference: same model family/config/seed as the
+    # deployment, built driver-side (deterministic seeded weights).
+    expected = InferenceEngine("gpt", "nano", seed=0).generate(
+        prompt, budget)
+
+    handle = serve.run(serve.LLMDeployment.options(
+        name="llm_chaos").bind(model="gpt", config="nano", max_lanes=4,
+                               seed=0))
+    before = _metric("serve_stream_failovers")
+    got = list(handle.options("generate",
+                              failover=serve.llm_stream_resume)
+               .stream(prompt, budget))
+    assert got == expected
+    # The kills actually happened (two failovers absorbed them).
+    assert _metric("serve_stream_failovers") - before >= 1
+
+
+@pytest.mark.parametrize("serve_chaos_cluster", [{}], indirect=True)
+def test_drain_on_downscale_zero_dropped(serve_chaos_cluster):
+    """ISSUE acceptance criterion: a scripted downscale during a burst
+    of in-flight unary requests completes every request — replicas leave
+    the routing table immediately but are only killed after draining, so
+    zero ActorDiedErrors surface."""
+    from ray_tpu import serve
+    from ray_tpu.serve._private import CONTROLLER_NAME, SERVE_NAMESPACE
+
+    @serve.deployment(name="drainy", num_replicas=2,
+                      max_concurrent_queries=16)
+    def slow(x):
+        time.sleep(0.25)
+        return x * 2
+
+    handle = serve.run(slow.bind())
+    results, errors = [], []
+
+    def one(i):
+        try:
+            results.append(handle.remote(i).result(timeout=60))
+        except Exception as e:   # noqa: BLE001 - recorded for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)  # burst is in flight on both replicas
+    serve.run(slow.options(num_replicas=1).bind())  # scripted downscale
+    for t in threads:
+        t.join(120)
+    assert not errors, f"requests dropped during drain: {errors!r}"
+    assert sorted(results) == [2 * i for i in range(12)]
+    # The retired replicas really went through DRAINING, not a hard kill.
+    controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        stats = ray_tpu.get(controller.drain_stats.remote(), timeout=30)
+        if stats["drained_total"] >= 1 and stats["draining"] == 0:
+            break
+        time.sleep(0.2)
+    assert stats["drained_total"] >= 1
+    assert stats["deadline_kills"] == 0
+
+
+@pytest.mark.parametrize("serve_chaos_cluster", [{}], indirect=True)
+def test_overload_sheds_and_recovers(serve_chaos_cluster):
+    """ISSUE acceptance criterion: overload driving the bounded
+    admission queue past its limit sheds with ServeOverloadedError (with
+    a retry-after hint) and the deployment serves normally afterwards."""
+    from ray_tpu import serve
+    from ray_tpu.exceptions import ServeOverloadedError
+
+    @serve.deployment(name="shedder", num_replicas=1,
+                      max_concurrent_queries=1, queue_limit=2)
+    def slow(x):
+        time.sleep(0.5)
+        return x + 1
+
+    handle = serve.run(slow.bind())
+    assert handle.remote(0).result(timeout=30) == 1  # warm routing table
+
+    before = _metric("serve_requests_shed")
+    ok, shed = [], []
+
+    def one(i):
+        try:
+            ok.append(handle.remote(i).result(timeout=60))
+        except ServeOverloadedError as e:
+            shed.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    # 1 executing + 2 queued admitted; the rest shed fast with a hint.
+    assert ok and shed
+    assert all(e.retry_after_s > 0 for e in shed)
+    assert _metric("serve_requests_shed") - before >= len(shed)
+    # Recovery: the deployment serves normally once the burst passes.
+    assert handle.remote(41).result(timeout=30) == 42
+
+
+# ---------------------------------------------------------------------------
 # Node-death propagation plumbing (unit level)
 # ---------------------------------------------------------------------------
 

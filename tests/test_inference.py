@@ -412,6 +412,67 @@ def test_tokens_timeout_is_overall_deadline():
     assert time.monotonic() - t0 < 2.0
 
 
+def test_cancel_evicts_lane_and_engine_stays_usable():
+    eng = InferenceEngine("gpt", "nano", max_lanes=2, block_size=8,
+                          prefill_chunk=4, auto_start=False, seed=0)
+    h = eng.submit([1, 2, 3], max_new_tokens=1000)
+    eng.step()
+    assert eng.num_active == 1
+    assert h.cancel() is True
+    assert h.finish_reason == "cancelled"
+    assert h.cancel() is False          # idempotent
+    assert eng.num_active == 0
+    assert eng.cache.allocator.num_free == eng.cache.allocator.num_blocks
+    # The lane is genuinely reusable afterwards.
+    assert len(eng.generate([4, 5, 6], max_new_tokens=3)) == 3
+
+
+def test_tokens_timeout_cancels_upstream():
+    """Satellite fix: a client-side tokens() deadline must CANCEL the
+    request (dequeue / evict the lane), not leave the engine generating
+    for a consumer that already gave up."""
+    eng = InferenceEngine("gpt", "nano", max_lanes=1, block_size=8,
+                          prefill_chunk=4, auto_start=False, seed=0)
+    h = eng.submit([1, 2, 3], max_new_tokens=1000)
+    assert eng.num_waiting == 1
+    with pytest.raises(TimeoutError):
+        h.tokens(timeout=0.1)           # never stepped: still queued
+    assert h.finish_reason == "cancelled"
+    assert eng.num_waiting == 0 and eng.num_active == 0
+
+
+def test_request_deadline_evicts_lane():
+    eng = InferenceEngine("gpt", "nano", max_lanes=1, block_size=8,
+                          prefill_chunk=4, auto_start=False, seed=0)
+    h = eng.submit([1, 2, 3], max_new_tokens=100000, deadline_s=0.15)
+    while eng.step():
+        pass
+    assert h.finish_reason == "deadline"
+    assert len(h.tokens()) < 100000
+    assert eng.num_active == 0
+    assert eng.cache.allocator.num_free == eng.cache.allocator.num_blocks
+
+
+def test_sample_offset_resume_is_seed_consistent():
+    """Failover building block: resubmitting with the produced tokens
+    appended to the prompt and sample_offset=len(produced) draws the
+    SAME per-step sampling keys the original request would have drawn,
+    so a resumed sampled stream is token-exact."""
+    eng = InferenceEngine("gpt", "nano", max_lanes=2, block_size=8,
+                          prefill_chunk=8, auto_start=False, seed=0)
+    prompt = [2, 3, 4, 5]
+    full = eng.generate(prompt, max_new_tokens=8, temperature=0.9, seed=42)
+    if len(full) < 4:
+        pytest.skip("sampled run hit max_seq_len too early")
+    part = eng.generate(prompt, max_new_tokens=3, temperature=0.9, seed=42)
+    assert part == full[:3]
+    h = eng.submit(prompt + part, max_new_tokens=len(full) - 3,
+                   temperature=0.9, seed=42, sample_offset=3)
+    while eng.step():
+        pass
+    assert h.tokens() == full[3:]
+
+
 def test_sampled_step_keeps_logits_on_device():
     eng = InferenceEngine("gpt", "nano", max_lanes=2, block_size=8,
                           max_seq_len=32, prefill_chunk=8,

@@ -559,3 +559,152 @@ def test_asgi_receive_does_not_fabricate_disconnect(cluster):
         f"http://127.0.0.1:{port}/sseapp/x", timeout=30).read()
     assert body == b"c0;c1;c2;", body
     serve.delete("sseapp")
+
+
+# ---------------------------------------------------------------------------
+# Graceful degradation: deadlines, draining, failover, shedding plumbing
+# ---------------------------------------------------------------------------
+
+def test_request_deadline_bounds_admission_wait(cluster):
+    """A handle timeout_s caps how long a request may wait for a replica
+    slot: with the only slot busy, the second request times out at its
+    deadline instead of sitting in the admission queue for the full
+    backpressure window."""
+    import threading
+
+    @serve.deployment(name="deadliner", num_replicas=1,
+                      max_concurrent_queries=1)
+    def slow(x):
+        time.sleep(1.5)
+        return x
+
+    handle = serve.run(slow.bind())
+    handle.remote("warm").result(timeout=60)  # routing table populated
+
+    t = threading.Thread(
+        target=lambda: handle.remote("hog").result(timeout=60))
+    t.start()
+    time.sleep(0.2)  # the hog owns the only slot
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        handle.options(timeout_s=0.3).remote("late").result(timeout=60)
+    assert time.monotonic() - t0 < 1.2
+    t.join(60)
+    serve.delete("deadliner")
+
+
+def test_deadline_propagates_to_replica(cluster):
+    """A deadline-aware deployment (signature takes `_deadline_s`)
+    receives the remaining budget server-side."""
+    @serve.deployment(name="dlaware")
+    def report(x, _deadline_s=None):
+        return _deadline_s
+
+    handle = serve.run(report.bind())
+    # No deadline configured: nothing injected.
+    assert handle.remote(0).result(timeout=60) is None
+    got = handle.options(timeout_s=7.5).remote(0).result(timeout=60)
+    assert got is not None and 0 < got <= 7.5
+    serve.delete("dlaware")
+
+
+def test_stream_deadline_aborts_mid_stream(cluster):
+    """A stream that outlives its request deadline is aborted — client
+    raises, and the replica-side generator is closed (its finally runs)
+    instead of producing for nobody."""
+    from ray_tpu.exceptions import TaskError
+
+    @serve.deployment(name="slowstream", num_replicas=1)
+    def ticks(n):
+        for i in range(n):
+            time.sleep(0.25)
+            yield i
+
+    handle = serve.run(ticks.bind())
+    got = []
+    with pytest.raises((TimeoutError, TaskError)):
+        for c in handle.options(timeout_s=0.6).stream(100):
+            got.append(c)
+    assert len(got) < 100
+    serve.delete("slowstream")
+
+
+def test_stream_failover_replay_skips_delivered_chunks(cluster):
+    """Generic mid-stream failover: kill the replica mid-stream; with
+    failover="replay" the handle heals, resubmits, skips the chunks the
+    consumer already saw, and the stream completes without duplicates."""
+    from ray_tpu.serve._private import (
+        CONTROLLER_NAME, SERVE_NAMESPACE)
+
+    @serve.deployment(name="replaysrc", num_replicas=1)
+    def count(n):
+        for i in range(n):
+            time.sleep(0.05)
+            yield i
+
+    handle = serve.run(count.bind())
+    controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+    got = []
+    for c in handle.options(failover="replay").stream(8):
+        got.append(c)
+        if len(got) == 3:
+            routing = ray_tpu.get(
+                controller.get_routing.remote("replaysrc"), timeout=30)
+            ray_tpu.kill(routing["replicas"][0])
+    assert got == list(range(8))
+    serve.delete("replaysrc")
+
+
+def test_restarted_replica_raises_stream_lost(cluster):
+    """next_chunk for a stream id the replica does not know must raise
+    ReplicaStreamLostError (the failover trigger), never fake a clean
+    end-of-stream."""
+    from ray_tpu.serve._private import (
+        CONTROLLER_NAME, SERVE_NAMESPACE, _is_replica_loss)
+
+    @serve.deployment(name="loststream")
+    def gen():
+        yield 1
+
+    serve.run(gen.bind())
+    controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+    routing = ray_tpu.get(
+        controller.get_routing.remote("loststream"), timeout=30)
+    replica = routing["replicas"][0]
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(replica.next_chunk.remote(424242), timeout=30)
+    assert _is_replica_loss(ei.value)
+    serve.delete("loststream")
+
+
+def test_status_reports_replica_states(cluster):
+    @serve.deployment(name="stately", num_replicas=2)
+    def f(x):
+        return x
+
+    serve.run(f.bind())
+    st = serve.status()["stately"]
+    assert st["states"]["RUNNING"] == 2
+    assert st["states"]["DRAINING"] == 0
+    serve.delete("stately")
+
+
+def test_llm_stream_resume_policy_rewrites_request():
+    """Unit: the LLM failover policy appends produced tokens to the
+    prompt, decrements the budget, aligns the sampling offset, and
+    signals completion (None) on exhausted budget or EOS."""
+    from ray_tpu.serve import llm_stream_resume
+
+    args, kwargs = llm_stream_resume(([1, 2], 8), {}, [5, 6, 7])
+    assert args == ([1, 2, 5, 6, 7],)
+    assert kwargs["max_new_tokens"] == 5
+    assert kwargs["_produced_offset"] == 3
+    # Positional temperature/eos_id/seed survive as kwargs.
+    args, kwargs = llm_stream_resume(([1], 4, 0.9, 99, 7), {}, [3])
+    assert args == ([1, 3],)
+    assert kwargs["temperature"] == 0.9 and kwargs["eos_id"] == 99 \
+        and kwargs["seed"] == 7
+    # Budget exhausted -> the stream was already complete.
+    assert llm_stream_resume(([1], 3), {}, [4, 5, 6]) is None
+    # EOS emitted -> complete, even with budget left.
+    assert llm_stream_resume(([1], 9), {"eos_id": 6}, [4, 6]) is None
