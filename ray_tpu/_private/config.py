@@ -140,6 +140,30 @@ _D("serve_request_deadline_s", 0.0, float,
    "handle.options(timeout_s=...)")
 _D("serve_failover_attempts", 2, int,
    "max mid-stream failover resubmissions per streaming request")
+# -- train fault tolerance -------------------------------------------------
+_D("train_hang_timeout_s", 60.0, float,
+   "gang declared hung when NO worker makes observable progress (a "
+   "consumed report or an advanced step beacon) for this long; the "
+   "watchdog then collects per-rank stacks and fails the gang instead "
+   "of waiting in a collective forever.  Must exceed the slowest "
+   "legitimate train step.")
+_D("train_beacon_poll_s", 5.0, float,
+   "how often the driver-side watchdog polls worker step beacons while "
+   "blocked waiting on gang reports")
+_D("train_elastic_timeout_s", 120.0, float,
+   "overall deadline for an elastic restart to form SOME gang between "
+   "min_workers and num_workers before the restart fails")
+_D("train_pg_timeout_s", 15.0, float,
+   "placement-group reservation wait per elastic gang-size attempt "
+   "(the non-elastic path keeps its legacy 120s wait)")
+_D("train_resize_check_interval_s", 5.0, float,
+   "how often a resized-down gang probes the cluster for returned "
+   "capacity (resize-up happens at the next step boundary after a "
+   "successful probe)")
+_D("worker_sigterm_grace_s", 3.0, float,
+   "bounded SIGTERM -> wait -> SIGKILL escalation window: how long a "
+   "terminated worker may finish its in-flight task before the kill "
+   "(hostd child teardown and the worker's own SIGTERM handler)")
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")
@@ -197,6 +221,31 @@ _D("chaos_kill_replica_salts", "", str,
    "th serve-plane event (see fault_injection.kill_replica)")
 _D("chaos_kill_replica_at", 0, int,
    "serve-plane event index at which the scripted replica kill fires")
+_D("chaos_preempt", 0.0, float,
+   "probability a hostd receives a preemption notice at a heartbeat "
+   "tick (simulated TPU maintenance event: SIGTERM after a grace "
+   "window)")
+_D("chaos_preempt_at", -1, int,
+   "scripted preemption: heartbeat tick ordinal at which the notice "
+   "fires on every hostd matching chaos_preempt_target (-1 = disabled)")
+_D("chaos_preempt_target", "any", str,
+   "which hostds a scripted preemption hits: 'any', 'head', or "
+   "'nonhead'.  A preempted head degrades to killing only its workers "
+   "(slice loss) instead of exiting, so a colocated GCS survives.")
+_D("chaos_preempt_grace_s", 5.0, float,
+   "grace window between the injected preemption notice and the kill")
+_D("chaos_stall_worker", 0.0, float,
+   "probability a train worker stalls at a step boundary (hang chaos "
+   "for the train watchdog)")
+_D("chaos_stall_worker_salts", "", str,
+   "scripted stalls: csv of worker spawn ordinals that stall at their "
+   "chaos_stall_at-th session.report (see "
+   "fault_injection.stall_train_step)")
+_D("chaos_stall_at", 0, int,
+   "report ordinal at which the scripted train stall fires")
+_D("chaos_stall_s", 3600.0, float,
+   "how long an injected train stall sleeps (interruptible; default "
+   "is effectively forever relative to train_hang_timeout_s)")
 
 
 GLOBAL_CONFIG = RayTpuConfig()

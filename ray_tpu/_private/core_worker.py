@@ -298,6 +298,27 @@ class CoreWorker:
         s.register("CoreWorker", "Ping", self._rpc_ping)
         s.register("CoreWorker", "NativePort", self._rpc_native_port)
         s.register("CoreWorker", "NodeDead", self._rpc_node_dead)
+        s.register("CoreWorker", "PreemptionNotice",
+                   self._rpc_preemption_notice)
+
+    async def _rpc_preemption_notice(self, req):
+        """Hostd fans its preemption notice down to each worker: this
+        host dies in `grace_s` seconds.  If a train session lives here,
+        arm it — its next report() races a proactive checkpoint save
+        against the window, then aborts at the step boundary with
+        TrainPreemptedError.  The train module is looked up, never
+        imported: non-train workers must not pay the import."""
+        import sys
+        grace = float(req.get("grace_s", 0.0))
+        from ray_tpu.util import metrics as mt
+        mt.Counter("train_preemption_notices",
+                   "preemption notices delivered to this worker").inc()
+        sess_mod = sys.modules.get("ray_tpu.train.session")
+        sess = getattr(sess_mod, "_session", None) if sess_mod else None
+        if sess is not None:
+            sess.notify_preemption(grace)
+            return {"ok": True, "armed": True}
+        return {"ok": True, "armed": False}
 
     async def _rpc_native_port(self, req):
         """Native-transport discovery: callers connect to this port for the
@@ -2341,11 +2362,14 @@ class CoreWorker:
         return {"returns": self._pack_returns(spec, result), "error": None}
 
     def _error_reply(self, spec: TaskSpec, e: BaseException) -> dict:
-        from ray_tpu.exceptions import TaskCancelledError
+        from ray_tpu.exceptions import TaskCancelledError, TrainPreemptedError
         tb = traceback.format_exc()
         logger.info("task %s failed:\n%s", spec.name, tb)
+        # TrainPreemptedError stays typed across the wire: the driver
+        # routes it to the preemption recovery path (resume from the
+        # grace-window save), not the crash path.
         err = e if isinstance(e, (TaskError, ActorDiedError,
-                                  TaskCancelledError)) \
+                                  TaskCancelledError, TrainPreemptedError)) \
             else TaskError(spec.name, tb, None)
         return {"returns": [], "error": err}
 

@@ -204,6 +204,60 @@ class ChaosController:
         return self.should(
             "hostd", GLOBAL_CONFIG.chaos_kill_hostd, "kill")
 
+    def preempt_hostd(self, is_head: bool) -> bool:
+        """Inject a preemption NOTICE at a hostd heartbeat tick — the
+        maintenance-event simulation the train plane's grace-window save
+        must race.  Unlike kill_hostd this fires on head nodes too: a
+        preempted head degrades to killing only its workers (slice
+        loss), so the colocated GCS survives and the scenario stays
+        runnable on a single-node cluster.
+
+        Two modes: scripted (`chaos_preempt_at` names the tick ordinal;
+        `chaos_preempt_target` selects head/nonhead/any hostds — the
+        deterministic way to preempt exactly one node of a multi-node
+        cluster) or probabilistic (`chaos_preempt` per tick).
+        """
+        cfg = GLOBAL_CONFIG
+        at = int(cfg.chaos_preempt_at)
+        if at >= 0:
+            target = str(cfg.chaos_preempt_target or "any")
+            matches = (target == "any"
+                       or (target == "head") == bool(is_head))
+            with self._lock:
+                n = self._next_index("preempt")
+                if matches and n == at:
+                    self._faults += 1
+                    self.schedule.append(("preempt", n, "preempt"))
+                    return True
+            return False
+        return self.should("preempt", cfg.chaos_preempt, "preempt")
+
+    def stall_train_step(self) -> Optional[float]:
+        """Chaos verdict for one session.report() step boundary: None
+        (no fault) or seconds to stall BEFORE updating the progress
+        beacon — so the stalled rank's beacon reads stale and the
+        driver-side watchdog can classify it as the laggard.
+
+        Same two modes as kill_worker: scripted
+        (`chaos_stall_worker_salts` lists worker spawn ordinals; a
+        listed worker stalls at its `chaos_stall_at`-th report) or
+        probabilistic (`chaos_stall_worker` per report).
+        """
+        cfg = GLOBAL_CONFIG
+        salts = str(cfg.chaos_stall_worker_salts or "")
+        if salts and self.salt:
+            listed = self.salt in [s.strip() for s in salts.split(",")]
+            with self._lock:
+                n = self._next_index("train")
+                if listed and n == int(cfg.chaos_stall_at):
+                    self._faults += 1
+                    self.schedule.append(("train", n, "stall"))
+                    return float(cfg.chaos_stall_s)
+            return None
+        if self.should("train", cfg.chaos_stall_worker, "stall"):
+            return float(cfg.chaos_stall_s)
+        return None
+
     def kill_ckpt_commit(self) -> bool:
         """Kill this process mid-checkpoint-save: the async writer draws
         this right before the COMMIT rename, when every shard file is on

@@ -56,6 +56,12 @@ def _metrics():
             "oom_workers_killed": mt.Counter(
                 "oom_workers_killed",
                 "workers killed by the memory monitor"),
+            "preemption_notices": mt.Counter(
+                "preemption_notices",
+                "preemption notices received by this hostd"),
+            "preemption_grace_s": mt.Gauge(
+                "preemption_grace_s",
+                "grace window of the most recent preemption notice"),
         }
     return _M
 
@@ -223,6 +229,11 @@ class WorkerHandle:
         self.actor_id = None
         self.idle_since = time.monotonic()
         self.leased_at = 0.0
+        # Set via the WorkerExiting RPC when the worker announces a
+        # deliberate exit (SIGTERM drain, preemption abort) so the reaper
+        # reports intent instead of "crash" (reference: raylet
+        # DisconnectClient carries a WorkerExitType).
+        self.exit_reason: str | None = None
         self.log_paths: dict = {}
         self.log_offsets: dict = {}
         self.ready = asyncio.Event()
@@ -252,6 +263,13 @@ class NodeDaemon:
         #  placement_group_resource_manager.h:46)
         self.bundles: dict[tuple, dict] = {}
         self.workers: dict[int, WorkerHandle] = {}  # pid -> handle
+        # Preemption notice state (simulated TPU maintenance event):
+        # while `preempting`, every new lease / bundle prepare is rejected
+        # with reason "preempting" so the scheduler spills to healthy
+        # nodes, and `_preempt_victims` pins the pids alive at notice time
+        # so the deadline kill can never hit a later-formed gang.
+        self.preempting = False
+        self._preempt_victims: set[int] = set()
         self._lease_seq = 0
         self.server = RpcServer(host)
         self._shutdown = asyncio.Event()
@@ -502,10 +520,35 @@ class NodeDaemon:
             handle.state = "claimed"
             return handle
 
+    async def _escalate_kill(self, proc, grace: float | None = None):
+        """Bounded SIGTERM -> wait -> SIGKILL escalation.
+
+        SIGTERM can be ignored or deferred by native code (TPU runtime,
+        compiled extensions) and by the worker's own graceful-exit drain;
+        polling every 50ms keeps detection prompt while the grace window
+        (worker_sigterm_grace_s) bounds how long a stuck child can wedge
+        teardown before SIGKILL ends it unconditionally."""
+        if grace is None:
+            grace = _cfg().worker_sigterm_grace_s
+        deadline = time.monotonic() + max(0.0, grace)
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                return
+            await asyncio.sleep(0.05)
+        if proc.poll() is None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+
     def _kill_worker(self, handle: WorkerHandle):
         self.workers.pop(handle.proc.pid, None)
         if handle.proc.poll() is None:
             handle.proc.terminate()
+            try:
+                asyncio.ensure_future(self._escalate_kill(handle.proc))
+            except RuntimeError:
+                pass  # no running loop (teardown path escalates itself)
 
     # ---------------- leasing ----------------
 
@@ -604,6 +647,8 @@ class NodeDaemon:
         saturated (reference: RequestWorkerLease node_manager.proto:363 +
         LocalTaskManager dispatch queue).  With req["bundle"]=(pg_hex, idx)
         the demand is charged against that placement-group bundle."""
+        if self.preempting:
+            return {"granted": False, "reason": "preempting"}
         demand = req.get("resources", {})
         bundle = tuple(req["bundle"]) if req.get("bundle") else None
         job_id = req.get("job_id", 0)
@@ -670,6 +715,8 @@ class NodeDaemon:
         saturation into rejections the GCS spins its placement-attempt
         budget against (reference: leases wait in the raylet's dispatch
         queue until resources and a worker exist)."""
+        if self.preempting:
+            return {"granted": False, "reason": "preempting"}
         demand = req.get("resources", {})
         bundle = tuple(req["bundle"]) if req.get("bundle") else None
         loop = asyncio.get_running_loop()
@@ -711,6 +758,10 @@ class NodeDaemon:
     # placement_group_resource_manager.h:46.
 
     async def prepare_bundle(self, req):
+        if self.preempting:
+            # A doomed node must not accept new gang reservations during
+            # its grace window: the PG would commit and immediately die.
+            return {"ok": False, "reason": "preempting"}
         key = (req["pg_id"], req["index"])
         demand = req["resources"]
         if key in self.bundles:
@@ -1010,14 +1061,11 @@ class NodeDaemon:
                     threshold * 100, victim.proc.pid)
                 _metrics()["oom_workers_killed"].inc()
                 self._release_lease(victim)
-                proc = victim.proc
+                # _kill_worker already schedules the bounded
+                # SIGTERM -> wait -> SIGKILL escalation (_escalate_kill);
+                # the old one-shot 2s poll here could miss a worker whose
+                # native code ignored SIGTERM and raced the poll.
                 self._kill_worker(victim)
-
-                async def escalate(p=proc):
-                    await asyncio.sleep(2.0)
-                    if p.poll() is None:  # SIGTERM ignored (native code)
-                        p.kill()
-                asyncio.ensure_future(escalate())
                 # Cooldown: give the kernel time to reclaim before judging
                 # again — otherwise one spike serially destroys the node.
                 await asyncio.sleep(max(3 * interval, 2.0))
@@ -1197,6 +1245,101 @@ class NodeDaemon:
         out.extend(await asyncio.gather(*[probe(h) for h in handles]))
         return {"processes": out}
 
+    async def collect_stacks(self, req):
+        """Live thread dumps from a SPECIFIC set of this node's workers
+        (by pid) — the train hang watchdog's diagnosis RPC.  Unlike
+        stack_traces this skips the daemon self-dump and probes only the
+        gang's workers, concurrently: a wedged gang must dump in ~one
+        probe timeout, not N."""
+        pids = set(req.get("pids") or [])
+        handles = [h for h in self.workers.values()
+                   if h.address and (not pids or h.proc.pid in pids)]
+
+        async def probe(handle):
+            try:
+                reply = await self.pool.get(handle.address).call(
+                    "CoreWorker", "StackTrace", {}, timeout=5)
+                return {"pid": reply["pid"], "state": handle.state,
+                        "threads": reply["threads"]}
+            except Exception as e:
+                return {"pid": handle.proc.pid, "state": handle.state,
+                        "error": repr(e), "threads": []}
+
+        return {"processes":
+                await asyncio.gather(*[probe(h) for h in handles]),
+                "node_id": self.node_id.hex()}
+
+    # ---------------- preemption (maintenance events) ----------------
+
+    async def notify_preemption(self, req):
+        """Advance notice that this host will be reclaimed in `grace_s`
+        seconds (TPU maintenance event / spot preemption; in production
+        wired to the metadata-server preemption signal, here driven by
+        the chaos plane).  The daemon immediately stops granting leases
+        and bundle reservations, fans the notice out to every live
+        worker — train sessions there race a proactive checkpoint save
+        against the window — and schedules the kill at the deadline."""
+        grace = float(req.get("grace_s", _cfg().chaos_preempt_grace_s))
+        if self.preempting:
+            return {"ok": True, "already": True}
+        self.preempting = True
+        self._preempt_victims = {
+            h.proc.pid for h in self.workers.values()
+            if h.proc.poll() is None}
+        _metrics()["preemption_notices"].inc()
+        _metrics()["preemption_grace_s"].set(grace)
+        logger.warning(
+            "preemption notice: node %s reclaimed in %.1fs (%d workers "
+            "notified)", self.node_id.hex()[:8], grace,
+            len(self._preempt_victims))
+
+        async def _notify(handle):
+            try:
+                await self.pool.get(handle.address).call(
+                    "CoreWorker", "PreemptionNotice",
+                    {"grace_s": grace}, timeout=2)
+            except Exception:
+                pass  # worker mid-exit; the deadline kill covers it
+
+        targets = [h for h in list(self.workers.values())
+                   if h.address and h.proc.poll() is None]
+        if targets:
+            await asyncio.gather(*[_notify(h) for h in targets])
+        asyncio.ensure_future(self._preempt_kill(grace))
+        return {"ok": True, "grace_s": grace}
+
+    async def _preempt_kill(self, grace: float):
+        """The reclaim at the end of the grace window.  A non-head node
+        dies whole (os._exit, like a real preemption — the GCS health
+        loop declares it dead and peers learn via node-watch).  A head
+        node degrades to killing only the workers alive at notice time:
+        the colocated GCS must survive so the cluster can re-form, which
+        also keeps single-node chaos scenarios runnable."""
+        await asyncio.sleep(max(0.0, grace))
+        if not self.is_head:
+            logger.warning("preemption: node %s reclaimed",
+                           self.node_id.hex()[:8])
+            os._exit(1)
+        for pid in list(self._preempt_victims):
+            handle = self.workers.get(pid)
+            if handle is not None and handle.proc.poll() is None:
+                self._kill_worker(handle)
+        self._preempt_victims = set()
+        self.preempting = False
+        logger.warning("preemption: head %s lost its workers; leasing "
+                       "re-enabled", self.node_id.hex()[:8])
+
+    async def worker_exiting(self, req):
+        """A worker announcing a deliberate exit (SIGTERM drain,
+        preemption abort) before it dies, so the reaper reports intent
+        instead of a crash and the owner's retry logic can tell a
+        drained worker from a wedged one."""
+        handle = self.workers.get(int(req.get("pid", 0)))
+        if handle is None:
+            return {"ok": False}
+        handle.exit_reason = str(req.get("reason", "deliberate"))
+        return {"ok": True}
+
     async def list_workers(self, req):
         """Per-node worker table for the state API (reference:
         experimental/state/api.py list_workers via raylet)."""
@@ -1255,6 +1398,16 @@ class NodeDaemon:
                 logger.warning("chaos: killing hostd %s",
                                self.node_id.hex()[:8])
                 os._exit(1)
+            if (chaos is not None and not self.preempting
+                    and chaos.preempt_hostd(self.is_head)):
+                # Injected maintenance event: a preemption NOTICE with a
+                # grace window, not an instant kill.  Unlike kill_hostd
+                # this may target the head — it degrades to losing only
+                # its workers so the colocated GCS survives.
+                logger.warning("chaos: preemption notice on hostd %s",
+                               self.node_id.hex()[:8])
+                asyncio.ensure_future(self.notify_preemption(
+                    {"grace_s": _cfg().chaos_preempt_grace_s}))
             try:
                 hb = protocol.pb.HeartbeatRequest(
                     node_id=self.node_id.binary())
@@ -1368,13 +1521,17 @@ class NodeDaemon:
                     self.workers.pop(handle.proc.pid, None)
                     self._release_lease(handle)
                     if handle.state == "actor" and handle.actor_id is not None:
+                        reason = (f"worker exited deliberately "
+                                  f"({handle.exit_reason})"
+                                  if handle.exit_reason else
+                                  f"worker exited "
+                                  f"({handle.proc.returncode})")
                         try:
                             await self.gcs.call(
                                 "Gcs", "report_actor_death",
                                 {"actor_id": handle.actor_id,
                                  "address": handle.address,
-                                 "reason": f"worker exited "
-                                           f"({handle.proc.returncode})"},
+                                 "reason": reason},
                                 timeout=2)
                         except Exception:
                             pass
@@ -1408,6 +1565,12 @@ class NodeDaemon:
                              self.spill_objects)
         self.server.register("NodeManager", "ListWorkers", self.list_workers)
         self.server.register("NodeManager", "StackTraces", self.stack_traces)
+        self.server.register("NodeManager", "CollectStacks",
+                             self.collect_stacks)
+        self.server.register("NodeManager", "NotifyPreemption",
+                             self.notify_preemption)
+        self.server.register("NodeManager", "WorkerExiting",
+                             self.worker_exiting)
         self.server.register("NodeManager", "Metrics", self.get_metrics)
         self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
         port = await self.server.start(port)
@@ -1449,11 +1612,16 @@ class NodeDaemon:
         await self._shutdown.wait()
         for t in self._tasks:
             t.cancel()
-        for handle in list(self.workers.values()):
+        # Teardown escalation: SIGTERM everyone, give the pool one shared
+        # grace window to drain (workers' own SIGTERM handlers finish the
+        # in-flight task), then SIGKILL any survivor — shutdown can never
+        # wedge on a worker whose native code ignores SIGTERM.
+        victims = list(self.workers.values())
+        for handle in victims:
             self._kill_worker(handle)
         self._zygote_close()
-        deadline = time.monotonic() + 3
-        for handle in list(self.workers.values()):
+        deadline = time.monotonic() + max(3.0, _cfg().worker_sigterm_grace_s)
+        for handle in victims:
             try:
                 handle.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
             except Exception:

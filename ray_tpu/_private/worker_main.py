@@ -103,6 +103,39 @@ def main():
             time.sleep(1.0)
 
     threading.Thread(target=orphan_watch, daemon=True).start()
+
+    # Graceful SIGTERM (reference: default_worker.py sigterm handler →
+    # CoreWorkerProcess graceful exit).  Without this the worker dies
+    # mid-task and the owner misreads a deliberate kill as a crash and
+    # retries; here the handler reports the deliberate exit to hostd,
+    # lets the in-flight task drain within worker_sigterm_grace_s, then
+    # exits.  Hostd's _escalate_kill SIGKILLs anything that overstays.
+    import signal
+
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    def _graceful_exit(signum=None, frame=None):
+        def drain():
+            try:
+                cw.io.run(hostd.call(
+                    "NodeManager", "WorkerExiting",
+                    {"pid": os.getpid(), "reason": "sigterm"}, timeout=2))
+            except Exception:
+                pass
+            deadline = (time.monotonic()
+                        + GLOBAL_CONFIG.worker_sigterm_grace_s)
+            while cw._running_tasks and time.monotonic() < deadline:
+                time.sleep(0.02)
+            os._exit(0 if not cw._running_tasks else 1)
+        # Drain on a thread: the signal may land on a frame holding locks
+        # the in-flight task needs to finish.
+        threading.Thread(target=drain, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful_exit)
+    except (ValueError, OSError):
+        pass  # non-main-thread entry (tests importing main())
+
     cw.run_task_loop()
     os._exit(0)
 

@@ -28,6 +28,12 @@ class ScalingConfig:
     resources_per_worker: Optional[dict] = None
     placement_strategy: str = "PACK"
     topology: Optional[str] = None
+    # Elastic floor: None (default) keeps the legacy fixed-size gang — a
+    # restart waits for a full-size gang.  Set to k <= num_workers and a
+    # restart may re-form on as few as k surviving workers (resize-down,
+    # data re-sharded by the new world size) and grows back to
+    # num_workers when capacity returns (resize-up at a step boundary).
+    min_workers: Optional[int] = None
 
     def worker_resources(self) -> dict:
         if self.resources_per_worker is not None:

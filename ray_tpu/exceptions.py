@@ -97,6 +97,59 @@ class ReplicaStreamLostError(RayTpuError):
         return (type(self), (self.stream_id,))
 
 
+class TrainPreemptedError(RayTpuError):
+    """A training worker aborted at a step boundary because its host
+    received a preemption notice (TPU maintenance event / spot
+    reclamation).  The session's preemption hook has already raced its
+    proactive checkpoint save against the grace window, so an elastic
+    restart resumes having lost at most the in-flight step.
+
+    Preserved across the task-error boundary (core_worker keeps the
+    type instead of wrapping it in TaskError) so the driver can route
+    it to the preemption recovery path instead of the crash path."""
+
+    def __init__(self, grace_s: float = 0.0, rank: int = -1):
+        self.grace_s = float(grace_s)
+        self.rank = rank
+        super().__init__(
+            f"training worker rank {rank} preempted (grace window "
+            f"{self.grace_s:g}s): aborted at the step boundary after the "
+            f"proactive checkpoint save")
+
+    def __reduce__(self):
+        return (type(self), (self.grace_s, self.rank))
+
+
+class TrainHungError(RayTpuError):
+    """The gang made no observable progress (no report consumed, no
+    step beacon advanced) for longer than ``train_hang_timeout_s``.
+
+    Carries the watchdog's diagnosis: which ranks lag the gang's
+    furthest step, how stale each rank's last beacon is, and the live
+    per-rank thread stacks collected through the hostd stack-collection
+    RPC — a bounded, diagnosed failure instead of an infinite wait in a
+    collective."""
+
+    def __init__(self, timeout_s: float = 0.0, laggard_ranks=None,
+                 beacon_ages=None, stacks: str = ""):
+        self.timeout_s = float(timeout_s)
+        self.laggard_ranks = list(laggard_ranks or [])
+        self.beacon_ages = dict(beacon_ages or {})
+        self.stacks = stacks
+        ages = ", ".join(
+            f"rank {r}: {self.beacon_ages.get(r, -1.0):.1f}s"
+            for r in self.laggard_ranks)
+        super().__init__(
+            f"training gang hung: no progress for {self.timeout_s:g}s; "
+            f"laggard rank(s) {self.laggard_ranks} "
+            f"(last beacon age {ages or 'unknown'})"
+            + (f"\n--- live worker stacks ---\n{stacks}" if stacks else ""))
+
+    def __reduce__(self):
+        return (type(self), (self.timeout_s, self.laggard_ranks,
+                             self.beacon_ages, self.stacks))
+
+
 class ObjectLostError(RayTpuError):
     """An object was evicted/lost and could not be reconstructed."""
 
@@ -139,6 +192,8 @@ __all__ = [
     "ActorUnavailableError",
     "ServeOverloadedError",
     "ReplicaStreamLostError",
+    "TrainPreemptedError",
+    "TrainHungError",
     "ObjectLostError",
     "ObjectStoreFullError",
     "RuntimeEnvSetupError",

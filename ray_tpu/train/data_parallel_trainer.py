@@ -118,21 +118,30 @@ class DataParallelTrainer(BaseTrainer):
         last_checkpoint = self._resolve_resume(manager)
         error: Optional[BaseException] = None
 
-        # Per-worker streaming ingest: each worker iterates only ITS
-        # shard (reference: DataParallelTrainer datasets= +
-        # session.get_dataset_shard over streaming_split).
-        dataset_shards = {
-            name: ds.streaming_split(self.scaling_config.num_workers,
-                                     equal=True)
-            for name, ds in self._datasets.items()}
-
         executor.start()
         try:
             while True:
+                # Datasets travel raw: the executor splits by the ACTUAL
+                # gang size each (re)start, so an elastic resize
+                # re-shards by the new world size (reference:
+                # DataParallelTrainer datasets= + streaming_split).
                 executor.start_training(train_fn, last_checkpoint,
-                                        dataset_shards)
+                                        self._datasets)
+                resized = False
                 try:
                     while True:
+                        # Step-boundary resize-up: returned capacity is
+                        # re-admitted between reports, resuming from the
+                        # latest committed step — voluntary, so it never
+                        # burns the failure budget.
+                        if executor.should_resize_up():
+                            executor.resize_up()
+                            committed = \
+                                executor.latest_committed_checkpoint()
+                            if committed is not None:
+                                last_checkpoint = committed
+                            resized = True
+                            break
                         results = executor.get_next_results()
                         if results is None:
                             break
@@ -143,13 +152,29 @@ class DataParallelTrainer(BaseTrainer):
                             self._persist_checkpoint(last_checkpoint,
                                                      len(history), metrics)
                         history.append(metrics)
+                    if resized:
+                        continue
                     executor.finish_training()
                     break
                 except Exception as e:  # worker failure path
                     if isinstance(e, KeyboardInterrupt):
                         raise
                     if executor.can_restart():
-                        executor.restart()
+                        from ray_tpu.exceptions import (
+                            TrainHungError, TrainPreemptedError)
+
+                        def _reason(err):
+                            seen = set()
+                            while err is not None and id(err) not in seen:
+                                seen.add(id(err))
+                                if isinstance(err, TrainPreemptedError):
+                                    return "preempted"
+                                if isinstance(err, TrainHungError):
+                                    return "hang"
+                                err = getattr(err, "cause", None) \
+                                    or err.__cause__
+                            return "failure"
+                        executor.restart(reason=_reason(e))
                         # Elastic resume point: the latest COMMITTED step
                         # — an async save the dead gang never finished has
                         # no COMMIT marker and is skipped by construction.

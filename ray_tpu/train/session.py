@@ -13,10 +13,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.exceptions import TrainPreemptedError
 
 _session: Optional["_TrainSession"] = None
 
@@ -50,6 +52,21 @@ class _TrainSession:
         self.error: Optional[BaseException] = None
         self.finished = False
         self._stop = False
+        # Progress beacon: step counter + wall time of the last completed
+        # step boundary, polled by the driver watchdog through the actor's
+        # concurrent beacon() method while get_next blocks.
+        self._beacon_step = 0
+        self._beacon_t = time.monotonic()
+        # Preemption notice state: armed by the hostd fan-out (via the
+        # CoreWorker PreemptionNotice RPC); consumed at the next report()
+        # step boundary — run the grace-window save hook, then abort with
+        # TrainPreemptedError so at most the in-flight step is lost.
+        self._preempt_pending = False
+        self._preempt_deadline: Optional[float] = None
+        self._preempt_grace = 0.0
+        self._preempt_hook: Optional[Callable[[float], Any]] = None
+        # Interruptible chaos stall (hang injection for the watchdog).
+        self._stall_abort = threading.Event()
 
         def run():
             global _session
@@ -77,11 +94,55 @@ class _TrainSession:
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
         if self._stop:
             raise StopIteration  # unblocks and ends the user loop
+        # Chaos stall BEFORE the beacon update: the stalled rank's beacon
+        # stays at the previous step, so the driver watchdog classifies
+        # it as the laggard.  Interruptible via stop().
+        from ray_tpu._private.fault_injection import get_chaos
+        chaos = get_chaos()
+        if chaos is not None:
+            stall = chaos.stall_train_step()
+            if stall:
+                self._stall_abort.wait(stall)
+                if self._stop:
+                    raise StopIteration
+        self._beacon_step += 1
+        self._beacon_t = time.monotonic()
+        if self._preempt_pending:
+            # Step boundary after a preemption notice: run the proactive
+            # save hook with whatever is left of the grace window, then
+            # abort — resuming from this save loses at most the step that
+            # was in flight when the notice landed.
+            self._preempt_pending = False
+            remaining = self._preempt_grace
+            if self._preempt_deadline is not None:
+                remaining = max(0.0,
+                                self._preempt_deadline - time.monotonic())
+            if self._preempt_hook is not None:
+                try:
+                    self._preempt_hook(remaining)
+                except Exception:
+                    pass  # a failed rescue save must not mask the abort
+            raise TrainPreemptedError(self._preempt_grace,
+                                      self.context.world_rank)
         self.result_queue.put((metrics, checkpoint))  # blocks when full
         self.continue_event.wait()
         self.continue_event.clear()
         if self._stop:
             raise StopIteration
+
+    def notify_preemption(self, grace_s: float) -> None:
+        """Arm the step-boundary abort (called from the CoreWorker
+        PreemptionNotice RPC thread)."""
+        self._preempt_grace = float(grace_s)
+        self._preempt_deadline = time.monotonic() + float(grace_s)
+        self._preempt_pending = True
+
+    def beacon(self) -> dict:
+        """Progress snapshot for the driver watchdog (served through a
+        concurrent actor method while get_next blocks)."""
+        return {"step": self._beacon_step,
+                "age_s": time.monotonic() - self._beacon_t,
+                "finished": self.finished}
 
     def get_next(self, timeout: float | None = None):
         """Driver side (via actor RPC): next report, or None when done.
@@ -113,6 +174,7 @@ class _TrainSession:
     def stop(self):
         self._stop = True
         self.continue_event.set()
+        self._stall_abort.set()  # wake an injected stall so teardown works
 
 
 def get_session() -> "_TrainSession":
@@ -167,6 +229,26 @@ def get_dataset_shard(name: str = "train"):
             f"no dataset shard {name!r}; pass datasets={{{name!r}: ds}} to "
             f"the trainer")
     return shard
+
+
+def set_preemption_hook(fn: Callable[[float], Any]) -> None:
+    """Register the grace-window rescue: on a preemption notice, `fn`
+    runs at the next step boundary with the REMAINING grace seconds and
+    should save a checkpoint (typically
+    ``get_checkpoint_manager().save(state, step).wait()``).  report()
+    then aborts the loop with TrainPreemptedError, so an elastic restart
+    resumes from this save having lost at most the in-flight step."""
+    get_session()._preempt_hook = fn
+
+
+def preemption_deadline() -> Optional[float]:
+    """Seconds until this host is reclaimed, or None if no preemption
+    notice is pending — lets a train loop skip non-essential work (eval,
+    logging) when the clock is running."""
+    sess = get_session()
+    if sess._preempt_deadline is None:
+        return None
+    return max(0.0, sess._preempt_deadline - time.monotonic())
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

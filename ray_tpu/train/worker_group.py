@@ -41,6 +41,21 @@ class RayTrainWorker:
     def get_next(self, timeout: float | None = None):
         return self._session.get_next(timeout)
 
+    def beacon(self):
+        """Progress snapshot for the driver hang watchdog.  Runs on a
+        concurrent actor thread (max_concurrency > 1) so it answers even
+        while get_next blocks in the result queue."""
+        sess = getattr(self, "_session", None)
+        return sess.beacon() if sess is not None else None
+
+    def stop_session(self):
+        """Ask the session's user thread to exit at its next report —
+        the cooperative teardown a resize uses before re-forming."""
+        sess = getattr(self, "_session", None)
+        if sess is not None:
+            sess.stop()
+        return True
+
     def finish_session(self):
         self._session.finish()
         return True
@@ -59,38 +74,47 @@ class Worker:
     actor: Any
     rank: int
     node_id: str = ""
+    pid: int = 0
 
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: dict,
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 pg_timeout_s: float = 120.0):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         self._pg: Optional[PlacementGroup] = placement_group(
             [dict(resources_per_worker) for _ in range(num_workers)],
             strategy=placement_strategy)
-        if not self._pg.wait(120):
+        if not self._pg.wait(pg_timeout_s):
             remove_placement_group(self._pg)
             raise RuntimeError(
                 f"could not reserve {num_workers} x {resources_per_worker} "
-                f"(strategy {placement_strategy}) within 120s")
+                f"(strategy {placement_strategy}) within {pg_timeout_s:g}s")
         self.workers: List[Worker] = []
         try:
             res = dict(resources_per_worker)
             cpu = res.pop("CPU", 0)
             tpu = res.pop("TPU", None)
+            # max_concurrency: beacon() must answer on a second actor
+            # thread while get_next blocks in the result queue.
             actor_cls = RayTrainWorker.options(
-                num_cpus=cpu, num_tpus=tpu, resources=res or None)
+                num_cpus=cpu, num_tpus=tpu, resources=res or None,
+                max_concurrency=4)
             for rank in range(num_workers):
                 actor = actor_cls.options(
                     placement_group=self._pg,
                     placement_group_bundle_index=rank).remote()
                 self.workers.append(Worker(actor=actor, rank=rank))
-            # Resolve worker placement (node ids) for local-rank assignment.
+            # Resolve worker placement (node ids + pids): local-rank
+            # assignment and the watchdog's per-node stack collection.
             node_ids = ray_tpu.get(
                 [w.actor.node_id.remote() for w in self.workers], timeout=120)
-            for w, nid in zip(self.workers, node_ids):
+            pids = ray_tpu.get(
+                [w.actor.pid.remote() for w in self.workers], timeout=120)
+            for w, nid, pid in zip(self.workers, node_ids, pids):
                 w.node_id = nid
+                w.pid = pid
         except Exception:
             # Don't leak the gang's reserved bundles if construction fails
             # partway (the wait-timeout path above already cleans up).

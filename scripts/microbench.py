@@ -162,6 +162,78 @@ def bench_serve(results: dict):
         serve.shutdown()
 
 
+def bench_train_ft(results: dict):
+    """Train fault-tolerance microbenches: the preemption-notice step
+    boundary (rescue save + commit + abort — the latency that must fit
+    inside the grace window), and a gang down-shift cycle (full-size
+    group torn down, smaller group re-formed: PG release, re-placement,
+    actor spawn, worker boot) — the elastic resize-down path minus
+    checkpoint replay."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu.checkpoint import CheckpointManager
+    from ray_tpu.exceptions import TrainPreemptedError
+    from ray_tpu.train.session import TrainContext, _TrainSession
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    root = tempfile.mkdtemp(prefix="microbench_train_ft_")
+    state = {"w": np.zeros((256, 256), np.float32), "step": 0}
+    ctx = TrainContext(world_rank=0, world_size=1, local_rank=0,
+                       local_world_size=1, node_rank=0)
+    ops = iter(range(10_000))
+    try:
+        def preempt_save(n):
+            # One op = a notice-to-abort boundary on a live session: the
+            # notice arms mid-step, the next report() runs the rescue
+            # hook (durable 256 KiB save, wait for COMMIT) and aborts
+            # with TrainPreemptedError.
+            for _ in range(n):
+                i = next(ops)
+                mgr = CheckpointManager(root, save_id=f"mb{i}")
+                box = {}
+
+                def fn():
+                    while True:
+                        box["s"].report({"ok": 1})
+
+                def rescue(remaining_s, mgr=mgr, i=i):
+                    h = mgr.save(i, state)
+                    if not h._event.wait(30):
+                        raise TimeoutError("rescue save did not commit")
+
+                sess = _TrainSession(fn, ctx)
+                box["s"] = sess
+                sess._preempt_hook = rescue
+                sess.start()
+                sess.get_next(timeout=10)          # first step delivered
+                sess.notify_preemption(grace_s=5.0)
+                try:
+                    while sess.get_next(timeout=10) is not None:
+                        pass
+                    raise AssertionError("session ended without abort")
+                except TrainPreemptedError:
+                    pass
+                mgr.wait_until_finished()
+
+        timeit("train_preempt_save", preempt_save, 10, results)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    def resize_down(n):
+        # One op = a down-shift cycle: form the full-size gang, tear it
+        # down (lost node), re-form one worker smaller.
+        for _ in range(n):
+            wg2 = WorkerGroup(2, {"CPU": 1}, "PACK", pg_timeout_s=30.0)
+            wg2.shutdown()
+            wg1 = WorkerGroup(1, {"CPU": 1}, "PACK", pg_timeout_s=30.0)
+            wg1.shutdown()
+
+    timeit("train_resize_down", resize_down, 2, results, settle=1.0)
+
+
 def main():
     ray_tpu.init(num_cpus=8, object_store_memory=256 << 20)
     results: dict = {}
@@ -353,6 +425,9 @@ def main():
 
     # --- serve: failover-resume + drain cycles -----------------------------
     bench_serve(results)
+
+    # --- train: preempt-boundary rescue save + gang down-shift -------------
+    bench_train_ft(results)
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MICROBENCH.json")

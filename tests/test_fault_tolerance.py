@@ -526,6 +526,350 @@ def test_overload_sheds_and_recovers(serve_chaos_cluster):
 
 
 # ---------------------------------------------------------------------------
+# Scenario 7: preemption notice -> grace-window save -> resume loses at most
+# the in-flight step
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 606,
+      # Scripted maintenance event: the head hostd receives a preemption
+      # NOTICE (not an instant kill) at its 9th heartbeat tick (~4.5s in,
+      # while the train loop is mid-run) with a 5s grace window.  The
+      # session's preemption hook saves the current step inside the
+      # window; the hostd kills the workers when it expires.
+      "chaos_preempt_at": 8,
+      "chaos_preempt_target": "head",
+      "chaos_preempt_grace_s": 5.0}],
+    indirect=True)
+def test_preemption_grace_save_resumes_with_at_most_one_step_lost(
+        chaos_cluster, tmp_path):
+    """ISSUE acceptance criterion: a scripted preemption with a 5s grace
+    window triggers a proactive checkpoint save; the elastic restart
+    resumes from it having lost at most the step that was in flight when
+    the notice landed."""
+    from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.checkpoint import is_committed
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu.train import session
+
+        mgr = session.get_checkpoint_manager()
+        holder = {}
+
+        def rescue(remaining_s):
+            # Grace-window save: runs at the next step boundary after the
+            # notice, racing the remaining grace seconds.
+            h = mgr.save(holder["step"], dict(holder["state"]))
+            h._event.wait(30)
+
+        session.set_preemption_hook(rescue)
+        start = 0
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            start = int(ckpt.to_dict()["step"]) + 1
+        for step in range(start, 6):
+            holder["step"] = step
+            holder["state"] = {"w": np.full((8,), float(step)),
+                               "step": step}
+            if step == 0:
+                # The only PERIODIC save: everything after step 0 is
+                # recoverable solely through the grace-window rescue.
+                h = mgr.save(step, dict(holder["state"]))
+                h._event.wait(30)
+            time.sleep(1.2)
+            session.report({"step": step, "resumed_from": start})
+
+    from ray_tpu.train import DataParallelTrainer
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="preempt", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 5
+    resumes = sorted({m["resumed_from"] for m in result.metrics_history})
+    assert len(resumes) == 2 and resumes[0] == 0
+    r2 = resumes[1]
+    assert r2 >= 1
+    # Exactly the in-flight step is missing from the delivered history:
+    # its report() aborted with TrainPreemptedError AFTER the rescue
+    # saved its state, so the restart resumed one past it.
+    steps = {m["step"] for m in result.metrics_history}
+    assert set(range(6)) - steps == {r2 - 1}
+    # The step we resumed from exists only because the rescue committed
+    # it inside the grace window (periodic saves stopped at step 0).
+    assert is_committed(str(tmp_path / "preempt"
+                            / f"checkpoint_{r2 - 1:06d}"))
+    from ray_tpu.util import metrics
+    assert (metrics.read("train_recoveries",
+                         {"reason": "preempted"}) or 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Scenario 8: scripted stall -> hang watchdog names the laggard rank with
+# live stacks
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 52,
+      # Scripted straggler: the SECOND spawned worker's 2nd report()
+      # stalls (default chaos_stall_s is effectively forever but
+      # interruptible), freezing its beacon at step 1 while its healthy
+      # peer advances — exactly the asymmetric-hang shape a watchdog
+      # must classify.
+      "chaos_stall_worker_salts": "2",
+      "chaos_stall_at": 1,
+      "train_hang_timeout_s": 6.0,
+      "train_beacon_poll_s": 1.0}],
+    indirect=True)
+def test_hang_watchdog_detects_stalled_rank_with_stacks(chaos_cluster):
+    """ISSUE acceptance criterion: a scripted stall is detected within
+    train_hang_timeout_s and the TrainHungError names the laggard rank
+    and carries per-rank thread stacks from the hostd stack-collection
+    RPC — instead of the gang blocking forever in a collective."""
+    from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.exceptions import TrainHungError
+    from ray_tpu.train import DataParallelTrainer
+
+    def loop(config):
+        from ray_tpu.train import session
+        for step in range(4):
+            time.sleep(0.2)
+            session.report({"step": step})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=0)))
+    t0 = time.monotonic()
+    result = trainer.fit()
+    elapsed = time.monotonic() - t0
+    err = result.error
+    assert isinstance(err, TrainHungError), f"got {err!r}"
+    assert err.timeout_s == 6.0
+    # Exactly one rank is the straggler; the healthy rank (blocked on the
+    # driver, beacon at a HIGHER step) must not be blamed.
+    assert len(err.laggard_ranks) == 1
+    assert err.beacon_ages, "laggard beacon ages missing"
+    # Live stacks collected through hostd CollectStacks: the stalled user
+    # thread is parked under session.report.
+    assert err.stacks and "thread" in err.stacks
+    assert "report" in err.stacks or "wait" in err.stacks
+    assert "--- live worker stacks ---" in str(err)
+    assert _metric("train_hangs") >= 1
+    # Detected via the watchdog, not some multi-minute RPC timeout.
+    assert elapsed < 60
+
+
+# ---------------------------------------------------------------------------
+# Scenario 9: node loss -> gang resizes DOWN onto survivors, token-exact
+# with a restart-from-checkpoint baseline
+# ---------------------------------------------------------------------------
+
+def test_resize_down_on_node_loss_token_exact(tmp_path):
+    """ISSUE acceptance criterion: killing one of two single-CPU nodes
+    mid-run re-forms the gang at world size 1 on the survivor (instead
+    of waiting forever for a replacement) and the final weights are
+    token-exact with replaying from the same COMMITTED step — and with
+    a clean unfaulted run."""
+    import numpy as np
+
+    from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import DataParallelTrainer
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    node2 = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.gcs_address, _system_config={
+        # Fast descending gang formation: the post-loss full-size attempt
+        # gives up in 3s and re-forms on the survivor.
+        "train_pg_timeout_s": 3.0,
+        "train_elastic_timeout_s": 60.0})
+    N = 10
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu.train import session
+
+        mgr = session.get_checkpoint_manager()
+        ctx = session.get_context()
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            state = ckpt.to_dict()
+            start = int(state["step"]) + 1
+            w = np.asarray(state["w"]).copy()
+        else:
+            start, w = 0, np.zeros(4)
+        for step in range(start, 10):
+            w = w + (step + 1)  # rank-independent: exactness is checkable
+            h = None
+            if ctx.world_rank == 0:
+                h = mgr.save(step, {"w": w, "step": step})
+                h._event.wait(30)
+            time.sleep(0.5)
+            session.report({"step": step, "resumed_from": start,
+                            "world_size": ctx.world_size}, checkpoint=h)
+
+    root = tmp_path / "resize_down"
+
+    def killer():
+        # Kill the second node only once training has demonstrably
+        # progressed at world size 2 (step-2 save on shared storage).
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (root / "checkpoint_000002").exists():
+                time.sleep(0.3)
+                cluster.remove_node(node2)
+                return
+            time.sleep(0.1)
+
+    try:
+        trainer = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, min_workers=1,
+                resources_per_worker={"CPU": 1}),
+            run_config=RunConfig(
+                name="resize_down", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2)))
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        result = trainer.fit()
+        kt.join(60)
+        assert result.error is None
+        assert result.metrics["step"] == N - 1
+        sizes = {m["world_size"] for m in result.metrics_history}
+        assert sizes == {2, 1}, f"gang sizes seen: {sizes}"
+        resumes = sorted({m["resumed_from"]
+                          for m in result.metrics_history})
+        assert len(resumes) == 2 and resumes[0] == 0
+        r2 = resumes[1]
+        final = np.asarray(result.checkpoint.to_dict()["w"])
+        # Token-exact vs the restart-from-checkpoint baseline: replay
+        # from the SAME committed step the resized gang resumed from.
+        base = Checkpoint.from_sharded_dir(
+            str(root / f"checkpoint_{r2 - 1:06d}")).to_dict()
+        w_base = np.asarray(base["w"]).copy()
+        for s in range(r2, N):
+            w_base = w_base + (s + 1)
+        np.testing.assert_array_equal(final, w_base)
+        # ... which is also exactly the unfaulted full run.
+        clean = np.zeros(4)
+        for s in range(N):
+            clean = clean + (s + 1)
+        np.testing.assert_array_equal(final, clean)
+        from ray_tpu.util import metrics
+        assert (metrics.read("train_recoveries",
+                             {"reason": "failure"}) or 0) >= 1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+# ---------------------------------------------------------------------------
+# Scenario 10: returned capacity -> gang resizes UP at a step boundary
+# ---------------------------------------------------------------------------
+
+def test_resize_up_readmits_returned_node(tmp_path):
+    """ISSUE acceptance criterion: a gang that started below target size
+    (only one single-CPU node available) re-admits a returning node at a
+    step boundary — growing to full size mid-run without losing
+    committed progress and without burning the failure budget."""
+    import numpy as np
+
+    from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import DataParallelTrainer
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.gcs_address, _system_config={
+        "train_pg_timeout_s": 2.0,
+        "train_elastic_timeout_s": 60.0,
+        "train_resize_check_interval_s": 0.5})
+    N = 10
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu.train import session
+
+        mgr = session.get_checkpoint_manager()
+        ctx = session.get_context()
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            state = ckpt.to_dict()
+            start = int(state["step"]) + 1
+            w = np.asarray(state["w"]).copy()
+        else:
+            start, w = 0, np.zeros(4)
+        for step in range(start, 10):
+            w = w + (step + 1)
+            h = None
+            if ctx.world_rank == 0:
+                h = mgr.save(step, {"w": w, "step": step})
+                h._event.wait(30)
+            time.sleep(0.4)
+            session.report({"step": step, "world_size": ctx.world_size},
+                           checkpoint=h)
+
+    root = tmp_path / "resize_up"
+
+    def returner():
+        # Add the second node only after the undersized gang has
+        # committed progress, so both world sizes provably trained.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (root / "checkpoint_000001").exists():
+                time.sleep(0.2)
+                cluster.add_node(num_cpus=1)
+                return
+            time.sleep(0.1)
+
+    try:
+        trainer = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, min_workers=1,
+                resources_per_worker={"CPU": 1}),
+            run_config=RunConfig(
+                name="resize_up", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2)))
+        rt = threading.Thread(target=returner, daemon=True)
+        rt.start()
+        result = trainer.fit()
+        rt.join(60)
+        assert result.error is None
+        assert result.metrics["step"] == N - 1
+        sizes = {m["world_size"] for m in result.metrics_history}
+        assert sizes == {1, 2}, f"gang sizes seen: {sizes}"
+        # Token-exact through the voluntary resize: replayed steps after
+        # the committed resume point fold into the same final weights.
+        final = np.asarray(result.checkpoint.to_dict()["w"])
+        clean = np.zeros(4)
+        for s in range(N):
+            clean = clean + (s + 1)
+        np.testing.assert_array_equal(final, clean)
+        from ray_tpu.util import metrics
+        assert (metrics.read("train_recoveries",
+                             {"reason": "resize_up"}) or 0) >= 1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+# ---------------------------------------------------------------------------
 # Node-death propagation plumbing (unit level)
 # ---------------------------------------------------------------------------
 
