@@ -1,0 +1,174 @@
+"""Input-pipeline benchmark: sync iter_batches vs the overlapped device feed.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Runs the same input-bound training loop (jitted matmul step over a
+materialized dataset's float32 feature column) two ways:
+
+  * sync baseline — `iter_batches()` fetches + assembles on the training
+    thread, then a blocking `jax.device_put` stages the batch, then the
+    step runs: fetch latency, assembly, H2D, and compute all serialize;
+  * overlapped — `iter_device_batches()`: a background thread fetches and
+    assembles into a bounded queue while double-buffered H2D staging keeps
+    the next batch in flight during the current step, so the step loop
+    only ever waits when the producer is genuinely behind.
+
+Block-fetch latency is EMULATED (`--fetch-latency-ms`, default 40): on
+this single-node bench host every block is already sealed in the local
+shm store, whereas the production trainer pulls shard blocks from peer
+hosts' stores (or storage) with a real per-block RTT.  The emulation adds
+that RTT in `_block_iter` — the same hook both the sync and overlapped
+paths consume — so the two modes pay identical ingest cost and differ
+only in WHERE it is paid (training thread vs background producer).  Both
+paths run the same assembly/H2D code on the same blocks; the exactness
+gate checks the overlapped feed is numerically identical to the sync
+path before anything is timed.
+
+Reports overlapped steps/s; `vs_baseline` is the ratio over sync.  The
+device-idle fraction per mode (time the step loop spent waiting on data:
+measured ingest+H2D time for sync, the producer-starved wait for
+overlapped) shows the mechanism.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=8192)
+    ap.add_argument("--blocks", type=int, default=16)
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--step-iters", type=int, default=4,
+                    help="matmul iterations per jitted step (compute knob)")
+    ap.add_argument("--fetch-latency-ms", type=float, default=40.0,
+                    help="emulated per-block fetch RTT (cross-host object "
+                         "transfer on a real cluster; 0 disables)")
+    ap.add_argument("--rounds", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.data import DataIterator
+
+    fetch_s = args.fetch_latency_ms / 1000.0
+
+    class EmulatedFetchIterator(DataIterator):
+        """Adds the emulated cross-host fetch RTT per block, in the
+        `_block_iter` hook shared by iter_batches AND iter_device_batches
+        — both modes pay it; only the paying thread differs."""
+
+        def _block_iter(self, prefetch: int = 4):
+            for b in super()._block_iter(prefetch):
+                if fetch_s:
+                    time.sleep(fetch_s)
+                yield b
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 << 20)
+    try:
+        dim, bs, iters = args.dim, args.batch_size, args.step_iters
+
+        def add_x(b):
+            ids = b["id"].astype(np.float32)
+            b["x"] = np.repeat(ids[:, None], dim, axis=1) * 1e-3
+            return b
+
+        ds = (rd.range(args.rows, parallelism=args.blocks)
+              .map_batches(add_x).materialize())
+        it = EmulatedFetchIterator(ds.streaming_split(1)[0]._refs)
+
+        @jax.jit
+        def step(w, x):
+            y = x
+            for _ in range(iters):
+                y = jnp.tanh(y @ w)
+            return y.sum()
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (dim, dim),
+                              jnp.float32) * 0.05
+        step(w, jnp.zeros((bs, dim), jnp.float32)).block_until_ready()
+
+        # -- exactness gate -------------------------------------------------
+        sync_ref = [b["x"].copy()
+                    for b in it.iter_batches(batch_size=bs, drop_last=True)]
+        dev_feed = it.iter_device_batches(batch_size=bs, drop_last=True)
+        dev_ref = [np.asarray(b["x"]) for b in dev_feed]
+        assert len(sync_ref) == len(dev_ref) > 0
+        for a, b in zip(sync_ref, dev_ref):
+            np.testing.assert_array_equal(a, b)
+        del sync_ref, dev_ref
+        n_steps = args.rows // bs
+
+        # -- sync baseline --------------------------------------------------
+        def run_sync():
+            ingest_s = 0.0
+            t0 = time.perf_counter()
+            gen = iter(it.iter_batches(batch_size=bs, drop_last=True))
+            steps = 0
+            while True:
+                ti = time.perf_counter()
+                batch = next(gen, None)
+                if batch is None:
+                    break
+                x = jax.device_put(batch["x"])
+                x.block_until_ready()
+                ingest_s += time.perf_counter() - ti
+                step(w, x).block_until_ready()
+                steps += 1
+            wall = time.perf_counter() - t0
+            assert steps == n_steps
+            return wall, ingest_s / wall
+
+        # -- overlapped device feed -----------------------------------------
+        def run_overlapped():
+            t0 = time.perf_counter()
+            feed = it.iter_device_batches(batch_size=bs, drop_last=True)
+            steps = 0
+            for batch in feed:
+                step(w, batch["x"]).block_until_ready()
+                steps += 1
+            wall = time.perf_counter() - t0
+            assert steps == n_steps
+            stats = feed.stats()
+            return wall, stats["consumer_wait_s"] / wall
+
+        run_sync()          # warm both paths once before timing
+        run_overlapped()
+        sync_runs = [run_sync() for _ in range(args.rounds)]
+        over_runs = [run_overlapped() for _ in range(args.rounds)]
+
+        sync_wall = statistics.median(r[0] for r in sync_runs)
+        over_wall = statistics.median(r[0] for r in over_runs)
+        sync_sps = n_steps / sync_wall
+        over_sps = n_steps / over_wall
+        print(json.dumps({
+            "metric": "ingest_overlapped_steps_s",
+            "value": round(over_sps, 2),
+            "unit": "steps_per_s",
+            "vs_baseline": round(over_sps / sync_sps, 3),
+            "steps_s_sync": round(sync_sps, 2),
+            "device_idle_frac_sync":
+                round(statistics.median(r[1] for r in sync_runs), 3),
+            "device_idle_frac_overlapped":
+                round(statistics.median(r[1] for r in over_runs), 3),
+            "exactness_gate": "passed",
+            "steps_per_epoch": n_steps,
+            "batch_mib": round(bs * dim * 4 / (1 << 20), 2),
+            "fetch_latency_ms": args.fetch_latency_ms,
+            "rounds": args.rounds,
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

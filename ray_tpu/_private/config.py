@@ -164,6 +164,24 @@ _D("worker_sigterm_grace_s", 3.0, float,
    "bounded SIGTERM -> wait -> SIGKILL escalation window: how long a "
    "terminated worker may finish its in-flight task before the kill "
    "(hostd child teardown and the worker's own SIGTERM handler)")
+# -- ingest / device feed --------------------------------------------------
+_D("ingest_queue_depth", 2, int,
+   "bounded handoff queue between the background batch producer and the "
+   "training thread (batches buffered ahead of the consumer)")
+_D("ingest_prefetch_blocks", 4, int,
+   "block refs the ingest path touches ahead of the blocking fetch")
+_D("ingest_device_buffers", 2, int,
+   "device batches kept in flight by iter_device_batches: while the "
+   "jitted step consumes batch k, batch k+1 is already being device_put")
+_D("ingest_work_stealing", False, _bool,
+   "trainer dataset shards lease blocks from a SplitCoordinator instead "
+   "of static per-worker lists — a straggler no longer strands its "
+   "shard.  Off by default: the static split is deterministic "
+   "(token-exact elastic restores)")
+_D("ingest_lease_timeout_s", 30.0, float,
+   "a work-stealing split re-queues a worker's outstanding block leases "
+   "once the worker has been silent this long AND the fresh pool is "
+   "exhausted (crash recovery; mark_dead re-queues immediately)")
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")

@@ -7,12 +7,19 @@ ingest.
 """
 
 from ray_tpu.data.dataset import (  # noqa: F401
+    CoordinatedDataIterator,
     DataIterator,
     Dataset,
     DatasetPipeline,
     GroupedData,
 )
 from ray_tpu.data.executor import ActorPoolStrategy  # noqa: F401
+from ray_tpu.data.ingest import (  # noqa: F401
+    BatchAssembler,
+    BatchProducer,
+    DeviceBatchIterator,
+    SplitCoordinator,
+)
 from ray_tpu.data.preprocessors import (  # noqa: F401
     BatchMapper,
     Chain,

@@ -21,6 +21,7 @@ import pyarrow as pa
 
 import ray_tpu
 from ray_tpu.data import block as blk
+from ray_tpu.data import ingest
 from ray_tpu.data.executor import (
     ActorPoolStrategy,
     AllToAll, ExecPlan, OneToOne, execute, iter_output_refs)
@@ -376,15 +377,36 @@ class Dataset:
         in-flight materialization."""
         return DatasetPipeline(self, blocks_per_window=blocks_per_window)
 
-    def streaming_split(self, n: int, *, equal: bool = False
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        steal: bool = False, deterministic: bool = False,
+                        lease_timeout_s: Optional[float] = None
                         ) -> List["DataIterator"]:
         """n independent streaming iterators, one per consumer (Train
         workers): each holds only ITS shard's block refs and pulls blocks
         with bounded prefetch — no driver round-trips during iteration
         (reference: dataset.streaming_split / DataIterator).  Picklable:
-        pass them to actors."""
+        pass them to actors.
+
+        steal=True replaces the static per-worker lists with a
+        SplitCoordinator actor that LEASES blocks dynamically: a worker
+        drains its own shard first (local-store blocks first), then
+        steals from the slowest peer's tail, and a dead worker's
+        outstanding leases re-queue — a straggler host no longer strands
+        its shard.  `deterministic=True` keeps the coordinator but serves
+        each worker exactly its static shard in order (byte-identical to
+        steal=False), for token-exact elastic-restore runs."""
         shards = self.split(n, equal=equal)
-        return [DataIterator(d._execute()) for d in shards]
+        if not steal:
+            return [DataIterator(d._execute()) for d in shards]
+        shard_refs = [d._execute() for d in shards]
+        pool: List[Any] = []
+        queues: List[List[int]] = []
+        for refs in shard_refs:
+            queues.append(list(range(len(pool), len(pool) + len(refs))))
+            pool.extend(refs)
+        coord = ingest.SplitCoordinator.remote(
+            queues, deterministic, lease_timeout_s)
+        return [CoordinatedDataIterator(pool, coord, i) for i in range(n)]
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -448,24 +470,14 @@ class Dataset:
                      drop_last: bool = False,
                      prefetch_blocks: int = 4) -> Iterator[Any]:
         """Streaming batches with block prefetch (backpressure via the
-        executor's in-flight window)."""
-        buffer: List[pa.Table] = []
-        buffered = 0
-        for r in iter_output_refs(self._plan, window=max(1, prefetch_blocks)):
-            b = ray_tpu.get(r)
-            if b.num_rows == 0:
-                continue
-            buffer.append(b)
-            buffered += b.num_rows
-            while buffered >= batch_size:
-                whole = blk.concat_blocks(buffer)
-                piece = blk.slice_block(whole, 0, batch_size)
-                rest = blk.slice_block(whole, batch_size, whole.num_rows)
-                buffer = [rest] if rest.num_rows else []
-                buffered = rest.num_rows
-                yield blk.block_to_batch(piece, batch_format)
-        if buffered and not drop_last:
-            yield blk.block_to_batch(blk.concat_blocks(buffer), batch_format)
+        executor's in-flight window).  Assembly is incremental — a row
+        cursor over the buffered blocks (ingest.BatchAssembler) — so
+        each batch costs O(batch rows) regardless of the block-to-batch
+        ratio."""
+        blocks = (ray_tpu.get(r) for r in iter_output_refs(
+            self._plan, window=max(1, prefetch_blocks)))
+        return ingest.batches_from_block_iter(
+            blocks, batch_size, batch_format, drop_last)
 
     def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
         import torch
@@ -637,32 +649,12 @@ class GroupedData:
 
 def _batches_from_refs(refs, batch_size, batch_format, drop_last,
                        prefetch: int = 4):
-    """Yield batches from block refs with bounded prefetch."""
-    buffer: List[pa.Table] = []
-    buffered = 0
-    pending = list(refs)
-    i = 0
-    while i < len(pending):
-        # Touch ahead: ray_tpu.wait warms up to `prefetch` blocks.
-        ahead = pending[i:i + prefetch]
-        if len(ahead) > 1:
-            ray_tpu.wait(ahead, num_returns=len(ahead), timeout=0,
-                         fetch_local=True)
-        b = ray_tpu.get(pending[i])
-        i += 1
-        if b.num_rows == 0:
-            continue
-        buffer.append(b)
-        buffered += b.num_rows
-        while buffered >= batch_size:
-            whole = blk.concat_blocks(buffer)
-            piece = blk.slice_block(whole, 0, batch_size)
-            rest = blk.slice_block(whole, batch_size, whole.num_rows)
-            buffer = [rest] if rest.num_rows else []
-            buffered = rest.num_rows
-            yield blk.block_to_batch(piece, batch_format)
-    if buffered and not drop_last:
-        yield blk.block_to_batch(blk.concat_blocks(buffer), batch_format)
+    """Yield batches from block refs with bounded touch-ahead prefetch.
+    Assembly is incremental (ingest.BatchAssembler): O(batch rows) per
+    batch, where the old path re-concatenated the whole buffered tail."""
+    return ingest.batches_from_block_iter(
+        ingest.iter_blocks_from_refs(refs, prefetch),
+        batch_size, batch_format, drop_last)
 
 
 class DataIterator:
@@ -672,15 +664,48 @@ class DataIterator:
     def __init__(self, refs: List[Any]):
         self._refs = list(refs)
 
+    def _block_iter(self, prefetch: int = 4) -> Iterator[Any]:
+        """Materialized blocks, in shard order, with bounded touch-ahead
+        (subclasses may source blocks elsewhere, e.g. a lease
+        coordinator)."""
+        return ingest.iter_blocks_from_refs(self._refs, prefetch)
+
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy", drop_last: bool = False,
                      prefetch_blocks: int = 4) -> Iterator[Any]:
-        return _batches_from_refs(self._refs, batch_size, batch_format,
-                                  drop_last, prefetch_blocks)
+        return ingest.batches_from_block_iter(
+            self._block_iter(prefetch_blocks), batch_size, batch_format,
+            drop_last)
+
+    def iter_device_batches(self, *, sharding=None, batch_size: int = 256,
+                            drop_last: bool = False,
+                            prefetch_blocks: Optional[int] = None,
+                            queue_depth: Optional[int] = None,
+                            device_buffers: Optional[int] = None
+                            ) -> "ingest.DeviceBatchIterator":
+        """Overlapped device feed: a background thread fetches blocks and
+        assembles numpy batches into a bounded queue, and the returned
+        iterator keeps `device_buffers` (default 2) batches in flight on
+        the accelerator — while the jitted step consumes batch k, batch
+        k+1's jax.device_put has already been dispatched, so the device
+        never waits on fetch+assemble+H2D.  Batches are numerically
+        identical to iter_batches(batch_format="numpy").
+
+        `sharding` may be None (default device), a jax.sharding.Sharding
+        (every column), a Mesh (per-column ("batch", "length") layout via
+        parallel.sharding.batch_shardings), or a dict column -> Sharding.
+        Defaults for the knobs come from the ingest_* config flags."""
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+        prefetch = (prefetch_blocks if prefetch_blocks is not None
+                    else cfg.ingest_prefetch_blocks)
+        producer = ingest.BatchProducer(
+            self._block_iter(prefetch), batch_size, "numpy", drop_last,
+            queue_depth)
+        return ingest.DeviceBatchIterator(producer, sharding, device_buffers)
 
     def iter_rows(self) -> Iterator[Any]:
-        for r in self._refs:
-            yield from blk.block_rows(ray_tpu.get(r))
+        for b in self._block_iter():
+            yield from blk.block_rows(b)
 
     def count(self) -> int:
         return sum(c for c, _ in ray_tpu.get(
@@ -691,6 +716,34 @@ class DataIterator:
 
     def __reduce__(self):
         return (DataIterator, (self._refs,))
+
+
+class CoordinatedDataIterator(DataIterator):
+    """A work-stealing shard: holds the WHOLE split's ref pool but pulls
+    block indexes from a SplitCoordinator lease loop, so which blocks
+    this worker consumes is decided at iteration time (own shard first —
+    local-store blocks before remote ones — then stolen stragglers).
+    count()/materialize() describe the full pool, not one worker's share.
+    Picklable; the coordinator handle travels with it."""
+
+    def __init__(self, refs: List[Any], coordinator, worker: int):
+        super().__init__(refs)
+        self._coordinator = coordinator
+        self._worker = worker
+
+    def _block_iter(self, prefetch: int = 4) -> Iterator[Any]:
+        local = [i for i, r in enumerate(self._refs)
+                 if ingest.block_is_local(r)]
+        for idx in ingest.coordinated_block_indexes(
+                self._coordinator, self._worker, local):
+            yield ray_tpu.get(self._refs[idx])
+
+    def coordinator(self):
+        return self._coordinator
+
+    def __reduce__(self):
+        return (CoordinatedDataIterator,
+                (self._refs, self._coordinator, self._worker))
 
 
 class DatasetPipeline:

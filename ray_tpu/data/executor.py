@@ -156,8 +156,10 @@ def execute(plan: ExecPlan, window: int = 16) -> List[Any]:
             pending = {}
             for r in refs:
                 while len(pending) >= window:
+                    # These refs only ever pass BY REFERENCE to the next
+                    # stage's tasks — never pull their payloads here.
                     done, _ = ray_tpu.wait(list(pending), num_returns=1,
-                                           timeout=None)
+                                           timeout=None, fetch_local=False)
                     for d in done:
                         pending.pop(d, None)
                 task = _run_block.remote(r, seg)
@@ -188,21 +190,62 @@ def _probe_nbytes(block) -> int:
     return _sizeof_block(block)
 
 
+def _local_nbytes(ref) -> Optional[int]:
+    """Serialized size of a SEALED object resident on this node, read
+    straight from the store / owner state — no task, no deserialization.
+    None when the object is inline-less and not in the local store."""
+    from ray_tpu import api
+    w = api._worker
+    if w is None:
+        return None
+    try:
+        st = w.objects.get(ref.id)
+        if st is not None and st.inline is not None:
+            return len(st.inline[0])
+        store = getattr(w, "store", None)
+        if store is None or not store.contains(ref.id):
+            return None
+        buf = store.get(ref.id, timeout_ms=0)
+        if buf is None:
+            return None
+        try:
+            return len(buf.data)
+        finally:
+            buf.release()
+    except Exception:
+        return None
+
+
 class _ByteWindow:
     """Adaptive in-flight bound: counts until the segment's first output
-    block has been size-probed, then bytes/size blocks — resource-aware
+    block has been sized, then bytes/size blocks — resource-aware
     backpressure without a separate control plane (reference:
     StreamingExecutor's per-operator resource budgets,
-    streaming_executor.py:41)."""
+    streaming_executor.py:41).  Sizing is free when the sealed block is
+    local (store metadata via _local_nbytes); the remote _probe_nbytes
+    task is a fallback for blocks sealed on another node only."""
 
     def __init__(self, window: int, window_bytes: int):
         self.window = max(1, window)
         self.window_bytes = window_bytes
+        self._first = None
         self._probe = None
         self._est: Optional[int] = None
 
-    def limit(self) -> int:
-        if self._est is None and self._probe is not None:
+    def _resolve(self) -> None:
+        if self._first is not None:
+            ready, _ = ray_tpu.wait([self._first], num_returns=1, timeout=0,
+                                    fetch_local=False)
+            if not ready:
+                return
+            n = _local_nbytes(self._first)
+            if n is not None:
+                self._est = max(1, n)
+                self._first = None
+                return
+            self._probe = _probe_nbytes.remote(self._first)
+            self._first = None
+        if self._probe is not None:
             done, _ = ray_tpu.wait([self._probe], num_returns=1, timeout=0)
             if done:
                 try:
@@ -210,13 +253,17 @@ class _ByteWindow:
                 except Exception:
                     self._est = None
                 self._probe = None
+
+    def limit(self) -> int:
+        if self._est is None:
+            self._resolve()
         if self._est is None:
             return self.window
         return max(1, min(self.window, self.window_bytes // self._est))
 
     def observe(self, out_ref) -> None:
-        if self._est is None and self._probe is None:
-            self._probe = _probe_nbytes.remote(out_ref)
+        if self._est is None and self._first is None and self._probe is None:
+            self._first = out_ref
 
 
 def _stream_fused(src: Iterator[Any], fused_fn: Callable, window: int,

@@ -142,6 +142,18 @@ def _batch_logical(x) -> LogicalSpec:
     return ("batch",) + (None,) * (x.ndim - 1)
 
 
+def batch_shardings(mesh: Mesh, batch: Any,
+                    rules: Optional[Mapping] = None) -> Any:
+    """Per-leaf NamedShardings for a host batch pytree with the
+    ("batch", "length") layout — the placement half of `shard_batch`,
+    without the device_put.  The device-feed ingest path
+    (data.ingest.DeviceBatchIterator) resolves a bare Mesh argument
+    through this, so `iter_device_batches(sharding=mesh)` lands every
+    column split over the data axes."""
+    return jax.tree.map(
+        lambda x: named_sharding(mesh, _batch_logical(x), rules), batch)
+
+
 def shard_batch(mesh: Mesh, batch: Any,
                 rules: Optional[Mapping] = None) -> Any:
     """Device-put a host batch pytree with ("batch", "length") layout onto

@@ -160,8 +160,14 @@ class BackendExecutor:
         instead of leaving shards orphaned on dead ranks."""
         wg = self.worker_group
         self._backend.on_training_start(wg, self._backend_config)
+        # ingest_work_stealing=True swaps the static per-worker lists for
+        # SplitCoordinator leases (straggler-proof; re-split per (re)start
+        # so gang resizes recreate the coordinator).  The static split
+        # stays the default: it is deterministic, which token-exact
+        # elastic restores rely on.
+        steal = _cfg().ingest_work_stealing
         dataset_shards = {
-            name: ds.streaming_split(len(wg), equal=True)
+            name: ds.streaming_split(len(wg), equal=True, steal=steal)
             for name, ds in (datasets or {}).items()}
         local = wg.local_ranks()
         node_ranks = wg.node_ranks()

@@ -231,6 +231,17 @@ def get_dataset_shard(name: str = "train"):
     return shard
 
 
+def iter_device_batches(name: str = "train", *, sharding=None, **kwargs):
+    """Overlapped device feed over this worker's dataset shard —
+    shorthand for ``get_dataset_shard(name).iter_device_batches(...)``.
+    Yields batches already on the accelerator (double-buffered H2D: batch
+    k+1 transfers while the step consumes batch k); pass ``sharding=``
+    a NamedSharding, a Mesh, or a dict column -> Sharding to land each
+    batch pre-sharded for the jitted step."""
+    return get_dataset_shard(name).iter_device_batches(
+        sharding=sharding, **kwargs)
+
+
 def set_preemption_hook(fn: Callable[[float], Any]) -> None:
     """Register the grace-window rescue: on a preemption notice, `fn`
     runs at the next step boundary with the REMAINING grace seconds and

@@ -91,6 +91,33 @@ class Histogram(Metric):
             cur["max"] = max(cur["max"], value)
 
 
+class timer:
+    """Context manager that adds the elapsed wall seconds to a Counter
+    (e.g. the ingest producer/consumer wait accumulators) — the cheap
+    idiom for 'how long was this side blocked':
+
+        with metrics.timer(wait_counter):
+            item = q.get()
+    """
+
+    __slots__ = ("_counter", "_tags", "_t0", "elapsed")
+
+    def __init__(self, counter: Counter, tags: Optional[Dict[str, str]] = None):
+        self._counter = counter
+        self._tags = tags
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "timer":
+        import time
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import time
+        self.elapsed = time.perf_counter() - self._t0
+        self._counter.inc(self.elapsed, self._tags)
+
+
 def collect() -> Dict[str, dict]:
     """Snapshot of every metric in this process."""
     with _registry_lock:

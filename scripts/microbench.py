@@ -162,6 +162,67 @@ def bench_serve(results: dict):
         serve.shutdown()
 
 
+def bench_ingest(results: dict):
+    """Input-pipeline microbenches: incremental batch assembly over
+    misaligned Arrow blocks (the row-cursor path — batches/s), the
+    overlapped device feed end to end (producer thread + double-buffered
+    H2D — device batches/s), and the work-stealing coordinator's lease
+    round-trip (leases/s: the per-block scheduling overhead a stealing
+    split adds over a static split)."""
+    import numpy as np
+
+    from ray_tpu import data as rd
+    from ray_tpu.data import block as blk
+    from ray_tpu.data import ingest
+
+    # Assembly: 64 blocks x 100 rows of a 256-wide float column, batch
+    # size 96 deliberately misaligned so every batch crosses a boundary.
+    blocks = [blk.batch_to_block(
+        {"id": np.arange(i * 100, (i + 1) * 100),
+         "x": np.ones((100, 256), np.float32)})
+        for i in range(64)]
+
+    def assemble(n):
+        done = 0
+        while done < n:
+            for b in ingest.batches_from_block_iter(iter(blocks), 96):
+                done += 1
+                if done >= n:
+                    break
+
+    timeit("ingest_assemble", assemble, 400, results)
+
+    # Device feed: partial drain (break at n) of the overlapped iterator
+    # over a materialized dataset — covers block fetch, producer-thread
+    # assembly, handoff queue, and the double-buffered device_put.
+    ds = rd.range(4096, parallelism=8).materialize()
+    it = ds.streaming_split(1)[0]
+
+    def device_feed(n):
+        done = 0
+        while done < n:
+            feed = it.iter_device_batches(batch_size=64)
+            for _ in feed:
+                done += 1
+                if done >= n:
+                    feed.close()
+                    break
+
+    timeit("ingest_device_feed", device_feed, 128, results)
+
+    # Lease round-trip: one op = next() ack'ing the previous lease —
+    # the steady-state coordinator hop per block.
+    coord = ingest.SplitCoordinator.remote([list(range(100_000))])
+    ray_tpu.get(coord.register.remote(0, []))
+
+    def steal_lease(n):
+        lease = None
+        for _ in range(n):
+            lease, _ = ray_tpu.get(coord.next.remote(0, lease))
+
+    timeit("split_steal", steal_lease, 500, results)
+
+
 def bench_train_ft(results: dict):
     """Train fault-tolerance microbenches: the preemption-notice step
     boundary (rescue save + commit + abort — the latency that must fit
@@ -419,6 +480,9 @@ def main():
 
     timeit("prefill_miss", prefill_miss, 32, results)
     eng.shutdown()
+
+    # --- data: ingest assembly / device feed / steal leases ----------------
+    bench_ingest(results)
 
     # --- checkpoint: sharded save / stage / restore ------------------------
     bench_checkpoint(results)

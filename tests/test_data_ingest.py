@@ -1,0 +1,317 @@
+"""Device-feed input pipeline tests: incremental batch assembly, the
+overlapped producer/device iterator (exactness + buffer bounds), and
+work-stealing dataset splits (exactly-once coverage under stragglers and
+worker death, deterministic mode byte-identity)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data import block as blk
+from ray_tpu.data import ingest
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4, object_store_memory=128 << 20)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _ids(batches):
+    out = []
+    for b in batches:
+        out.extend(int(x) for x in np.asarray(b["id"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Incremental assembly (the O(n^2) satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_batch_assembler_row_cursor_exact(cluster):
+    # Blocks deliberately misaligned with the batch size: every batch
+    # spans a block boundary somewhere.
+    blocks = [blk.rows_to_block([{"id": i} for i in range(lo, lo + n)])
+              for lo, n in [(0, 7), (7, 13), (20, 1), (21, 29), (50, 50)]]
+    batches = list(ingest.batches_from_block_iter(iter(blocks), 16))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [16] * 6 + [4]  # 100 rows -> 6 full + tail
+    assert _ids(batches) == list(range(100))
+    # drop_last drops exactly the partial tail.
+    dropped = list(ingest.batches_from_block_iter(iter(blocks), 16,
+                                                  drop_last=True))
+    assert _ids(dropped) == list(range(96))
+
+
+def test_assembler_buffers_only_the_tail(cluster):
+    # The row cursor must RELEASE consumed blocks: after draining full
+    # batches, at most one partial block's rows stay buffered.
+    asm = ingest.BatchAssembler(10)
+    for lo in range(0, 90, 30):
+        asm.add_block(blk.rows_to_block([{"id": i}
+                                         for i in range(lo, lo + 30)]))
+        while asm.next_batch() is not None:
+            pass
+        assert asm.buffered_rows < 10
+        assert len(asm._blocks) <= 1
+
+
+def test_iter_batches_matches_take_all(cluster):
+    ds = rd.range(500, parallelism=7).map(
+        lambda r: {"id": r["id"], "x": float(r["id"]) * 0.5})
+    got = _ids(ds.iter_batches(batch_size=64))
+    assert got == list(range(500))
+
+
+# ---------------------------------------------------------------------------
+# Overlapped producer + device feed
+# ---------------------------------------------------------------------------
+
+
+def test_device_iter_exactness_gate(cluster):
+    """The overlapped device feed must be numerically identical to the
+    sync path, batch for batch."""
+    ds = rd.range(600, parallelism=8).map(
+        lambda r: {"id": r["id"], "x": float(r["id"]) ** 2})
+    it = ds.streaming_split(1)[0]
+    sync = [{k: v.copy() for k, v in b.items()}
+            for b in it.iter_batches(batch_size=96)]
+    dev = list(it.iter_device_batches(batch_size=96))
+    assert len(sync) == len(dev)
+    for s, d in zip(sync, dev):
+        assert set(s) == set(d)
+        for k in s:
+            np.testing.assert_array_equal(s[k], np.asarray(d[k]))
+
+
+def test_device_iter_respects_buffer_bounds(cluster):
+    """Neither the handoff queue nor the device stage may buffer more
+    than its configured bound, even under a slow consumer."""
+    ds = rd.range(800, parallelism=8)
+    it = ds.streaming_split(1)[0]
+    dev = it.iter_device_batches(batch_size=50, queue_depth=3,
+                                 device_buffers=2)
+    for _ in dev:
+        time.sleep(0.01)  # consumer is the bottleneck: queues fill
+    stats = dev.stats()
+    assert stats["batches"] == 16
+    assert stats["max_queue_depth"] <= 3
+    assert stats["max_device_inflight"] <= 2
+    # Slow consumer => the producer spent time blocked on a full queue.
+    assert stats["producer_wait_s"] > 0
+
+
+def test_producer_error_propagates(cluster):
+    def boom(_):
+        raise RuntimeError("ingest boom")
+
+    producer = ingest.BatchProducer(map(boom, range(3)), 10)
+    with pytest.raises(RuntimeError, match="ingest boom"):
+        list(producer)
+
+
+def test_session_iter_device_batches_convenience(cluster):
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+    def loop():
+        from ray_tpu.train import session
+        total = 0
+        for b in session.iter_device_batches("train", batch_size=40):
+            total += int(np.asarray(b["id"]).shape[0])
+        session.report({"rows": total})
+
+    ds = rd.range(400, parallelism=8)
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": ds})
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rows"] == 200  # equal split of 400 over 2
+
+
+# ---------------------------------------------------------------------------
+# Work-stealing splits
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class _Consumer:
+    """Drains a shard iterator, optionally sleeping per batch (straggler
+    injection); batch_size aligns to the block size so one batch == one
+    block lease."""
+
+    def __init__(self, it, delay: float = 0.0):
+        self._it = it
+        self._delay = delay
+
+    def run(self, batch_size: int):
+        ids = []
+        for b in self._it.iter_batches(batch_size=batch_size):
+            ids.extend(int(x) for x in b["id"])
+            if self._delay:
+                time.sleep(self._delay)
+        return ids
+
+
+@ray_tpu.remote
+class _Sink:
+    """Cross-rank row collector for the trainer wiring test."""
+
+    def __init__(self):
+        self._ids = []
+
+    def add(self, ids):
+        self._ids.extend(ids)
+
+    def all(self):
+        return self._ids
+
+
+@ray_tpu.remote
+class _Leaser:
+    """Takes exactly one lease and never completes it (death injection)."""
+
+    def __init__(self, coord, worker: int):
+        self._coord = coord
+        self._worker = worker
+
+    def lease_one(self):
+        ray_tpu.get(self._coord.register.remote(self._worker, []))
+        return ray_tpu.get(self._coord.next.remote(self._worker, None))
+
+
+def test_stealing_covers_every_block_once_with_slow_worker(cluster):
+    ds = rd.range(1000, parallelism=8)  # 8 blocks x 125 rows
+    its = ds.streaming_split(2, equal=True, steal=True)
+    slow = _Consumer.remote(its[0], 0.4)
+    fast = _Consumer.remote(its[1], 0.0)
+    a, b = ray_tpu.get([slow.run.remote(125), fast.run.remote(125)],
+                       timeout=120)
+    combined = sorted(a + b)
+    assert combined == list(range(1000))  # exactly once, no loss, no dup
+    # The fast worker must have taken over straggler blocks.
+    stats = ray_tpu.get(its[0].coordinator().stats.remote())
+    assert stats["stolen"] >= 1
+    assert len(b) > len(a)
+
+
+@pytest.mark.chaos
+def test_lease_requeue_on_worker_death(cluster):
+    ds = rd.range(1000, parallelism=8)
+    its = ds.streaming_split(2, equal=True, steal=True)
+    coord = its[0].coordinator()
+    # Worker 0 leases one block and dies without completing it.
+    victim = _Leaser.remote(coord, 0)
+    lease = ray_tpu.get(victim.lease_one.remote())
+    assert lease is not None
+    ray_tpu.kill(victim)
+    assert ray_tpu.get(coord.mark_dead.remote(0)) == 1
+    # The survivor covers the ENTIRE pool, including the re-queued lease.
+    survivor = _Consumer.remote(its[1], 0.0)
+    ids = ray_tpu.get(survivor.run.remote(125), timeout=120)
+    assert sorted(ids) == list(range(1000))
+    stats = ray_tpu.get(coord.stats.remote())
+    assert stats["requeued"] == 1
+    assert stats["remaining"] == 0
+
+
+@pytest.mark.chaos
+def test_lease_timeout_reaps_silent_worker(cluster):
+    """Without an explicit mark_dead, a crashed worker's lease re-queues
+    once it has been silent past lease_timeout_s and the pool is dry."""
+    ds = rd.range(400, parallelism=4)
+    its = ds.streaming_split(2, equal=True, steal=True,
+                             lease_timeout_s=0.5)
+    coord = its[0].coordinator()
+    victim = _Leaser.remote(coord, 0)
+    assert ray_tpu.get(victim.lease_one.remote()) is not None
+    ray_tpu.kill(victim)  # silent death: no mark_dead
+    survivor = _Consumer.remote(its[1], 0.0)
+    ids = ray_tpu.get(survivor.run.remote(100), timeout=120)
+    assert sorted(ids) == list(range(400))
+    assert ray_tpu.get(coord.stats.remote())["requeued"] == 1
+
+
+def test_deterministic_mode_byte_identical(cluster):
+    ds = rd.range(500, parallelism=8).map(
+        lambda r: {"id": r["id"], "x": float(r["id"]) * 3})
+    runs = []
+    for _ in range(2):
+        its = ds.streaming_split(2, equal=True, steal=True,
+                                 deterministic=True)
+        runs.append([[{k: v.copy() for k, v in b.items()}
+                      for b in it.iter_batches(batch_size=64)]
+                     for it in its])
+    static = [list(it.iter_batches(batch_size=64))
+              for it in ds.streaming_split(2, equal=True)]
+    for other in (runs[1], static):
+        for shard_a, shard_b in zip(runs[0], other):
+            assert len(shard_a) == len(shard_b)
+            for ba, bb in zip(shard_a, shard_b):
+                for k in ba:
+                    np.testing.assert_array_equal(ba[k], bb[k])
+
+
+def test_trainer_steal_flag_wires_coordinated_shards(cluster):
+    """ingest_work_stealing=True routes trainer shards through the
+    coordinator; every row is still consumed exactly once across the
+    gang."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+    def loop(cfg):
+        from ray_tpu.train import session
+        ids = []
+        for b in session.get_dataset_shard("train").iter_batches(
+                batch_size=50):
+            ids.extend(int(x) for x in b["id"])
+        ray_tpu.get(cfg["sink"].add.remote(ids))
+        session.report({"rows": len(ids)})
+
+    sink = _Sink.remote()
+    GLOBAL_CONFIG.apply_system_config({"ingest_work_stealing": True})
+    try:
+        ds = rd.range(400, parallelism=8)
+        trainer = DataParallelTrainer(
+            loop, train_loop_config={"sink": sink},
+            scaling_config=ScalingConfig(num_workers=2),
+            datasets={"train": ds})
+        result = trainer.fit()
+    finally:
+        GLOBAL_CONFIG.apply_system_config({"ingest_work_stealing": False})
+    assert result.error is None
+    assert sorted(ray_tpu.get(sink.all.remote())) == list(range(400))
+
+
+# ---------------------------------------------------------------------------
+# Executor satellites
+# ---------------------------------------------------------------------------
+
+
+def test_local_nbytes_reads_store_without_probe_task(cluster):
+    from ray_tpu.data.executor import _local_nbytes
+    table = blk.rows_to_block([{"id": i, "x": float(i)}
+                               for i in range(5000)])
+    ref = ray_tpu.put(table)
+    n = _local_nbytes(ref)
+    assert n is not None and n > 0
+
+
+def test_byte_window_sizes_from_local_store(cluster):
+    """_ByteWindow must reach a byte-derived limit from the local store
+    alone (no probe task needed for locally sealed blocks)."""
+    from ray_tpu.data.executor import _ByteWindow
+    table = blk.rows_to_block([{"id": i} for i in range(50000)])
+    ref = ray_tpu.put(table)
+    bw = _ByteWindow(window=64, window_bytes=1 << 20)
+    bw.observe(ref)
+    limit = bw.limit()
+    assert bw._est is not None and bw._probe is None
+    assert 1 <= limit <= 64
