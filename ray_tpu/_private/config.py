@@ -182,6 +182,19 @@ _D("ingest_lease_timeout_s", 30.0, float,
    "a work-stealing split re-queues a worker's outstanding block leases "
    "once the worker has been silent this long AND the fresh pool is "
    "exhausted (crash recovery; mark_dead re-queues immediately)")
+# -- observability / flight recorder ---------------------------------------
+_D("events", True, _bool,
+   "flight recorder master switch: every plane appends structured "
+   "decision events to a per-process ring buffer (util/events.py), "
+   "dumped on crash and scrapeable via CollectEvents.  RAY_TPU_EVENTS=0 "
+   "reduces record() to a single global read")
+_D("events_ring_size", 4096, int,
+   "flight-recorder ring capacity (events per process); overflow "
+   "overwrites oldest")
+_D("flightrec_dir", "", str,
+   "directory for crash dumps (flightrec-<pid>-<incarnation>.jsonl); "
+   "hostd points workers at <session>/logs via RAY_TPU_FLIGHTREC_DIR, "
+   "empty = /tmp/ray_tpu/flightrec")
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")

@@ -295,6 +295,7 @@ class CoreWorker:
         s.register("CoreWorker", "AddLocation", self._rpc_add_location)
         s.register("CoreWorker", "StackTrace", self._rpc_stack_trace)
         s.register("CoreWorker", "Metrics", self._rpc_metrics)
+        s.register("CoreWorker", "CollectEvents", self._rpc_collect_events)
         s.register("CoreWorker", "Ping", self._rpc_ping)
         s.register("CoreWorker", "NativePort", self._rpc_native_port)
         s.register("CoreWorker", "NodeDead", self._rpc_node_dead)
@@ -403,10 +404,10 @@ class CoreWorker:
         return {"ok": True}
 
     async def _rpc_stack_trace(self, req):
-        """Live per-thread Python stacks (reference: `ray stack`
-        scripts.py:1798)."""
-        from ray_tpu._private.stack_dump import dump_threads
-        return {"pid": os.getpid(), "threads": dump_threads()}
+        """Live per-thread Python stacks + the flight-recorder tail
+        (reference: `ray stack` scripts.py:1798)."""
+        from ray_tpu._private.stack_dump import dump_state
+        return {"pid": os.getpid(), **dump_state()}
 
     async def _rpc_metrics(self, req):
         """This worker's util.metrics registry, pulled by hostd into the
@@ -414,6 +415,14 @@ class CoreWorker:
         user Counters/Gauges) live here, not in the daemon."""
         from ray_tpu.util import metrics as mt
         return {"pid": os.getpid(), "metrics": mt.collect()}
+
+    async def _rpc_collect_events(self, req):
+        """This worker's flight-recorder ring (live scrape side of the
+        black box).  `now` rides along so the aggregator can normalize
+        clock skew across nodes."""
+        from ray_tpu.util import events
+        return {"pid": os.getpid(), "now": time.time(),
+                "events": events.snapshot(since=req.get("since", 0.0))}
 
     # ---- execution services ----
 
@@ -929,6 +938,7 @@ class CoreWorker:
         """Fetch (data, metadata) from one node.  Small objects (the
         common case) cost ONE RPC; past max_inline the daemon answers
         too_large and the payload streams as bounded-concurrency chunks."""
+        from ray_tpu.util import events
         client = self.pool.get(addr)
         reply = await client.call(
             "NodeManager", "PullObject",
@@ -936,6 +946,9 @@ class CoreWorker:
         if not reply.get("found"):
             return None
         if not reply.get("too_large"):
+            events.record("object", "transfer",
+                          oid=oid.binary().hex()[:16], src=addr,
+                          bytes=len(reply["data"]), mode="inline")
             return reply["data"], reply["metadata"]
         size = reply["data_size"]
         metadata = reply["metadata"]
@@ -966,6 +979,9 @@ class CoreWorker:
                 buf = self.store.get(oid)
                 if buf is not None:
                     try:
+                        events.record("object", "transfer",
+                                      oid=oid.binary().hex()[:16],
+                                      src=addr, bytes=size, mode="native")
                         return bytes(buf.data), buf.metadata
                     finally:
                         buf.release()
@@ -992,6 +1008,8 @@ class CoreWorker:
             return_exceptions=True)
         if failed or any(isinstance(r, BaseException) for r in results):
             return None
+        events.record("object", "transfer", oid=oid.binary().hex()[:16],
+                      src=addr, bytes=size, mode="chunked")
         return bytes(out), metadata
 
     _node_cache: tuple | None = None
@@ -2402,10 +2420,18 @@ class CoreWorker:
                     raise ActorDiedError(spec.actor_id, "no instance")
                 self.current_task_id = spec.task_id
                 self.current_task_spec = spec
-                method = getattr(self.actor_instance, spec.method_name)
-                result = method(*arg_vals, **kwargs)
-                if _inspect.iscoroutine(result):
-                    result = await result
+                # Install the carried trace context: this coroutine runs
+                # as its own asyncio task (own contextvar copy), so the
+                # set is isolated per concurrent method call.
+                span = tracing.enter_task(spec)
+                try:
+                    method = getattr(self.actor_instance, spec.method_name)
+                    result = method(*arg_vals, **kwargs)
+                    if _inspect.iscoroutine(result):
+                        result = await result
+                finally:
+                    if span is not None:
+                        tracing.exit_task()
                 reply = self._pack_reply(spec, result)
             except BaseException as e:  # noqa: BLE001
                 reply = self._error_reply(spec, e)
@@ -2423,6 +2449,10 @@ class CoreWorker:
             # like a SIGKILL'd/preempted worker — the owner sees the
             # connection drop and must retry/reconstruct.
             logger.warning("chaos: killing worker before task %s", spec.name)
+            from ray_tpu.util import events
+            events.record("proc", "chaos_kill", task=spec.name,
+                          trace=getattr(spec, "trace_ctx", None))
+            events.dump_crash("chaos_kill_worker")
             os._exit(1)
         _t0 = time.time()
         if spec.task_id in self._cancelled_exec:

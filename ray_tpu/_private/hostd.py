@@ -27,6 +27,7 @@ from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu._private.protocol import NodeInfo
 from ray_tpu._private.rpc import ClientPool, RpcClient, RpcServer
+from ray_tpu.util import events
 
 logger = logging.getLogger("ray_tpu.hostd")
 
@@ -251,6 +252,10 @@ class NodeDaemon:
         self.is_head = is_head
         self.session_dir = session_dir
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        # This daemon's own black box lands with the worker dumps so
+        # collect_events finds every dead process's ring in one place.
+        os.environ.setdefault("RAY_TPU_FLIGHTREC_DIR",
+                              os.path.join(session_dir, "logs"))
         self.store_path = os.path.join(
             "/dev/shm", f"ray_tpu_{self.node_id.hex()[:12]}")
         self.store = ObjectStore.create(self.store_path, store_capacity)
@@ -334,6 +339,11 @@ class NodeDaemon:
         # schedule so a killed worker's replacement doesn't replay the
         # draw that killed it (fault_injection.ChaosController).
         env["RAY_TPU_CHAOS_PROC_SALT"] = str(self._spawn_seq)
+        # Flight-recorder black box: crash dumps land next to the worker
+        # logs so CollectEvents / state.events() can stitch a dead
+        # worker's ring with live peers.
+        env["RAY_TPU_FLIGHTREC_DIR"] = os.path.join(
+            self.session_dir, "logs")
         if not tpu:
             # Leases without a TPU demand get a worker that skips runtime
             # TPU registration (the site hook imports jax + the PJRT plugin
@@ -648,6 +658,7 @@ class NodeDaemon:
         LocalTaskManager dispatch queue).  With req["bundle"]=(pg_hex, idx)
         the demand is charged against that placement-group bundle."""
         if self.preempting:
+            events.record("sched", "lease_reject", reason="preempting")
             return {"granted": False, "reason": "preempting"}
         demand = req.get("resources", {})
         bundle = tuple(req["bundle"]) if req.get("bundle") else None
@@ -669,11 +680,16 @@ class NodeDaemon:
                     self._unreserve(demand)
                 if not any(w.state == "idle" or w.proc.poll() is None
                            for w in self.workers.values()):
+                    events.record("sched", "lease_reject",
+                                  reason="no_worker")
                     return {"granted": False, "reason": "no_worker"}
             elif bundle and bundle not in self.bundles:
+                events.record("sched", "lease_reject", reason="no_bundle")
                 return {"granted": False, "reason": "no_bundle"}
             remaining = deadline - loop.time()
             if remaining <= 0:
+                events.record("sched", "lease_reject", reason="busy",
+                              demand=demand)
                 return {"granted": False, "reason": "busy"}
             await self._wait_worker_slot(remaining)
         # Chain wake: capacity may remain (fractional demand) — pass the
@@ -683,6 +699,8 @@ class NodeDaemon:
         _metrics()["leases_granted"].inc()
         lease_id = f"{self.node_id.hex()[:8]}-{self._lease_seq}"
         logger.info("lease %s -> worker pid=%d", lease_id, handle.proc.pid)
+        events.record("sched", "lease_grant", lease_id=lease_id,
+                      pid=handle.proc.pid)
         handle.leased_at = time.monotonic()
         handle.state = "leased"
         handle.lease_id = lease_id
@@ -716,6 +734,9 @@ class NodeDaemon:
         budget against (reference: leases wait in the raylet's dispatch
         queue until resources and a worker exist)."""
         if self.preempting:
+            aid = req.get("actor_id")
+            events.record("sched", "lease_reject", reason="preempting",
+                          actor=getattr(aid, "hex", lambda: aid)())
             return {"granted": False, "reason": "preempting"}
         demand = req.get("resources", {})
         bundle = tuple(req["bundle"]) if req.get("bundle") else None
@@ -744,6 +765,10 @@ class NodeDaemon:
                 return {"granted": False, "reason": "busy"}
             await self._wait_worker_slot(remaining)
         self._notify_capacity()   # chain wake: see lease_worker
+        actor_id = req["actor_id"]
+        events.record("sched", "lease_grant",
+                      actor=getattr(actor_id, "hex", lambda: actor_id)(),
+                      pid=handle.proc.pid)
         handle.state = "actor"
         handle.actor_id = req["actor_id"]
         handle.lease_resources = demand
@@ -1226,9 +1251,8 @@ class NodeDaemon:
         daemon itself (reference: `ray stack` scripts.py:1798).  Worker
         probes run CONCURRENTLY: a node full of wedged workers — the very
         thing this exists to debug — must dump in ~one timeout, not N."""
-        from ray_tpu._private.stack_dump import dump_threads
-        out = [{"pid": os.getpid(), "kind": "hostd",
-                "threads": dump_threads()}]
+        from ray_tpu._private.stack_dump import dump_state
+        out = [{"pid": os.getpid(), "kind": "hostd", **dump_state()}]
         handles = [h for h in self.workers.values() if h.address]
 
         async def probe(handle):
@@ -1236,7 +1260,8 @@ class NodeDaemon:
                 reply = await self.pool.get(handle.address).call(
                     "CoreWorker", "StackTrace", {}, timeout=5)
                 return {"pid": reply["pid"], "kind": "worker",
-                        "state": handle.state, "threads": reply["threads"]}
+                        "state": handle.state, "threads": reply["threads"],
+                        "recent_events": reply.get("recent_events") or []}
             except Exception as e:
                 return {"pid": handle.proc.pid, "kind": "worker",
                         "state": handle.state, "error": repr(e),
@@ -1260,7 +1285,8 @@ class NodeDaemon:
                 reply = await self.pool.get(handle.address).call(
                     "CoreWorker", "StackTrace", {}, timeout=5)
                 return {"pid": reply["pid"], "state": handle.state,
-                        "threads": reply["threads"]}
+                        "threads": reply["threads"],
+                        "recent_events": reply.get("recent_events") or []}
             except Exception as e:
                 return {"pid": handle.proc.pid, "state": handle.state,
                         "error": repr(e), "threads": []}
@@ -1268,6 +1294,35 @@ class NodeDaemon:
         return {"processes":
                 await asyncio.gather(*[probe(h) for h in handles]),
                 "node_id": self.node_id.hex()}
+
+    async def collect_events(self, req):
+        """Node-level flight-recorder scrape: the daemon's own ring, every
+        live worker's ring (concurrent CollectEvents probes), and any
+        crash dumps in the session log dir — the black boxes of processes
+        that already died.  Each event gains pid/source; `now` rides
+        along for cluster-wide clock-skew normalization."""
+        since = float(req.get("since", 0.0))
+        out = [dict(e, pid=os.getpid(), source="live")
+               for e in events.snapshot(since=since)]
+        handles = [h for h in self.workers.values() if h.address]
+
+        async def probe(handle):
+            try:
+                reply = await self.pool.get(handle.address).call(
+                    "CoreWorker", "CollectEvents", {"since": since},
+                    timeout=5)
+                return [dict(e, pid=reply["pid"], source="live")
+                        for e in reply.get("events") or []]
+            except Exception:
+                return []
+
+        for chunk in await asyncio.gather(*[probe(h) for h in handles]):
+            out.extend(chunk)
+        out.extend(e for e in
+                   events.read_dumps(os.path.join(self.session_dir, "logs"))
+                   if e["ts"] >= since)
+        return {"events": out, "node_id": self.node_id.hex(),
+                "now": time.time()}
 
     # ---------------- preemption (maintenance events) ----------------
 
@@ -1397,6 +1452,9 @@ class NodeDaemon:
                 # actors; peers learn through their node-watch loops.
                 logger.warning("chaos: killing hostd %s",
                                self.node_id.hex()[:8])
+                events.record("proc", "chaos_kill",
+                              node=self.node_id.hex()[:8])
+                events.dump_crash("chaos_kill_hostd")
                 os._exit(1)
             if (chaos is not None and not self.preempting
                     and chaos.preempt_hostd(self.is_head)):
@@ -1572,6 +1630,8 @@ class NodeDaemon:
         self.server.register("NodeManager", "WorkerExiting",
                              self.worker_exiting)
         self.server.register("NodeManager", "Metrics", self.get_metrics)
+        self.server.register("NodeManager", "CollectEvents",
+                             self.collect_events)
         self.server.register("NodeManager", "ShutdownNode", self.shutdown_node)
         port = await self.server.start(port)
         # Native bulk-data plane: serves this store's sealed objects over
@@ -1610,6 +1670,13 @@ class NodeDaemon:
 
     async def run_until_shutdown(self):
         await self._shutdown.wait()
+        # Black box + profile flush before the teardown starts killing
+        # things: this daemon's ring records the node's last decisions.
+        events.record("proc", "hostd_shutdown",
+                      node=self.node_id.hex()[:8])
+        events.dump_crash("hostd_shutdown")
+        from ray_tpu._private.profiling import stop_periodic_profiles
+        stop_periodic_profiles()
         for t in self._tasks:
             t.cancel()
         # Teardown escalation: SIGTERM everyone, give the pool one shared

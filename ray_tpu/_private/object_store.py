@@ -158,6 +158,8 @@ class ObjectStore:
     def seal(self, object_id: ObjectID):
         _check(_load().tpus_obj_seal(self._h, object_id.binary()),
                f"seal {object_id}")
+        from ray_tpu.util import events
+        events.record("object", "seal", oid=object_id.binary().hex()[:16])
 
     def abort(self, object_id: ObjectID):
         _load().tpus_obj_abort(self._h, object_id.binary())

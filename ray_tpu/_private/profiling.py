@@ -2,20 +2,56 @@
 
 One helper behind every RAY_TPU_PROFILE_* / RAY_TPU_BOOT_PROFILE knob:
 daemons exit via signals or os._exit, so profiles dump PERIODICALLY from
-a background thread rather than relying on atexit.
+a background daemon thread — and a final flush runs on clean interpreter
+exit (atexit) so the tail between the last periodic dump and shutdown is
+not lost.  `stop_periodic_profiles()` flushes + stops every dumper
+explicitly for teardown paths that bypass atexit.
 """
 
 from __future__ import annotations
 
+import atexit
 import os
 import threading
-import time
+from typing import List
+
+
+class _PeriodicProfile:
+    def __init__(self, profile, path: str, interval_s: float, tag: str):
+        self.profile = profile
+        self.path = path
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(interval_s,), daemon=True,
+            name=f"profile-{tag}")
+        self._thread.start()
+
+    def _run(self, interval_s: float):
+        while not self._stop.wait(interval_s):
+            self.flush()
+
+    def flush(self):
+        try:
+            self.profile.dump_stats(self.path)
+        except Exception:
+            pass
+
+    def stop(self):
+        """Final flush + end the dumper thread (idempotent)."""
+        if not self._stop.is_set():
+            self._stop.set()
+            self.flush()
+
+
+_active: List[_PeriodicProfile] = []
+_atexit_installed = False
 
 
 def start_periodic_profile(env_var: str, tag: str, interval_s: float = 5.0):
     """If `env_var` names a directory, enable cProfile on the CALLING
-    thread and dump `<dir>/<tag>-<pid>.prof` every `interval_s`.
-    Returns the Profile (or None when disabled)."""
+    thread and dump `<dir>/<tag>-<pid>.prof` every `interval_s` from a
+    daemon thread (plus a final flush at clean exit).  Returns the
+    Profile (or None when disabled)."""
     prof_dir = os.environ.get(env_var)
     if not prof_dir:
         return None
@@ -23,15 +59,16 @@ def start_periodic_profile(env_var: str, tag: str, interval_s: float = 5.0):
     pr = cProfile.Profile()
     pr.enable()
     path = os.path.join(prof_dir, f"{tag}-{os.getpid()}.prof")
-
-    def _dumper():
-        while True:
-            time.sleep(interval_s)
-            try:
-                pr.dump_stats(path)
-            except Exception:
-                pass
-
-    threading.Thread(target=_dumper, daemon=True,
-                     name=f"profile-{tag}").start()
+    _active.append(_PeriodicProfile(pr, path, interval_s, tag))
+    global _atexit_installed
+    if not _atexit_installed:
+        _atexit_installed = True
+        atexit.register(stop_periodic_profiles)
     return pr
+
+
+def stop_periodic_profiles() -> None:
+    """Flush and stop every periodic dumper (clean-exit hook; also safe
+    to call from daemon teardown paths that end in os._exit)."""
+    while _active:
+        _active.pop().stop()

@@ -17,3 +17,13 @@ def dump_threads() -> List[Dict]:
         "name": names.get(ident, "?"),
         "stack": "".join(traceback.format_stack(frame)),
     } for ident, frame in sys._current_frames().items()]
+
+
+def dump_state(events_tail: int = 50) -> Dict:
+    """Threads + the flight-recorder tail: a hang report (TrainHungError,
+    `cli stack`) carries the runtime's recent DECISIONS next to the
+    frames, so "stuck in queue.get" comes with the lease/steal/evict
+    events that led there."""
+    from ray_tpu.util import events
+    return {"threads": dump_threads(),
+            "recent_events": events.tail(events_tail)}

@@ -114,7 +114,14 @@ def main():
 
     from ray_tpu._private.config import GLOBAL_CONFIG
 
+    from ray_tpu.util import events
+
     def _graceful_exit(signum=None, frame=None):
+        # Black box first: the flight-recorder ring is the only record of
+        # this worker's decisions once the process is gone.
+        events.record("proc", "sigterm")
+        events.dump_crash("sigterm")
+
         def drain():
             try:
                 cw.io.run(hostd.call(
@@ -135,6 +142,17 @@ def main():
         signal.signal(signal.SIGTERM, _graceful_exit)
     except (ValueError, OSError):
         pass  # non-main-thread entry (tests importing main())
+
+    # Fatal-error black box: an uncaught exception on any thread dumps
+    # the ring before the default traceback handling runs.
+    _prev_hook = sys.excepthook
+
+    def _fatal_hook(tp, val, tb):
+        events.record("proc", "fatal_error", error=repr(val))
+        events.dump_crash("fatal_error")
+        _prev_hook(tp, val, tb)
+
+    sys.excepthook = _fatal_hook
 
     cw.run_task_loop()
     os._exit(0)

@@ -167,6 +167,9 @@ def stage(tree: Any, *, save_id: str = "0", step: Optional[int] = None,
         "tree": skeleton,
         "arrays": arrays,
     }
+    from ray_tpu.util import events
+    events.record("ckpt", "stage", save_id=str(save_id), step=step,
+                  chunks=len(local))
     return Staged(manifest=manifest, local_chunks=local,
                   process_index=pidx, process_count=pcount,
                   save_id=str(save_id))
@@ -243,12 +246,16 @@ def maybe_commit(path: str, save_id: str, process_count: int) -> bool:
     # data is fully written but before the commit rename, the worst
     # possible instant.  A restore must never see this directory.
     from ray_tpu._private.fault_injection import get_chaos
+    from ray_tpu.util import events
     chaos = get_chaos()
     if chaos is not None and chaos.kill_ckpt_commit():
+        events.record("ckpt", "chaos_kill", path=path, save_id=save_id)
+        events.dump_crash("chaos_kill_ckpt_commit")
         os._exit(1)
     write_bytes_atomic(os.path.join(path, COMMIT_FILE),
                        b'{"save_id": "%s"}\n' % save_id.encode())
     fsync_dir(path)
+    events.record("ckpt", "commit", path=path, save_id=save_id)
     return True
 
 

@@ -39,6 +39,7 @@ from typing import Any, Iterable, Iterator, List, Optional
 
 import ray_tpu
 from ray_tpu.data import block as blk
+from ray_tpu.util import events
 
 
 def _cfg():
@@ -71,6 +72,16 @@ def _metrics():
             "requeues": mt.Counter(
                 "ingest_lease_requeues",
                 "block leases re-queued after their worker died"),
+            "fetch_s": mt.Histogram(
+                "ingest_fetch_s",
+                "per-block fetch latency (ref resolution + transfer)",
+                buckets=(1e-5, 1e-4, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                         0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)),
+            "assemble_s": mt.Histogram(
+                "ingest_assemble_s",
+                "per-block batch-assembly latency (row copy + format)",
+                buckets=(1e-6, 1e-5, 1e-4, 0.001, 0.0025, 0.005, 0.01,
+                         0.025, 0.05, 0.1, 0.25, 0.5, 1.0)),
         }
     return _M
 
@@ -138,15 +149,30 @@ class BatchAssembler:
 def batches_from_block_iter(blocks: Iterable, batch_size: int,
                             batch_format: str = "numpy",
                             drop_last: bool = False) -> Iterator[Any]:
-    """Synchronous assembly over an (already materialized) block stream."""
+    """Synchronous assembly over an (already materialized) block stream.
+    Per-block fetch (pulling the next block out of the iterator, which
+    for ref streams includes the object-store get) and assemble (row
+    copies into batches) latencies feed the two ingest histograms."""
     asm = BatchAssembler(batch_size, batch_format)
-    for b in blocks:
+    met = _metrics()
+    it = iter(blocks)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            b = next(it)
+        except StopIteration:
+            break
+        met["fetch_s"].observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        ready = []
         asm.add_block(b)
         while True:
             batch = asm.next_batch()
             if batch is None:
                 break
-            yield batch
+            ready.append(batch)
+        met["assemble_s"].observe(time.perf_counter() - t1)
+        yield from ready
     if not drop_last:
         tail = asm.flush()
         if tail is not None:
@@ -255,6 +281,11 @@ class BatchProducer:
                 with timer(wait) as t:
                     item = self._q.get()
                 self._stats["consumer_wait_s"] += t.elapsed
+                if t.elapsed > 0.01:
+                    # The training thread sat idle on an empty handoff
+                    # queue: the producer (fetch/assemble) is starving it.
+                    events.record("ingest", "producer_starved",
+                                  wait_s=round(t.elapsed, 6))
                 if item is _DONE:
                     if self._error is not None:
                         raise self._error
@@ -426,10 +457,12 @@ class SplitCoordinator:
                    if w in self._dead
                    or now - self._last_seen.get(w, t) > self._timeout]
         for lid in expired:
-            _, idx, _ = self._leases.pop(lid)
+            w, idx, _ = self._leases.pop(lid)
             self._orphans.append(idx)
             self._stats["requeued"] += 1
             _metrics()["requeues"].inc()
+            events.record("ingest", "requeue", worker=w, block=idx,
+                          reason="lease_timeout")
 
     def _pick(self, worker: int) -> Optional[int]:
         own = self._queues[worker] if worker < len(self._queues) else deque()
@@ -453,6 +486,7 @@ class SplitCoordinator:
         if victim is not None:
             self._stats["stolen"] += 1
             _metrics()["steals"].inc()
+            events.record("ingest", "steal", worker=worker)
             return victim.pop()
         return None
 
@@ -494,6 +528,8 @@ class SplitCoordinator:
             self._orphans.append(idx)
             self._stats["requeued"] += 1
             _metrics()["requeues"].inc()
+            events.record("ingest", "requeue", worker=worker, block=idx,
+                          reason="worker_dead")
         return len(stale)
 
     def stats(self) -> dict:

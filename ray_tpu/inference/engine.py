@@ -45,11 +45,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.inference.kv_cache import PagedKVCache
-from ray_tpu.util.metrics import Counter, Gauge
+from ray_tpu.util import events
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 _DONE = object()
 
 _MET = None
+
+# SLO latency buckets: generation latencies live in the 1ms–60s range;
+# sub-ms resolution at the low end keeps TBT percentiles meaningful.
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
 def _metrics() -> dict:
@@ -74,6 +80,14 @@ def _metrics() -> dict:
             "queue_depth": Gauge(
                 "inference_waiting_requests",
                 "Requests queued behind lane admission"),
+            "ttft": Histogram(
+                "inference_ttft_s",
+                "Time to first token (submit -> first emit)",
+                buckets=_LATENCY_BUCKETS),
+            "tbt": Histogram(
+                "inference_tbt_s",
+                "Time between tokens (per-decode emit gap)",
+                buckets=_LATENCY_BUCKETS),
         }
     return _MET
 
@@ -93,6 +107,12 @@ class _Request:
     # unfaulted run.
     sample_offset: int = 0
     deadline: Optional[float] = None   # monotonic; lane evicted past it
+    # Flight-recorder / SLO bookkeeping: the trace context is captured at
+    # submit() time because every later hop (scheduler thread, _commit)
+    # runs outside the submitter's contextvars.
+    trace: Optional[tuple] = None
+    submitted: float = 0.0             # wall time of submit()
+    last_emit: float = 0.0             # wall time of the previous token
     fed: int = 0            # prompt tokens in the cache (prefilled OR reused)
     produced: int = 0
     last_token: int = 0
@@ -245,13 +265,18 @@ class InferenceEngine:
         if len(prompt) > self.cache.max_seq_len:
             raise ValueError("prompt longer than max_seq_len")
         rid = next(self._rid)
+        from ray_tpu.util import tracing
         req = _Request(rid=rid, prompt=prompt,
                        max_new_tokens=max_new_tokens,
                        temperature=temperature, eos_id=eos_id,
                        seed=seed if seed is not None else self.seed + rid,
                        sample_offset=int(sample_offset),
                        deadline=(None if deadline_s is None
-                                 else time.monotonic() + deadline_s))
+                                 else time.monotonic() + deadline_s),
+                       trace=tracing.current_context(),
+                       submitted=time.time())
+        events.record("engine", "submit", trace=req.trace, rid=rid,
+                      prompt_len=len(prompt), max_new=max_new_tokens)
         with self._work:
             if self._stopped:
                 raise RuntimeError("engine is shut down")
@@ -292,6 +317,9 @@ class InferenceEngine:
                     req.out.put(_DONE)
                     self.cache.free_lane(lane)
                     self._lanes[lane] = None
+                    events.record("engine", "lane_evict", trace=req.trace,
+                                  rid=req.rid, lane=lane,
+                                  reason="cancelled")
                     return True
         return False
 
@@ -308,12 +336,17 @@ class InferenceEngine:
                 req.out.put(_DONE)
                 self.cache.free_lane(lane)
                 self._lanes[lane] = None
+                events.record("engine", "deadline_kill", trace=req.trace,
+                              rid=req.rid, lane=lane,
+                              produced=req.produced)
         expired = [r for r in self._waiting
                    if r.deadline is not None and now > r.deadline]
         for req in expired:
             self._waiting.remove(req)
             req.finish_reason = "deadline"
             req.out.put(_DONE)
+            events.record("engine", "deadline_kill", trace=req.trace,
+                          rid=req.rid, lane=None, produced=0)
 
     def shutdown(self) -> None:
         with self._work:
@@ -415,10 +448,17 @@ class InferenceEngine:
             met["hit_tokens"].inc(reused)
             met["miss_tokens"].inc(len(req.prompt) - reused)
             met["hits" if reused else "misses"].inc()
+            events.record("engine",
+                          "prefix_hit" if reused else "prefix_miss",
+                          trace=req.trace, rid=req.rid, lane=lane,
+                          reused_tokens=reused,
+                          prompt_len=len(req.prompt))
         met["queue_depth"].set(len(self._waiting))
         evictions = self.cache.allocator.evictions
         if evictions > self._evictions_reported:
             met["evicted"].inc(evictions - self._evictions_reported)
+            events.record("engine", "blocks_evicted",
+                          n=evictions - self._evictions_reported)
             self._evictions_reported = evictions
 
     def step(self) -> bool:
@@ -441,6 +481,9 @@ class InferenceEngine:
             if prefill:
                 plans.append((prefill,)
                              + self._build_batch(prefill, self.prefill_chunk))
+            events.record("engine", "step", decode=len(decode),
+                          prefill=len(prefill),
+                          waiting=len(self._waiting))
         done = []
         for lanes, batch, chunks in plans:
             next_tok = self._run_step(batch)
@@ -559,6 +602,16 @@ class InferenceEngine:
             req.last_token = tok
             req.emitted.append(tok)
             req.produced += 1
+            # SLO latency accounting: first emit is TTFT (queue wait +
+            # prefill included), every later emit is one TBT gap.
+            now = time.time()
+            met = _metrics()
+            if req.produced == 1:
+                if req.submitted:
+                    met["ttft"].observe(now - req.submitted)
+            elif req.last_emit:
+                met["tbt"].observe(now - req.last_emit)
+            req.last_emit = now
             req.out.put(tok)
             if req.eos_id is not None and tok == req.eos_id:
                 req.finish_reason = "eos"
@@ -570,3 +623,6 @@ class InferenceEngine:
                 req.out.put(_DONE)
                 self.cache.free_lane(lane)
                 self._lanes[lane] = None
+                events.record("engine", "finish", trace=req.trace,
+                              rid=req.rid, reason=req.finish_reason,
+                              produced=req.produced)

@@ -278,12 +278,105 @@ def cmd_metrics(args) -> int:
 
 def cmd_timeline(args) -> int:
     from ray_tpu import state
-    events = state.timeline(args.address)
+    evs = state.timeline(args.address,
+                         include_events=getattr(args, "events", False))
     out = getattr(args, "out", None) or "ray_tpu_timeline.json"
     with open(out, "w") as f:
-        json.dump(events, f)
-    print(f"wrote {len(events)} events to {out} "
+        # Event payloads are free-form; stringify anything exotic rather
+        # than losing the whole trace to one unserializable field.
+        json.dump(evs, f, default=str)
+    print(f"wrote {len(evs)} events to {out} "
           f"(open in chrome://tracing or perfetto)")
+    return 0
+
+
+def cmd_events(args) -> int:
+    """Cluster-wide flight-recorder stream: live rings + crash dumps,
+    skew-normalized and merged (reference: `ray list cluster-events` /
+    experimental/state — here backed by util/events.py)."""
+    from ray_tpu import state
+    evs = state.events(args.address, plane=args.plane, kind=args.kind,
+                       trace_id=args.trace, since=args.since)
+    if args.limit:
+        evs = evs[-args.limit:]
+    if args.json:
+        print(json.dumps(evs, indent=2, default=str))
+        return 0
+    for e in evs:
+        ts = e.get("ts_adj", e["ts"])
+        trace = e.get("trace_id") or ""
+        payload = e.get("payload") or {}
+        crash = (f" !{e.get('reason', 'crash')}"
+                 if e.get("source") == "crash" else "")
+        where = f"{str(e.get('node_id', ''))[:8]}:{e.get('pid', '?')}"
+        print(f"{ts:.6f} [{where}{crash}] "
+              f"{e.get('plane', ''):<6s} {e.get('kind', ''):<20s}"
+              + (f" trace={trace[:8]}" if trace else "")
+              + ("".join(f" {k}={v}" for k, v in payload.items())
+                 if isinstance(payload, dict) else f" {payload}"))
+    print(f"({len(evs)} events)")
+    return 0
+
+
+def cmd_top(args) -> int:
+    """Live view: per-plane flight-recorder event rates plus latency
+    percentiles from every histogram in the cluster scrape (reference:
+    `ray status -v` refresh loop; percentile math in util/metrics.py)."""
+    import time as _time
+
+    from ray_tpu import state
+    from ray_tpu.util import metrics as mt
+
+    def render() -> str:
+        now = _time.time()
+        evs = state.events(args.address, since=now - args.window)
+        rates = {}
+        for e in evs:
+            rates[e.get("plane", "?")] = rates.get(e.get("plane", "?"), 0) + 1
+        snap = state.cluster_metrics(args.address)
+        merged = {}
+        mt.merge_snapshot(merged, snap["gcs"])
+        for m in snap["nodes"].values():
+            mt.merge_snapshot(merged, m)
+        lines = [f"-- ray_tpu top (window {args.window:g}s, "
+                 f"{len(evs)} events) --",
+                 "events/s by plane:"]
+        for pl in sorted(rates):
+            lines.append(f"  {pl:<8s} {rates[pl] / args.window:10.1f}/s")
+        if not rates:
+            lines.append("  (none)")
+        lines.append("latency percentiles:")
+        shown = 0
+        for name, entry in sorted(merged.items()):
+            if entry.get("type") != "histogram":
+                continue
+            for series in entry.get("series", []):
+                q = mt.series_quantiles(entry, series)
+                if q is None:
+                    continue
+                tags = ",".join(f"{k}={v}" for k, v
+                                in sorted(series["tags"].items()))
+                label = name + ("{" + tags + "}" if tags else "")
+                n = series["value"].get("count", 0)
+                lines.append(f"  {label} n={n}"
+                             f" p50={q[0.5]:.4g} p95={q[0.95]:.4g}"
+                             f" p99={q[0.99]:.4g}")
+                shown += 1
+        if not shown:
+            lines.append("  (no histogram data yet)")
+        return "\n".join(lines)
+
+    i = 0
+    try:
+        while True:
+            if i:
+                _time.sleep(args.interval)
+            print(render(), flush=True)
+            i += 1
+            if args.count and i >= args.count:
+                break
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -622,7 +715,37 @@ def main(argv=None) -> int:
         q.add_argument("--json", action="store_true")
         if name == "timeline":
             q.add_argument("--out", default="ray_tpu_timeline.json")
+            q.add_argument("--events", action="store_true",
+                           help="merge flight-recorder events as "
+                                "instant events")
         q.set_defaults(fn=fn)
+
+    q = sub.add_parser("events",
+                       help="cluster-wide flight-recorder event stream")
+    q.add_argument("--address", required=True)
+    q.add_argument("--plane", default=None,
+                   help="filter: sched/object/engine/serve/ckpt/"
+                        "ingest/train/proc")
+    q.add_argument("--kind", default=None)
+    q.add_argument("--trace", default=None,
+                   help="join: only events carrying this trace id")
+    q.add_argument("--since", type=float, default=0.0,
+                   help="unix timestamp lower bound")
+    q.add_argument("--limit", type=int, default=0,
+                   help="keep only the newest N after filtering")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_events)
+
+    q = sub.add_parser("top", help="live per-plane event rates and "
+                                   "latency percentiles")
+    q.add_argument("--address", required=True)
+    q.add_argument("--window", type=float, default=10.0,
+                   help="rate window in seconds")
+    q.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period")
+    q.add_argument("--count", type=int, default=0,
+                   help="stop after N refreshes (0 = until ctrl-c)")
+    q.set_defaults(fn=cmd_top)
 
     q = sub.add_parser("serve", help="serve control (deploy/status/shutdown)")
     q.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])

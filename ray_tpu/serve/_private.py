@@ -44,6 +44,7 @@ from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu.exceptions import (
     ActorDiedError, ActorUnavailableError, ReplicaStreamLostError,
     ServeOverloadedError, TaskError)
+from ray_tpu.util import events
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
@@ -57,10 +58,16 @@ REPLICA_DEAD = "DEAD"
 _SERVE_MET = None
 
 
+# SLO latency buckets for the serve plane (queue wait is often sub-ms;
+# end-to-end can run to minutes under backpressure).
+_SLO_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
 def _serve_metrics() -> dict:
     global _SERVE_MET
     if _SERVE_MET is None:
-        from ray_tpu.util.metrics import Counter, Gauge
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
         _SERVE_MET = {
             "drained": Counter(
                 "serve_replicas_drained",
@@ -81,6 +88,14 @@ def _serve_metrics() -> dict:
             "retries": Counter(
                 "serve_request_retries",
                 "Unary requests retried through a healed replica set"),
+            "queue_wait": Histogram(
+                "serve_queue_wait_s",
+                "Admission wait (request arrival -> replica acquired)",
+                buckets=_SLO_BUCKETS),
+            "e2e": Histogram(
+                "serve_e2e_s",
+                "Unary request end-to-end latency (call -> result)",
+                buckets=_SLO_BUCKETS),
         }
     return _SERVE_MET
 
@@ -109,6 +124,8 @@ def _chaos_kill_point() -> None:
         import os
         logging.getLogger("ray_tpu").warning(
             "chaos: killing serve replica process")
+        events.record("serve", "chaos_kill", pid=os.getpid())
+        events.dump_crash("chaos_kill_replica")
         os._exit(1)
 
 
@@ -249,8 +266,13 @@ class ReplicaActor:
                         getattr(target, "__call__", None))):
                 return await target(*args, **kwargs)
             loop = asyncio.get_running_loop()
+            # run_in_executor does not propagate contextvars: carry the
+            # request's trace context onto the pool thread so engine
+            # events recorded inside sync deployments join the trace.
+            import contextvars
+            ctx = contextvars.copy_context()
             result = await loop.run_in_executor(
-                self._pool, lambda: target(*args, **kwargs))
+                self._pool, lambda: ctx.run(target, *args, **kwargs))
             if inspect.iscoroutine(result):
                 # Sync wrapper handing back a coroutine: finish it here.
                 return await result
@@ -291,9 +313,11 @@ class ReplicaActor:
                         return True, gen.__next__()
                     except StopIteration:
                         return False, None
+                import contextvars
                 loop = asyncio.get_running_loop()
-                alive, chunk = await loop.run_in_executor(self._pool,
-                                                          _pull)
+                ctx = contextvars.copy_context()
+                alive, chunk = await loop.run_in_executor(
+                    self._pool, lambda: ctx.run(_pull))
                 if sid in self._cancelled:
                     # cancel_stream caught this generator mid-pull and
                     # could not close it; it is suspended now.
@@ -439,6 +463,7 @@ class ServeController:
             self._draining.append(rec)
             n = len(self._draining)
         _serve_metrics()["draining"].set(n)
+        events.record("serve", "drain_start", deployment=name)
 
     def _drain_pass(self, immediate: bool = False) -> int:
         """One sweep over DRAINING replicas: fan out ongoing_requests()
@@ -479,6 +504,8 @@ class ServeController:
                 kill = True
                 met["drain_deadline_kills"].inc()
                 self._drain_deadline_kills += 1
+                events.record("serve", "drain_deadline_kill",
+                              deployment=rec.get("name"))
             if not (kill or dead):
                 continue
             if kill:
@@ -491,6 +518,7 @@ class ServeController:
                     self._draining.remove(rec)
                     self._drained_total += 1
             met["drained"].inc()
+            events.record("serve", "drained", deployment=rec.get("name"))
         with self._lock:
             remaining = len(self._draining)
         met["draining"].set(remaining)
@@ -995,6 +1023,8 @@ class DeploymentHandle:
                 limit = GLOBAL_CONFIG.serve_queue_length
             if limit and st.pending >= limit:
                 _serve_metrics()["shed"].inc()
+                events.record("serve", "shed", deployment=self._name,
+                              pending=st.pending, limit=limit)
                 raise ServeOverloadedError(
                     self._name, GLOBAL_CONFIG.serve_retry_after_hint_s,
                     st.pending, limit)
@@ -1013,8 +1043,10 @@ class DeploymentHandle:
         """Admit one request: pick a replica under its cap, else wait in
         the bounded queue until one frees up, the backpressure window
         closes, or the request deadline passes."""
+        t0 = time.perf_counter()
         pick = self._pick_replica()
         if pick is not None:
+            self._observe_admit(t0)
             return pick
         self._admission_enter()
         try:
@@ -1022,6 +1054,7 @@ class DeploymentHandle:
             while True:
                 pick = self._pick_replica()
                 if pick is not None:
+                    self._observe_admit(t0)
                     return pick
                 if time.monotonic() > limit:
                     raise TimeoutError(
@@ -1031,10 +1064,18 @@ class DeploymentHandle:
         finally:
             self._admission_exit()
 
+    def _observe_admit(self, t0: float) -> None:
+        wait = time.perf_counter() - t0
+        _serve_metrics()["queue_wait"].observe(wait)
+        events.record("serve", "admit", deployment=self._name,
+                      wait_s=round(wait, 6))
+
     async def _acquire_replica_async(self, deadline: Optional[float]):
         import asyncio
+        t0 = time.perf_counter()
         pick = self._pick_replica()
         if pick is not None:
+            self._observe_admit(t0)
             return pick
         self._admission_enter()
         try:
@@ -1042,6 +1083,7 @@ class DeploymentHandle:
             while True:
                 pick = self._pick_replica()
                 if pick is not None:
+                    self._observe_admit(t0)
                     return pick
                 if time.monotonic() > limit:
                     raise TimeoutError(
@@ -1065,13 +1107,14 @@ class DeploymentHandle:
         return max(0.1, min(60.0, deadline - time.monotonic()))
 
     def _call(self, method, args, kwargs):
+        t0 = time.time()
         self._refresh()
         deadline = self._request_deadline()
         replica, key = self._acquire_replica(deadline)
         ref = replica.handle_request.remote(
             method, args, kwargs, False, self._remaining(deadline))
         return _TrackedRef(ref, self, key, method, args, kwargs,
-                           deadline=deadline)
+                           deadline=deadline, t0=t0)
 
     def stream(self, *args, **kwargs):
         """Synchronous streaming call: yields the chunks of a generator
@@ -1103,6 +1146,8 @@ class DeploymentHandle:
                 if deadline is not None and time.monotonic() > deadline:
                     raise
                 _serve_metrics()["failovers"].inc()
+                events.record("serve", "failover", deployment=self._name,
+                              attempt=attempts, received=len(received))
                 self._on_replica_error()
                 if callable(policy):
                     resumed = policy(args, dict(kwargs), list(received))
@@ -1189,6 +1234,8 @@ class DeploymentHandle:
                 if deadline is not None and time.monotonic() > deadline:
                     raise
                 _serve_metrics()["failovers"].inc()
+                events.record("serve", "failover", deployment=self._name,
+                              attempt=attempts, received=len(received))
                 self._on_replica_error()
                 if callable(policy):
                     resumed = policy(args, dict(kwargs or {}),
@@ -1342,13 +1389,15 @@ class _TrackedRef:
 
     def __init__(self, ref, handle: DeploymentHandle, key: bytes,
                  method: str, args, kwargs, retried: bool = False,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 t0: Optional[float] = None):
         self._ref = ref
         self._handle = handle
         self._idx = key
         self._request = (method, args, kwargs)
         self._retried = retried
         self._deadline = deadline
+        self._t0 = t0 if t0 is not None else time.time()
 
     def result(self, timeout: Optional[float] = None):
         from ray_tpu.exceptions import ActorDiedError, RayTpuTimeoutError
@@ -1360,10 +1409,14 @@ class _TrackedRef:
                                  and time.monotonic() > self._deadline):
                 raise
             _serve_metrics()["retries"].inc()
+            events.record("serve", "retry",
+                          deployment=self._handle._name,
+                          method=self._request[0])
             self._handle._on_replica_error()
             method, args, kwargs = self._request
             retry = self._handle._call(method, args, kwargs)
             retry._retried = True
+            retry._t0 = self._t0
             return retry.result(timeout)
         except RayTpuTimeoutError:
             # Still executing on the replica: keep the slot charged until
@@ -1376,6 +1429,7 @@ class _TrackedRef:
             self._handle._done(self._idx)
             raise
         self._handle._done(self._idx)
+        _serve_metrics()["e2e"].observe(time.time() - self._t0)
         return value
 
     @property

@@ -146,12 +146,82 @@ def list_tasks(address: Optional[str] = None,
     return list(reply.get("events", []))
 
 
-def timeline(address: Optional[str] = None) -> List[Dict[str, Any]]:
+def events(address: Optional[str] = None, *, plane: Optional[str] = None,
+           kind: Optional[str] = None, trace_id: Optional[str] = None,
+           since: float = 0.0) -> List[Dict[str, Any]]:
+    """Cluster-wide flight-recorder aggregation: every node's
+    CollectEvents scrape (the hostd ring + live worker rings + crash
+    dumps from dead processes) plus the connected driver's own ring,
+    time-skew normalized and merged into one ordered stream.
+
+    Skew normalization: each node reply carries its wall clock (`now`);
+    the RPC midpoint approximates the same instant locally, so
+    ``ts_adj = ts + (local_midpoint - remote_now)`` puts every node's
+    events on the caller's clock (NTP-grade, good enough to order
+    cross-node decision sequences).  Filters: plane / kind / trace_id /
+    since (raw remote ts)."""
+    import os
+    import time as _time
+
+    addr = _gcs_address(address)
+
+    async def _collect():
+        from ray_tpu._private.rpc import RpcClient
+        nodes = (await _gcs_call(addr, "get_nodes"))["nodes"]
+        out: List[Dict[str, Any]] = []
+        for n in nodes:
+            if not n.alive:
+                continue
+            client = RpcClient(n.address)
+            try:
+                t0 = _time.time()
+                reply = await client.call(
+                    "NodeManager", "CollectEvents", {"since": since},
+                    timeout=10)
+                t1 = _time.time()
+            except Exception:
+                continue
+            finally:
+                await client.close()
+            mid = (t0 + t1) / 2.0
+            offset = mid - reply.get("now", mid)
+            for e in reply.get("events", []):
+                e = dict(e)
+                e["node_id"] = n.node_id.hex()
+                e["ts_adj"] = e["ts"] + offset
+                out.append(e)
+        return out
+
+    evs = _run(_collect())
+    # The caller's own ring: serve routers and train drivers record from
+    # the driver process, which no hostd scrapes.
+    from ray_tpu import api
+    from ray_tpu.util import events as ev
+    if api._worker is not None and address is None:
+        driver_pid = os.getpid()
+        seen = {(e.get("pid"), e.get("seq")) for e in evs}
+        for e in ev.snapshot(since=since):
+            if (driver_pid, e.get("seq")) in seen:
+                continue
+            evs.append(dict(e, pid=driver_pid, source="live",
+                            node_id="driver", ts_adj=e["ts"]))
+    evs = [e for e in evs
+           if (plane is None or e.get("plane") == plane)
+           and (kind is None or e.get("kind") == kind)
+           and (trace_id is None or e.get("trace_id") == trace_id)]
+    evs.sort(key=lambda e: e.get("ts_adj", e["ts"]))
+    return evs
+
+
+def timeline(address: Optional[str] = None,
+             include_events: bool = False) -> List[Dict[str, Any]]:
     """Chrome trace events (chrome://tracing / perfetto 'X' phases) —
-    reference: `ray timeline` scripts.py:1840."""
-    events = list_tasks(address)
+    reference: `ray timeline` scripts.py:1840.  With `include_events`
+    the flight-recorder stream is merged in as instant events, so one
+    trace shows tasks AND the runtime decisions around them."""
+    task_events = list_tasks(address)
     out = []
-    for e in events:
+    for e in task_events:
         out.append({
             "name": e["name"],
             "cat": "actor_task" if e.get("actor_id") else "task",
@@ -163,6 +233,20 @@ def timeline(address: Optional[str] = None) -> List[Dict[str, Any]]:
             "args": {"task_id": e.get("task_id"),
                      "actor_id": e.get("actor_id")},
         })
+    if include_events:
+        for e in events(address):
+            out.append({
+                "name": f'{e["plane"]}:{e["kind"]}',
+                "cat": f'event:{e["plane"]}',
+                "ph": "i",
+                "s": "p",
+                "ts": e.get("ts_adj", e["ts"]) * 1e6,
+                "pid": f'{e.get("node_id", "")}:{e.get("pid", 0)}',
+                "tid": e.get("source", "live"),
+                "args": {"payload": e.get("payload"),
+                         "trace_id": e.get("trace_id"),
+                         "span_id": e.get("span_id")},
+            })
     return out
 
 

@@ -303,6 +303,9 @@ class BackendExecutor:
         ages = {r: beacons[r]["age_s"] for r in laggards if r in beacons}
         stacks = self._collect_stacks(laggards)
         _metrics()["train_hangs"].inc()
+        from ray_tpu.util import events
+        events.record("train", "hang", laggards=laggards,
+                      timeout_s=timeout_s)
         raise TrainHungError(timeout_s, laggards, ages, stacks)
 
     def _collect_stacks(self, ranks: List[int]) -> str:
@@ -398,6 +401,10 @@ class BackendExecutor:
         _metrics()["train_recoveries"].inc(tags={"reason": reason})
         _metrics()["train_recovery_seconds"].observe(
             dt, tags={"reason": reason})
+        from ray_tpu.util import events
+        events.record("train", "recovery", reason=reason,
+                      workers=len(self.worker_group),
+                      seconds=round(dt, 3))
         logger.warning("gang re-formed with %d worker(s) in %.2fs",
                        len(self.worker_group), dt)
 
@@ -460,6 +467,10 @@ class BackendExecutor:
         _metrics()["train_recoveries"].inc(tags={"reason": reason})
         _metrics()["train_recovery_seconds"].observe(
             dt, tags={"reason": reason})
+        from ray_tpu.util import events
+        events.record("train", "recovery", reason=reason,
+                      workers=len(self.worker_group),
+                      seconds=round(dt, 3))
         logger.warning("gang re-formed with %d worker(s) in %.2fs",
                        len(self.worker_group), dt)
 

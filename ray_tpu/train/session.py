@@ -22,6 +22,24 @@ from ray_tpu.exceptions import TrainPreemptedError
 
 _session: Optional["_TrainSession"] = None
 
+_STEP_MET = None
+
+
+def _step_metrics():
+    global _STEP_MET
+    if _STEP_MET is None:
+        from ray_tpu.util import metrics as mt
+        _STEP_MET = {
+            "step_time": mt.Histogram(
+                "train_step_time_s",
+                "wall seconds between report() step boundaries",
+                tag_keys=("rank",),
+                buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                         0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                         120.0, 300.0)),
+        }
+    return _STEP_MET
+
 
 @dataclass
 class TrainContext:
@@ -102,11 +120,25 @@ class _TrainSession:
         if chaos is not None:
             stall = chaos.stall_train_step()
             if stall:
+                from ray_tpu.util import events
+                events.record("train", "chaos_stall", stall_s=stall,
+                              rank=self.context.world_rank)
                 self._stall_abort.wait(stall)
                 if self._stop:
                     raise StopIteration
+        prev_t = self._beacon_t
         self._beacon_step += 1
         self._beacon_t = time.monotonic()
+        from ray_tpu.util import events
+        events.record("train", "beacon", step=self._beacon_step,
+                      rank=self.context.world_rank)
+        if self._beacon_step > 1:
+            # Wall time between step boundaries — the worker-side
+            # train_step_time_s SLO histogram (first report excluded: it
+            # measures setup, not a step).
+            _step_metrics()["step_time"].observe(
+                self._beacon_t - prev_t,
+                tags={"rank": str(self.context.world_rank)})
         if self._preempt_pending:
             # Step boundary after a preemption notice: run the proactive
             # save hook with whatever is left of the grace window, then
@@ -122,6 +154,10 @@ class _TrainSession:
                     self._preempt_hook(remaining)
                 except Exception:
                     pass  # a failed rescue save must not mask the abort
+            events.record("train", "preempt_abort",
+                          rank=self.context.world_rank,
+                          step=self._beacon_step,
+                          grace_remaining_s=round(remaining, 3))
             raise TrainPreemptedError(self._preempt_grace,
                                       self.context.world_rank)
         self.result_queue.put((metrics, checkpoint))  # blocks when full
@@ -133,6 +169,9 @@ class _TrainSession:
     def notify_preemption(self, grace_s: float) -> None:
         """Arm the step-boundary abort (called from the CoreWorker
         PreemptionNotice RPC thread)."""
+        from ray_tpu.util import events
+        events.record("train", "preempt_notice", grace_s=float(grace_s),
+                      rank=self.context.world_rank)
         self._preempt_grace = float(grace_s)
         self._preempt_deadline = time.monotonic() + float(grace_s)
         self._preempt_pending = True

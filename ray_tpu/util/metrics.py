@@ -11,8 +11,9 @@ exposition format.
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 _registry: Dict[str, "Metric"] = {}
 _registry_lock = threading.Lock()
@@ -49,7 +50,12 @@ class Metric:
 
     def _series(self) -> List[Tuple[Tuple[str, ...], float]]:
         with self._lock:
-            return list(self._values.items())
+            # Deep-copy mutable (histogram) values: snapshots outlive the
+            # lock and merge_snapshot folds into them in place.
+            return [(k, {**v, "buckets": list(v["buckets"])}
+                     if isinstance(v, dict) and "buckets" in v
+                     else dict(v) if isinstance(v, dict) else v)
+                    for k, v in self._values.items()]
 
 
 class Counter(Metric):
@@ -71,11 +77,28 @@ class Gauge(Metric):
             self._values[self._key(tags)] = float(value)
 
 
+# Default latency boundaries (seconds): 1 ms .. 5 min, roughly
+# exponential — the reference's metric_defs.h latency buckets.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
 class Histogram(Metric):
-    """Bucketless summary: tracks count/sum/min/max per series (the
-    reference exports full buckets; sum+count cover rate/mean queries)."""
+    """Bucketed histogram: each series tracks count/sum/min/max plus
+    per-bucket counts over fixed boundaries, so snapshots merge
+    bucket-exact across processes and quantiles (p50/p95/p99) export
+    without shipping raw samples (reference: stats/metric.h Histogram +
+    the Prometheus le= exposition)."""
 
     TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = (),
+                 buckets: Optional[Iterable[float]] = None):
+        self.buckets = tuple(sorted(float(b) for b in
+                                    (buckets or DEFAULT_BUCKETS)))
+        super().__init__(name, description, tag_keys)
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
@@ -83,12 +106,65 @@ class Histogram(Metric):
         with self._lock:
             cur = self._values.get(key)
             if cur is None:
-                cur = {"count": 0.0, "sum": 0.0, "min": value, "max": value}
+                cur = {"count": 0.0, "sum": 0.0, "min": value, "max": value,
+                       "buckets": [0] * (len(self.buckets) + 1)}
                 self._values[key] = cur
             cur["count"] += 1
             cur["sum"] += value
             cur["min"] = min(cur["min"], value)
             cur["max"] = max(cur["max"], value)
+            b = cur.get("buckets")
+            if b is not None and len(b) == len(self.buckets) + 1:
+                b[bisect.bisect_left(self.buckets, value)] += 1
+
+
+def quantiles_from_buckets(boundaries, counts, qs=(0.5, 0.95, 0.99),
+                           lo: Optional[float] = None,
+                           hi: Optional[float] = None) -> Dict[float, float]:
+    """Streaming quantile estimates from bucket counts: find the bucket
+    holding rank q*total, interpolate linearly inside it (Prometheus
+    histogram_quantile semantics).  `lo`/`hi` (observed min/max) clamp
+    the open-ended first/overflow buckets."""
+    total = sum(counts)
+    out: Dict[float, float] = {}
+    if total <= 0:
+        return {q: float("nan") for q in qs}
+    bounds = list(boundaries)
+    for q in qs:
+        rank = q * total
+        cum = 0.0
+        val = hi if hi is not None else (bounds[-1] if bounds else 0.0)
+        for i, c in enumerate(counts):
+            if c <= 0:
+                continue
+            if cum + c >= rank:
+                lower = bounds[i - 1] if i > 0 else (
+                    lo if lo is not None else 0.0)
+                upper = bounds[i] if i < len(bounds) else (
+                    hi if hi is not None else bounds[-1])
+                lower = min(lower, upper)
+                frac = (rank - cum) / c
+                val = lower + (upper - lower) * frac
+                break
+            cum += c
+        if lo is not None:
+            val = max(val, lo)
+        if hi is not None:
+            val = min(val, hi)
+        out[q] = val
+    return out
+
+
+def series_quantiles(metric_snapshot: dict, series: dict,
+                     qs=(0.5, 0.95, 0.99)) -> Optional[Dict[float, float]]:
+    """Quantiles for one histogram series out of a collect() snapshot
+    (or None when it carries no bucket counts)."""
+    v = series.get("value")
+    bounds = metric_snapshot.get("buckets")
+    if not isinstance(v, dict) or not bounds or not v.get("buckets"):
+        return None
+    return quantiles_from_buckets(bounds, v["buckets"], qs,
+                                  lo=v.get("min"), hi=v.get("max"))
 
 
 class timer:
@@ -124,7 +200,7 @@ def collect() -> Dict[str, dict]:
         metrics = list(_registry.values())
     out: Dict[str, dict] = {}
     for m in metrics:
-        out[m.name] = {
+        entry = {
             "type": m.TYPE,
             "description": m.description,
             "tag_keys": list(m.tag_keys),
@@ -132,6 +208,9 @@ def collect() -> Dict[str, dict]:
                 {"tags": dict(zip(m.tag_keys, key)), "value": value}
                 for key, value in m._series()],
         }
+        if m.TYPE == "histogram":
+            entry["buckets"] = list(getattr(m, "buckets", ()))
+        out[m.name] = entry
     return out
 
 
@@ -163,9 +242,13 @@ def merge_snapshot(into: Dict[str, dict], other: Dict[str, dict]) -> None:
                 "tag_keys": list(m["tag_keys"]),
                 "series": [dict(s) for s in m["series"]],
             }
+            if m.get("buckets"):
+                into[name]["buckets"] = list(m["buckets"])
             continue
         by_tags = {tuple(sorted(s["tags"].items())): s
                    for s in dst["series"]}
+        if m.get("buckets") and not dst.get("buckets"):
+            dst["buckets"] = list(m["buckets"])
         for s in m["series"]:
             key = tuple(sorted(s["tags"].items()))
             cur = by_tags.get(key)
@@ -178,6 +261,11 @@ def merge_snapshot(into: Dict[str, dict], other: Dict[str, dict]) -> None:
                 cv["sum"] += sv["sum"]
                 cv["min"] = min(cv["min"], sv["min"])
                 cv["max"] = max(cv["max"], sv["max"])
+                cb, sb = cv.get("buckets"), sv.get("buckets")
+                if cb is not None and sb is not None and len(cb) == len(sb):
+                    # Bucket-exact fold: same boundaries (both sides
+                    # declared the metric), counts sum element-wise.
+                    cv["buckets"] = [a + b for a, b in zip(cb, sb)]
             else:
                 cur["value"] += s["value"]
 
@@ -192,16 +280,32 @@ def prometheus_text(snapshot: Optional[Dict[str, dict]] = None,
         full = f"ray_tpu_{name}"
         if m.get("description"):
             lines.append(f"# HELP {full} {m['description']}")
-        ptype = m["type"] if m["type"] != "histogram" else "summary"
-        lines.append(f"# TYPE {full} {ptype}")
+        lines.append(f"# TYPE {full} {m['type']}")
+        bounds = m.get("buckets") or ()
         for series in m["series"]:
             tags = {**extra, **series["tags"]}
             label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
-            label = "{" + label + "}" if label else ""
+            braced = "{" + label + "}" if label else ""
             v = series["value"]
-            if isinstance(v, dict):  # histogram summary
+            if isinstance(v, dict):  # histogram
+                counts = v.get("buckets")
+                if bounds and counts and len(counts) == len(bounds) + 1:
+                    cum = 0
+                    for le, c in zip(bounds, counts):
+                        cum += c
+                        ltags = (label + "," if label else "") + f'le="{le}"'
+                        lines.append(
+                            f"{full}_bucket{{{ltags}}} {cum}")
+                    itags = (label + "," if label else "") + 'le="+Inf"'
+                    lines.append(
+                        f"{full}_bucket{{{itags}}} {cum + counts[-1]}")
                 for suffix in ("count", "sum", "min", "max"):
-                    lines.append(f"{full}_{suffix}{label} {v[suffix]}")
+                    lines.append(f"{full}_{suffix}{braced} {v[suffix]}")
+                qs = series_quantiles(m, series)
+                if qs:
+                    for q, qv in sorted(qs.items()):
+                        tag = f"p{int(round(q * 100))}"
+                        lines.append(f"{full}_{tag}{braced} {qv:.6g}")
             else:
-                lines.append(f"{full}{label} {v}")
+                lines.append(f"{full}{braced} {v}")
     return "\n".join(lines) + "\n"

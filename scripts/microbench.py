@@ -295,9 +295,40 @@ def bench_train_ft(results: dict):
     timeit("train_resize_down", resize_down, 2, results, settle=1.0)
 
 
+def bench_observability(results: dict):
+    """Observability hot-path costs: `events_append` is the per-record()
+    overhead every instrumented plane pays (budget: < 5 µs/event, i.e.
+    > 200k ops/s — the flight recorder must be cheap enough to leave on),
+    `metrics_observe` is one bucketed-histogram observation (the SLO
+    latency path: TTFT/TBT, queue wait, step time)."""
+    from ray_tpu.util import events
+    from ray_tpu.util import metrics as mt
+    events.reset()
+
+    def events_append(n):
+        record = events.record
+        for i in range(n):
+            record("engine", "bench", i=i)
+
+    timeit("events_append", events_append, 200_000, results)
+    events.reset()
+
+    h = mt.Histogram("microbench_observe_s", "observe() hot-path bench")
+
+    def metrics_observe(n):
+        obs = h.observe
+        for i in range(n):
+            obs(0.001 * (i & 1023))
+
+    timeit("metrics_observe", metrics_observe, 200_000, results)
+
+
 def main():
     ray_tpu.init(num_cpus=8, object_store_memory=256 << 20)
     results: dict = {}
+
+    # --- observability: flight recorder + histogram hot paths --------------
+    bench_observability(results)
 
     # --- object store ------------------------------------------------------
     payload = b"x" * 100

@@ -1,0 +1,377 @@
+"""Flight-recorder + SLO-metrics observability suite (PR 10).
+
+Covers the ISSUE checklist: ring overflow keeps the newest N, append is
+re-entrant from signal handlers, crash dumps survive a scripted chaos
+kill and `state.events()` stitches them with live peers by trace id,
+bucket-quantile math agrees with numpy, and the `cli events` / `cli top`
+commands render a live cluster.
+"""
+
+import bisect
+import io
+import json
+import os
+import signal
+import time
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.util import events
+from ray_tpu.util import metrics as mt
+from ray_tpu.util import tracing
+from ray_tpu.util.events import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Each test starts with an empty process ring and re-reads config."""
+    events.reset()
+    yield
+    events.reset()
+    GLOBAL_CONFIG.invalidate_cache()
+
+
+# ---------------------------------------------------------------------------
+# Ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_overflow_keeps_newest():
+    r = FlightRecorder(capacity=16)
+    for i in range(40):
+        r.append("engine", "step", {"i": i})
+    snap = r.snapshot()
+    assert len(snap) == 16
+    # Overflow overwrote the oldest: exactly seqs 24..39 survive, in order.
+    assert [e["seq"] for e in snap] == list(range(24, 40))
+    assert [e["payload"]["i"] for e in snap] == list(range(24, 40))
+
+
+def test_snapshot_filters_plane_kind_since():
+    r = FlightRecorder(capacity=64)
+    r.append("serve", "admit", {"a": 1})
+    t_mid = time.time()
+    time.sleep(0.01)
+    r.append("engine", "submit", {"b": 2})
+    r.append("engine", "finish", None)
+    assert [e["kind"] for e in r.snapshot(plane="engine")] == \
+        ["submit", "finish"]
+    assert [e["kind"] for e in r.snapshot(kind="admit")] == ["admit"]
+    assert all(e["ts"] >= t_mid for e in r.snapshot(since=t_mid))
+    assert [e["kind"] for e in r.snapshot(since=t_mid)] == \
+        ["submit", "finish"]
+
+
+def test_tail_returns_last_n():
+    r = FlightRecorder(capacity=128)
+    for i in range(80):
+        r.append("proc", "tick", {"i": i})
+    tail = r.tail(50)
+    assert len(tail) == 50
+    assert tail[-1]["payload"]["i"] == 79
+    assert tail[0]["payload"]["i"] == 30
+
+
+def test_record_carries_active_trace_context():
+    with tracing.trace("obs-test") as tid:
+        events.record("engine", "submit", rid=1)
+    events.record("engine", "submit", rid=2)
+    snap = events.snapshot(kind="submit")
+    assert snap[0]["trace_id"] == tid and snap[0]["span_id"]
+    assert snap[1]["trace_id"] is None
+
+
+def test_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_EVENTS", "0")
+    GLOBAL_CONFIG.invalidate_cache()
+    events.reset()
+    events.record("engine", "submit", rid=1)
+    assert not events.enabled()
+    assert events.snapshot() == []
+
+
+def test_append_reentrant_from_signal_handler():
+    """A SIGALRM handler that itself appends must not corrupt the ring:
+    the seq counter is a single C-level next() and the slot store is one
+    list assignment, so interleaved appends land in distinct slots."""
+    fired = [0]
+
+    def on_alarm(signum, frame):
+        fired[0] += 1
+        events.record("proc", "sig", n=fired[0])
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, 0.0005, 0.0005)
+    try:
+        for i in range(30000):
+            events.record("engine", "main", i=i)
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old)
+    assert fired[0] >= 1, "timer never fired; test environment broken"
+    snap = events.snapshot()
+    # Every surviving slot is a well-formed event and seqs are unique
+    # and strictly increasing after the snapshot sort.
+    seqs = [e["seq"] for e in snap]
+    assert len(seqs) == len(set(seqs))
+    assert seqs == sorted(seqs)
+    assert all(e["plane"] in ("engine", "proc") for e in snap)
+    sig_events = [e for e in snap if e["kind"] == "sig"]
+    assert len(sig_events) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Crash dumps (the black box)
+# ---------------------------------------------------------------------------
+
+
+def test_crash_dump_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHTREC_DIR", str(tmp_path))
+    with tracing.trace("blackbox") as tid:
+        events.record("serve", "admit", deployment="d")
+        events.record("engine", "submit", rid=7)
+    path = events.dump_crash("unit_test_kill")
+    assert path and os.path.exists(path)
+    assert os.path.basename(path) == \
+        f"flightrec-{os.getpid()}-{os.environ.get('RAY_TPU_CHAOS_PROC_SALT') or '0'}.jsonl"
+    # Header line + one line per event, all valid json.
+    lines = open(path).read().splitlines()
+    header = json.loads(lines[0])
+    assert header["_flightrec"] == 1 and header["pid"] == os.getpid()
+    assert header["reason"] == "unit_test_kill"
+    out = events.read_dumps(str(tmp_path))
+    assert out and all(e["source"] == "crash" for e in out)
+    assert all(e["reason"] == "unit_test_kill" for e in out)
+    assert all(e["pid"] == os.getpid() for e in out)
+    kinds = {e["kind"] for e in out}
+    # The dump itself is recorded, so forensics show the dump reason too.
+    assert {"admit", "submit", "crash_dump"} <= kinds
+    traced = [e for e in out if e["trace_id"] == tid]
+    assert {e["kind"] for e in traced} == {"admit", "submit"}
+
+
+def test_read_dumps_skips_corrupt_files(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHTREC_DIR", str(tmp_path))
+    events.record("proc", "ok")
+    assert events.dump_crash("good")
+    # Debris that must be ignored: truncated dump, non-dump jsonl, junk.
+    (tmp_path / "flightrec-999-0.jsonl").write_text("{not json")
+    (tmp_path / "flightrec-998-0.jsonl").write_text(
+        '{"other_format": true}\n{"ts": 1}\n')
+    (tmp_path / "notes.txt").write_text("unrelated")
+    out = events.read_dumps(str(tmp_path))
+    assert all(e["pid"] == os.getpid() for e in out)
+    assert any(e["kind"] == "ok" for e in out)
+
+
+def test_dump_is_atomic_no_tmp_left(tmp_path):
+    events.record("proc", "x")
+    target = str(tmp_path / "dump.jsonl")
+    assert events.dump(target, "t") == target
+    assert os.listdir(tmp_path) == ["dump.jsonl"]
+
+
+# ---------------------------------------------------------------------------
+# Percentile math vs numpy
+# ---------------------------------------------------------------------------
+
+
+def test_quantiles_from_buckets_vs_numpy():
+    """Bucket-interpolated quantiles agree with numpy within one bucket
+    width (the estimator's resolution bound)."""
+    rng = np.random.default_rng(7)
+    samples = np.concatenate([
+        rng.uniform(0.0, 2.0, 4000),       # body
+        rng.uniform(2.0, 9.5, 1000),       # tail
+    ])
+    width = 0.05
+    bounds = [round(width * i, 6) for i in range(1, 201)]  # 0.05 .. 10.0
+    counts = [0] * (len(bounds) + 1)
+    for s in samples:
+        counts[bisect.bisect_left(bounds, float(s))] += 1
+    q = mt.quantiles_from_buckets(bounds, counts, (0.5, 0.95, 0.99),
+                                  lo=float(samples.min()),
+                                  hi=float(samples.max()))
+    for p in (0.5, 0.95, 0.99):
+        expect = float(np.percentile(samples, p * 100))
+        assert abs(q[p] - expect) <= width + 1e-9, \
+            f"p{int(p * 100)}: got {q[p]}, numpy {expect}"
+
+
+def test_histogram_observe_to_series_quantiles():
+    """End to end through the Histogram type: observe() bins, collect()
+    snapshots, series_quantiles() interpolates."""
+    width = 0.01
+    bounds = tuple(round(width * i, 6) for i in range(1, 101))  # .01..1.0
+    h = mt.Histogram("obs_test_latency_s", "test", buckets=bounds)
+    rng = np.random.default_rng(3)
+    samples = rng.uniform(0.0, 1.0, 3000)
+    for s in samples:
+        h.observe(float(s))
+    entry = mt.collect()["obs_test_latency_s"]
+    assert entry["type"] == "histogram"
+    (series,) = entry["series"]
+    assert series["value"]["count"] == len(samples)
+    q = mt.series_quantiles(entry, series)
+    for p in (0.5, 0.95, 0.99):
+        expect = float(np.percentile(samples, p * 100))
+        assert abs(q[p] - expect) <= width + 1e-9
+
+
+def test_quantiles_empty_and_single_bucket():
+    nanq = mt.quantiles_from_buckets([1.0, 2.0], [0, 0, 0], (0.5,))
+    assert np.isnan(nanq[0.5])
+    # All mass in one bucket: clamp to observed min/max range.
+    q = mt.quantiles_from_buckets([1.0, 2.0], [0, 5, 0], (0.5, 0.99),
+                                  lo=1.2, hi=1.8)
+    for v in q.values():
+        assert 1.0 <= v <= 2.0
+
+
+def test_merged_snapshots_quantile_bucket_exact():
+    """Quantiles over a merge_snapshot() of two processes' histograms
+    equal quantiles over the union of their samples (bucket-exact
+    merging is the point of shipping buckets, not summaries)."""
+    bounds = tuple(round(0.02 * i, 6) for i in range(1, 51))
+    a = mt.Histogram("obs_merge_a_s", "a", buckets=bounds)
+    rng = np.random.default_rng(11)
+    s1 = rng.uniform(0.0, 0.5, 1000)
+    s2 = rng.uniform(0.3, 1.0, 1000)
+    for s in s1:
+        a.observe(float(s))
+    snap1 = {k: v for k, v in mt.collect().items() if k == "obs_merge_a_s"}
+    # Second "process": same metric name, different samples.
+    for s in s2:
+        a.observe(float(s))
+    snap_both = {k: v for k, v in mt.collect().items()
+                 if k == "obs_merge_a_s"}
+    merged = {}
+    mt.merge_snapshot(merged, snap1)
+    (series,) = merged["obs_merge_a_s"]["series"]
+    assert series["value"]["count"] == 1000
+    both = np.concatenate([s1, s2])
+    (series_b,) = snap_both["obs_merge_a_s"]["series"]
+    q = mt.series_quantiles(snap_both["obs_merge_a_s"], series_b)
+    assert abs(q[0.5] - float(np.percentile(both, 50))) <= 0.02 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Cluster: chaos kill -> crash dump stitched with live peers by trace id,
+# plus cli events / cli top smoke against the same live cluster.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serve_chaos_cluster(request):
+    from ray_tpu._private import fault_injection as fi
+    cfg = dict(getattr(request, "param", {}))
+    info = ray_tpu.init(num_cpus=4, object_store_memory=64 << 20,
+                        _system_config=cfg)
+    from ray_tpu import serve
+    serve.start()
+    try:
+        yield info
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        from ray_tpu.serve import _private as sp
+        with sp._router_states_lock:
+            sp._router_states.clear()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "serve_chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 31,
+      # Scripted: every serve replica incarnation dies at its 4th serve
+      # event (dispatch + 3 token pulls), mid-generation — same scenario
+      # as the fault-tolerance suite's token-exact resume test.
+      "chaos_kill_replica_salts": "*",
+      "chaos_kill_replica_at": 4,
+      "chaos_max_faults": 1}],
+    indirect=True)
+def test_chaos_kill_events_stitch_by_trace(serve_chaos_cluster):
+    """ISSUE acceptance criterion: after a chaos kill of an engine
+    replica mid-generation, `state.events()` / `cli events --trace`
+    reconstruct the decision sequence by joining the dead replica's
+    crash dump with events from surviving processes on one trace id."""
+    from ray_tpu import serve, state
+    from ray_tpu.scripts import cli
+
+    handle = serve.run(serve.LLMDeployment.options(
+        name="llm_obs").bind(model="gpt", config="nano", max_lanes=4,
+                             seed=0))
+    with tracing.trace("chaos-forensics") as tid:
+        got = list(handle.options("generate",
+                                  failover=serve.llm_stream_resume)
+                   .stream([1, 2, 3], 8))
+    assert len(got) == 8
+
+    deadline = time.time() + 20
+    evs = []
+    while time.time() < deadline:
+        evs = state.events(trace_id=tid)
+        if any(e.get("source") == "crash" for e in evs) and \
+           any(e.get("source") == "live" for e in evs):
+            break
+        time.sleep(0.5)
+
+    sources = {e.get("source") for e in evs}
+    assert "crash" in sources, \
+        f"no black-box events for trace {tid}: {evs}"
+    assert "live" in sources
+    # The dead replica's ring carries the engine-side decisions for this
+    # request; the driver's ring carries the serve-side failover.
+    kinds = {(e["plane"], e["kind"]) for e in evs}
+    assert ("engine", "submit") in kinds
+    assert ("serve", "failover") in kinds
+    # The kill fired mid-generation: the crashed incarnation and its
+    # replacement both submitted, so >= 2 distinct pids share the trace.
+    assert len({e.get("pid") for e in evs
+                if e["kind"] == "submit"}) >= 2
+    # Skew-normalized merge is ordered.
+    adj = [e["ts_adj"] for e in evs]
+    assert adj == sorted(adj)
+
+    # -- cli events: same reconstruction, rendered ---------------------
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["events", "--address",
+                       serve_chaos_cluster["gcs_address"],
+                       "--trace", tid])
+    assert rc == 0
+    out = buf.getvalue()
+    assert f"trace={tid[:8]}" in out
+    assert "submit" in out and "!" in out  # crash-source marker rendered
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["events", "--address",
+                       serve_chaos_cluster["gcs_address"],
+                       "--plane", "engine", "--limit", "5", "--json"])
+    assert rc == 0
+    parsed = json.loads(buf.getvalue())
+    assert len(parsed) <= 5
+    assert all(e["plane"] == "engine" for e in parsed)
+
+    # -- cli top: per-plane rates + latency percentiles ----------------
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["top", "--address",
+                       serve_chaos_cluster["gcs_address"],
+                       "--count", "1", "--window", "60"])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "events/s by plane" in out
+    assert "latency percentiles:" in out
+    # The generation above populated the engine TTFT/TBT histograms.
+    assert "p50=" in out and "p99=" in out
