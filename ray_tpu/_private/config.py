@@ -195,6 +195,15 @@ _D("flightrec_dir", "", str,
    "directory for crash dumps (flightrec-<pid>-<incarnation>.jsonl); "
    "hostd points workers at <session>/logs via RAY_TPU_FLIGHTREC_DIR, "
    "empty = /tmp/ray_tpu/flightrec")
+_D("telemetry_port", 0, int,
+   "base port for the pull telemetry HTTP endpoints (/metrics /events "
+   "/healthz) served by hostd and the driver; 0 = ephemeral (the bound "
+   "port is announced as a proc/telemetry_listen event).  The server "
+   "only starts when the flight recorder is enabled; -1 disables it "
+   "outright")
+_D("telemetry_host", "127.0.0.1", str,
+   "bind address for the telemetry HTTP endpoints; set 0.0.0.0 to "
+   "expose scrapes off-host")
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")

@@ -44,7 +44,7 @@ from ray_tpu._private import spec_codec
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import ObjectStore
-from ray_tpu.util import tracing
+from ray_tpu.util import spans, tracing
 from ray_tpu._private.protocol import (
     INLINE_LIMIT,
     RefArg,
@@ -125,6 +125,7 @@ class _PendingTask:
     template: tuple | None = None        # (tpl_id, TaskSpecP prefix bytes)
     sched_key: tuple | None = None       # cached _sched_key(spec, ())
     payload_epoch_base: int = 0          # sub.epoch_base baked into payload
+    q_span: object = None                # open sched_queue span (traced only)
 
 
 class _ActorSubmitter:
@@ -937,18 +938,24 @@ class CoreWorker:
     async def _pull_from_node(self, addr: str, oid: ObjectID):
         """Fetch (data, metadata) from one node.  Small objects (the
         common case) cost ONE RPC; past max_inline the daemon answers
-        too_large and the payload streams as bounded-concurrency chunks."""
-        from ray_tpu.util import events
+        too_large and the payload streams as bounded-concurrency chunks.
+        The whole pull is one `object`/`transfer` span (begin -> end with
+        mode/bytes), so cross-node data waits show up in critical paths."""
         client = self.pool.get(addr)
-        reply = await client.call(
-            "NodeManager", "PullObject",
-            {"id": oid.binary(), "max_inline": self.PULL_CHUNK_BYTES})
+        tok = spans.begin("object", "transfer",
+                          oid=oid.binary().hex()[:16], src=addr)
+        try:
+            reply = await client.call(
+                "NodeManager", "PullObject",
+                {"id": oid.binary(), "max_inline": self.PULL_CHUNK_BYTES})
+        except BaseException:
+            spans.end(tok, ok=False)
+            raise
         if not reply.get("found"):
+            spans.end(tok, ok=False)
             return None
         if not reply.get("too_large"):
-            events.record("object", "transfer",
-                          oid=oid.binary().hex()[:16], src=addr,
-                          bytes=len(reply["data"]), mode="inline")
+            spans.end(tok, bytes=len(reply["data"]), mode="inline")
             return reply["data"], reply["metadata"]
         size = reply["data_size"]
         metadata = reply["metadata"]
@@ -979,9 +986,7 @@ class CoreWorker:
                 buf = self.store.get(oid)
                 if buf is not None:
                     try:
-                        events.record("object", "transfer",
-                                      oid=oid.binary().hex()[:16],
-                                      src=addr, bytes=size, mode="native")
+                        spans.end(tok, bytes=size, mode="native")
                         return bytes(buf.data), buf.metadata
                     finally:
                         buf.release()
@@ -1007,9 +1012,9 @@ class CoreWorker:
             *[fetch(off) for off in range(0, size, self.PULL_CHUNK_BYTES)],
             return_exceptions=True)
         if failed or any(isinstance(r, BaseException) for r in results):
+            spans.end(tok, ok=False)
             return None
-        events.record("object", "transfer", oid=oid.binary().hex()[:16],
-                      src=addr, bytes=size, mode="chunked")
+        spans.end(tok, bytes=size, mode="chunked")
         return bytes(out), metadata
 
     _node_cache: tuple | None = None
@@ -1185,6 +1190,11 @@ class CoreWorker:
             runtime_env=renv_desc,
         )
         spec.trace_ctx = tracing.current_context()
+        # Task-lifecycle spans only exist under an explicit trace: the
+        # untraced hot path pays a single None check per site.
+        tok_submit = (spans.begin("sched", "submit", ctx=spec.trace_ctx,
+                                  name=spec.name)
+                      if spec.trace_ctx is not None else None)
         for r in pins:
             self._pin_serialized_ref(r)
         pending = _PendingTask(
@@ -1237,8 +1247,16 @@ class CoreWorker:
         if pending.payload is not None and not pins and self._native_sub:
             sched = self._lease_cache.get(pending.sched_key)
             if sched is not None and sched.try_direct(pending, spec):
+                spans.end(tok_submit, zero_hop=True)
                 return True
+        if tok_submit is not None:
+            # Queue time = enqueue here until a scheduler claims a lease
+            # slot in _dispatch; the token rides on the pending task.
+            pending.q_span = spans.begin("sched", "sched_queue",
+                                         ctx=spec.trace_ctx,
+                                         name=spec.name)
         self._enqueue_fast(("task", task_id))
+        spans.end(tok_submit)
         return True
 
     def _enqueue_fast(self, item):
@@ -2424,12 +2442,17 @@ class CoreWorker:
                 # as its own asyncio task (own contextvar copy), so the
                 # set is isolated per concurrent method call.
                 span = tracing.enter_task(spec)
+                tok_task = (spans.begin("sched", "task",
+                                        ctx=(span[0], span[2]),
+                                        sid=span[1], name=spec.name)
+                            if span is not None else None)
                 try:
                     method = getattr(self.actor_instance, spec.method_name)
                     result = method(*arg_vals, **kwargs)
                     if _inspect.iscoroutine(result):
                         result = await result
                 finally:
+                    spans.end(tok_task)
                     if span is not None:
                         tracing.exit_task()
                 reply = self._pack_reply(spec, result)
@@ -2462,9 +2485,18 @@ class CoreWorker:
         with self._cancel_lock:
             self._running_tasks[spec.task_id] = threading.get_ident()
         span = tracing.enter_task(spec)  # nested submits join the trace
+        # The task's own span reuses enter_task's span id, so the phase
+        # spans below (and any nested submits) hang off it as children.
+        tok_task = (spans.begin("sched", "task", ctx=(span[0], span[2]),
+                                sid=span[1], name=spec.name)
+                    if span is not None else None)
         try:
+            tok = spans.begin("sched", "arg_fetch",
+                              n=len(spec.args) + len(spec.kwargs)) \
+                if tok_task is not None else None
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(v) for k, v in spec.kwargs.items()}
+            spans.end(tok)
             self.current_task_id = spec.task_id
             self.current_task_spec = spec
             if spec.actor_creation:
@@ -2474,6 +2506,8 @@ class CoreWorker:
                 self.actor_instance = cls(*args, **kwargs)
                 self._setup_actor_execution(cls, spec)
                 return {"returns": [], "error": None}
+            tok = spans.begin("sched", "exec", name=spec.name) \
+                if tok_task is not None else None
             if spec.actor_id is not None:
                 if self.actor_instance is None:
                     raise ActorDiedError(spec.actor_id, "no instance")
@@ -2486,10 +2520,16 @@ class CoreWorker:
                 fn = self.fn_manager.fetch_cached(spec.fn_key) or \
                     self.io.run(self.fn_manager.fetch(spec.fn_key))
                 result = fn(*args, **kwargs)
-            return self._pack_reply(spec, result)
+            spans.end(tok)
+            tok = spans.begin("sched", "result_seal") \
+                if tok_task is not None else None
+            reply = self._pack_reply(spec, result)
+            spans.end(tok)
+            return reply
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(spec, e)
         finally:
+            spans.end(tok_task)
             if span is not None:
                 tracing.exit_task()
             with self._cancel_lock:
@@ -2668,8 +2708,16 @@ class _KeyScheduler:
                 return False
             best["inflight"] += 1
         pending.worker_address = best["worker_address"]
-        cb = (lambda status, data: self._on_push_done(
-            spec, None, best, status, data))
+        tok = (spans.begin("sched", "dispatch", ctx=spec.trace_ctx,
+                           name=spec.name, zero_hop=True)
+               if getattr(spec, "trace_ctx", None) is not None else None)
+        if tok is None:
+            cb = (lambda status, data: self._on_push_done(
+                spec, None, best, status, data))
+        else:
+            def cb(status, data, _tok=tok):
+                spans.end(_tok, status=status)
+                self._on_push_done(spec, None, best, status, data)
         sub.call_spec_batch(naddr, [(pending.payload, pending.template,
                                      cb)])
         return True
@@ -2765,16 +2813,27 @@ class _KeyScheduler:
         pending = worker.tasks.get(spec.task_id)
         if pending is not None:
             pending.worker_address = lease["worker_address"]
+            if pending.q_span is not None:
+                spans.end(pending.q_span)
+                pending.q_span = None
+        tok = (spans.begin("sched", "dispatch", ctx=spec.trace_ctx,
+                           name=spec.name)
+               if getattr(spec, "trace_ctx", None) is not None else None)
         if (pending is not None and pending.payload is not None
                 and worker._native_sub):
             naddr = worker._native_addrs.get(lease["worker_address"])
             if naddr:
-                cb = (lambda status, data: self._on_push_done(
-                    spec, sink, lease, status, data))
+                if tok is None:
+                    cb = (lambda status, data: self._on_push_done(
+                        spec, sink, lease, status, data))
+                else:
+                    def cb(status, data, _tok=tok):
+                        spans.end(_tok, status=status)
+                        self._on_push_done(spec, sink, lease, status, data)
                 batches.setdefault(naddr, []).append(
                     (pending.payload, pending.template, cb))
                 return
-        asyncio.ensure_future(self._run_on_lease(spec, sink, lease))
+        asyncio.ensure_future(self._run_on_lease(spec, sink, lease, tok))
 
     def _on_push_done(self, spec, sink, lease, status, data):
         """Completion callback for zero-coroutine native pushes (runs
@@ -2859,13 +2918,15 @@ class _KeyScheduler:
                 self._reaper = None
             self.worker._lease_cache.pop(self.key, None)
 
-    async def _run_on_lease(self, spec, sink, lease):
+    async def _run_on_lease(self, spec, sink, lease, tok=None):
         pending = self.worker.tasks.get(spec.task_id)
         if pending is not None:
             pending.worker_address = lease["worker_address"]
         try:
             reply = await self.worker._push_on_lease(spec, lease)
+            spans.end(tok, status=0)
         except Exception as e:
+            spans.end(tok, status=1)
             self.worker.pool.invalidate(lease["worker_address"])
             with self.tlock:
                 dead = lease in self.leases
@@ -2891,6 +2952,14 @@ class _KeyScheduler:
     async def _acquire_lease(self):
         worker = self.worker
         spec = self.proto_spec
+        # Lease demand is driven by the queue head: attribute the wait to
+        # the trace actually blocked on it (specs sharing a key share the
+        # lease, so this is the lease's best single owner).
+        head = self.queue[0][0] if self.queue else spec
+        tok = (spans.begin("sched", "lease_wait",
+                           ctx=getattr(head, "trace_ctx", None),
+                           key=str(self.key)[:64])
+               if getattr(head, "trace_ctx", None) is not None else None)
         try:
             bundle = None
             if spec.placement_group is not None:
@@ -2946,6 +3015,7 @@ class _KeyScheduler:
                     f"lease rejected: {lease.get('reason')}", node.node_id,
                     busy=lease.get("reason") in ("busy", "resources"))
         except BaseException as e:  # noqa: BLE001 - routed to a queued task
+            spans.end(tok, granted=False)
             self.pending_leases -= 1
             # A busy rejection while we HOLD leases is not a task failure:
             # queued tasks are draining through the held workers; failing
@@ -2969,6 +3039,7 @@ class _KeyScheduler:
             self._pump()
             self._maybe_gc()
             return
+        spans.end(tok, granted=True)
         self.pending_leases -= 1
         lease["node_address"] = node.address
         lease["node_id"] = node.node_id

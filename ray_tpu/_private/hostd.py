@@ -1110,7 +1110,10 @@ class NodeDaemon:
         if used <= (self.spill_high * cap if not bytes_needed else goal):
             return 0
         os.makedirs(self.spill_dir, exist_ok=True)
+        from ray_tpu.util import spans
+        tok = spans.begin("object", "spill", store_used=used)
         freed = 0
+        count = 0
         for oid, size, refcount, sealed, _tick in self.store.list_objects():
             if used - freed <= goal:
                 break
@@ -1139,6 +1142,8 @@ class NodeDaemon:
             _metrics()["bytes_spilled"].inc(size)
             self.store.delete(oid)
             freed += size
+            count += 1
+        spans.end(tok, freed=freed, objects=count)
         if freed:
             logger.info("spilled %d bytes (%d objects on disk)", freed,
                         len(self.spilled))
@@ -1657,7 +1662,41 @@ class NodeDaemon:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
         self._tasks.append(asyncio.ensure_future(self._log_tail_loop()))
+        self._start_telemetry()
         return port
+
+    def _start_telemetry(self):
+        """Pull endpoints (/metrics /events /healthz) for external
+        scrapers.  Handlers run on the HTTP thread pool and hop onto the
+        daemon loop for the node-level merges; rides the flight-recorder
+        switch (RAY_TPU_EVENTS=0 -> no server)."""
+        from ray_tpu.util import telemetry
+        loop = asyncio.get_running_loop()
+
+        def metrics_fn():
+            from ray_tpu.util import metrics as mt
+            reply = asyncio.run_coroutine_threadsafe(
+                self.get_metrics({}), loop).result(timeout=10)
+            return mt.prometheus_text(
+                reply.get("metrics", {}),
+                {"component": "hostd", "node_id": self.node_id.hex()[:12]})
+
+        def events_fn(plane, kind, trace_id, since):
+            reply = asyncio.run_coroutine_threadsafe(
+                self.collect_events({"since": since}), loop).result(
+                    timeout=10)
+            return [e for e in reply.get("events", [])
+                    if (plane is None or e.get("plane") == plane)
+                    and (kind is None or e.get("kind") == kind)
+                    and (trace_id is None or e.get("trace_id") == trace_id)]
+
+        def healthz_fn():
+            return {"node_id": self.node_id.hex(),
+                    "workers": len(self.workers)}
+
+        self.telemetry = telemetry.start_server(
+            metrics_fn=metrics_fn, events_fn=events_fn,
+            component="hostd", healthz_fn=healthz_fn)
 
     def install_signal_handlers(self):
         import signal
@@ -1677,6 +1716,9 @@ class NodeDaemon:
         events.dump_crash("hostd_shutdown")
         from ray_tpu._private.profiling import stop_periodic_profiles
         stop_periodic_profiles()
+        if getattr(self, "telemetry", None) is not None:
+            self.telemetry.stop()
+            self.telemetry = None
         for t in self._tasks:
             t.cancel()
         # Teardown escalation: SIGTERM everyone, give the pool one shared

@@ -171,9 +171,20 @@ class ObjectStore:
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         msize = ctypes.c_uint64()
+        tok = None
+        if timeout_ms != 0:
+            # Blocking gets are a real wait phase (producer hasn't sealed
+            # yet); zero-timeout polls stay span-free.
+            from ray_tpu.util import spans
+            tok = spans.begin("object", "store_wait",
+                              oid=object_id.binary().hex()[:16])
         rc = lib.tpus_obj_get(self._h, object_id.binary(), timeout_ms,
                               ctypes.byref(off), ctypes.byref(size),
                               ctypes.byref(msize))
+        if tok is not None:
+            from ray_tpu.util import spans
+            spans.end(tok, found=rc not in (_NOT_FOUND, _BAD_STATE,
+                                            _TIMEOUT))
         if rc in (_NOT_FOUND, _BAD_STATE):
             return None
         if rc == _TIMEOUT:

@@ -33,7 +33,13 @@ def main():
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.ids import JobID, NodeID
     from ray_tpu._private.rpc import RpcClient
+    from ray_tpu.util import spans
     t_imports = time.perf_counter() - t0
+    # Boot span: CoreWorker construction through WorkerReady ack, so a
+    # creation storm shows up as a wall of long proc/boot spans (import
+    # cost rides along in the payload — it predates the recorder).
+    tok_boot = spans.begin("proc", "boot", pid=os.getpid(),
+                           imports_ms=round(t_imports * 1e3, 1))
 
     cw = CoreWorker(
         mode="worker",
@@ -82,6 +88,7 @@ def main():
             time.sleep(0.5 * (attempt + 1))
     else:
         raise RuntimeError(f"WorkerReady never acknowledged: {last}")
+    spans.end(tok_boot)
     if boot_trace:
         print(f"[boot-trace] imports={t_imports*1e3:.1f}ms "
               f"core_worker={(t_core - t_imports)*1e3:.1f}ms "

@@ -149,11 +149,41 @@ def init(address: str | None = None, *, num_cpus=None, num_tpus=None,
             raise
         if log_to_driver:
             _start_log_echo(_worker)
+        _start_driver_telemetry()
         atexit.register(shutdown)
         return _connection_info()
 
 
 _log_echo_stop = None
+_telemetry = None
+
+
+def _start_driver_telemetry():
+    """Driver-process pull endpoints (/metrics /events /healthz): serve
+    routers, train drivers, and user Counters record in THIS process,
+    which no hostd scrapes — the driver exports its own."""
+    global _telemetry
+    import time as _time
+
+    from ray_tpu.util import metrics as mt
+    from ray_tpu.util import telemetry
+
+    # A lean driver may never touch a library Counter, and an empty
+    # /metrics body reads as a broken scrape — always export uptime.
+    up = mt.Gauge("driver_uptime_seconds", "seconds since ray_tpu.init")
+    t0 = _time.time()
+
+    def metrics_fn():
+        up.set(_time.time() - t0)
+        return mt.prometheus_text(mt.collect(), {"component": "driver"})
+
+    def events_fn(plane, kind, trace_id, since):
+        from ray_tpu.util import events as ev
+        return [e for e in ev.snapshot(since=since, plane=plane, kind=kind)
+                if trace_id is None or e.get("trace_id") == trace_id]
+
+    _telemetry = telemetry.start_server(
+        metrics_fn=metrics_fn, events_fn=events_fn, component="driver")
 
 
 def _start_log_echo(worker):
@@ -212,10 +242,14 @@ _applied_system_config: list = []
 
 def shutdown():
     """Disconnect; if we bootstrapped the cluster, tear it down."""
-    global _worker, _cluster, _applied_system_config, _log_echo_stop
+    global _worker, _cluster, _applied_system_config, _log_echo_stop, \
+        _telemetry
     if _log_echo_stop is not None:
         _log_echo_stop.set()
         _log_echo_stop = None
+    if _telemetry is not None:
+        _telemetry.stop()
+        _telemetry = None
     with _global_lock:
         if _worker is None:
             return

@@ -115,6 +115,11 @@ def stage(tree: Any, *, save_id: str = "0", step: Optional[int] = None,
     shards to host memory and build the manifest.  Runs at the step
     boundary; everything after (serialization, I/O, commit) can happen
     on a background thread against the snapshot."""
+    from ray_tpu.util import spans
+    # Durational span: stage() runs AT the step boundary, so its length
+    # is exactly the checkpoint tax on training (the async writer hides
+    # the rest).
+    tok = spans.begin("ckpt", "stage", save_id=str(save_id), step=step)
     pidx, pcount = _process_info()
     skeleton, leaves = encode_tree(tree)
     arrays = []
@@ -167,9 +172,7 @@ def stage(tree: Any, *, save_id: str = "0", step: Optional[int] = None,
         "tree": skeleton,
         "arrays": arrays,
     }
-    from ray_tpu.util import events
-    events.record("ckpt", "stage", save_id=str(save_id), step=step,
-                  chunks=len(local))
+    spans.end(tok, chunks=len(local))
     return Staged(manifest=manifest, local_chunks=local,
                   process_index=pidx, process_count=pcount,
                   save_id=str(save_id))

@@ -39,7 +39,7 @@ from typing import Any, Iterable, Iterator, List, Optional
 
 import ray_tpu
 from ray_tpu.data import block as blk
-from ray_tpu.util import events
+from ray_tpu.util import events, spans
 
 
 def _cfg():
@@ -72,6 +72,12 @@ def _metrics():
             "requeues": mt.Counter(
                 "ingest_lease_requeues",
                 "block leases re-queued after their worker died"),
+            "served": mt.Counter(
+                "ingest_blocks_served",
+                "blocks handed out by the split coordinator"),
+            "queue_depth": mt.Gauge(
+                "ingest_queue_depth",
+                "current depth of the producer->consumer handoff queue"),
             "fetch_s": mt.Histogram(
                 "ingest_fetch_s",
                 "per-block fetch latency (ref resolution + transfer)",
@@ -238,18 +244,27 @@ class BatchProducer:
     # -- producer side ----------------------------------------------------
 
     def _put(self, item) -> bool:
+        # Starvation counters flush LIVE (not at end-of-run): a scrape of
+        # /metrics or `cli top` mid-epoch must see the bottleneck while
+        # it is happening, not after the iterator is exhausted.
+        met = _metrics()
         while not self._stop.is_set():
             t0 = time.perf_counter()
             try:
                 self._q.put(item, timeout=0.1)
             except queue.Full:
-                self._stats["producer_wait_s"] += time.perf_counter() - t0
+                waited = time.perf_counter() - t0
+                self._stats["producer_wait_s"] += waited
+                met["producer_wait"].inc(waited)
                 continue
             waited = time.perf_counter() - t0
             if waited > 0.005:
                 self._stats["producer_wait_s"] += waited
+                met["producer_wait"].inc(waited)
+            depth = self._q.qsize()
             self._stats["max_queue_depth"] = max(
-                self._stats["max_queue_depth"], self._q.qsize())
+                self._stats["max_queue_depth"], depth)
+            met["queue_depth"].set(depth)
             return True
         return False
 
@@ -259,13 +274,12 @@ class BatchProducer:
                     self._blocks, self._batch_size, self._format,
                     self._drop_last):
                 self._stats["batches"] += 1
+                _metrics()["batches"].inc()
                 if not self._put(batch):
                     return
         except BaseException as e:  # noqa: BLE001 — crosses to the consumer
             self._error = e
         finally:
-            _metrics()["producer_wait"].inc(self._stats["producer_wait_s"])
-            _metrics()["batches"].inc(self._stats["batches"])
             try:
                 self._q.put(_DONE, timeout=60)
             except queue.Full:
@@ -275,11 +289,18 @@ class BatchProducer:
 
     def __iter__(self) -> Iterator[Any]:
         from ray_tpu.util.metrics import timer
-        wait = _metrics()["consumer_wait"]
+        met = _metrics()
+        wait = met["consumer_wait"]
         try:
             while True:
+                # Durational ingest_wait span: the gap the training
+                # thread spends blocked on the handoff queue (always on —
+                # batch cadence is far below the ring's budget).
+                tok = spans.begin("ingest", "ingest_wait")
                 with timer(wait) as t:
                     item = self._q.get()
+                spans.end(tok, depth=self._q.qsize())
+                met["queue_depth"].set(self._q.qsize())
                 self._stats["consumer_wait_s"] += t.elapsed
                 if t.elapsed > 0.01:
                     # The training thread sat idle on an empty handoff
@@ -354,14 +375,20 @@ class DeviceBatchIterator:
         if not self._have_resolved:
             self._resolved = _resolve_sharding(self._sharding, batch)
             self._have_resolved = True
-        if self._resolved is None:
-            return jax.device_put(batch)
-        if isinstance(self._resolved, dict):
-            return {k: (jax.device_put(v, self._resolved[k])
-                        if self._resolved[k] is not None
-                        else jax.device_put(v))
-                    for k, v in batch.items()}
-        return jax.device_put(batch, self._resolved)
+        # h2d span covers the device_put DISPATCH (the copy itself is
+        # async; a long span here means the staging queue is full).
+        tok = spans.begin("ingest", "h2d")
+        try:
+            if self._resolved is None:
+                return jax.device_put(batch)
+            if isinstance(self._resolved, dict):
+                return {k: (jax.device_put(v, self._resolved[k])
+                            if self._resolved[k] is not None
+                            else jax.device_put(v))
+                        for k, v in batch.items()}
+            return jax.device_put(batch, self._resolved)
+        finally:
+            spans.end(tok)
 
     def __iter__(self) -> Iterator[Any]:
         inflight: deque = deque()
@@ -511,6 +538,7 @@ class SplitCoordinator:
         self._next_lease += 1
         self._leases[lease_id] = (worker, idx, now)
         self._stats["served"] += 1
+        _metrics()["served"].inc()
         return (lease_id, idx)
 
     def done(self, worker: int, lease_id) -> None:

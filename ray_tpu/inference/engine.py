@@ -45,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.inference.kv_cache import PagedKVCache
-from ray_tpu.util import events
+from ray_tpu.util import events, spans
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 _DONE = object()
@@ -115,6 +115,10 @@ class _Request:
     last_emit: float = 0.0             # wall time of the previous token
     fed: int = 0            # prompt tokens in the cache (prefilled OR reused)
     produced: int = 0
+    # Open engine span for TRACED requests only: the prefill span
+    # (submit -> first token) until produced==1, then the current
+    # inter-token decode span.  Untraced requests never pay for these.
+    span_tok: object = None
     last_token: int = 0
     emitted: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
@@ -277,6 +281,12 @@ class InferenceEngine:
                        submitted=time.time())
         events.record("engine", "submit", trace=req.trace, rid=rid,
                       prompt_len=len(prompt), max_new=max_new_tokens)
+        if req.trace is not None:
+            # Prefill span: submit -> first emitted token (TTFT, queue
+            # wait included).  _commit swaps it for per-token decode
+            # spans once tokens flow.
+            req.span_tok = spans.begin("engine", "prefill", ctx=req.trace,
+                                       rid=rid, prompt_len=len(prompt))
         with self._work:
             if self._stopped:
                 raise RuntimeError("engine is shut down")
@@ -310,11 +320,15 @@ class InferenceEngine:
             else:
                 req.finish_reason = "cancelled"
                 req.out.put(_DONE)
+                spans.end(req.span_tok, ok=False)
+                req.span_tok = None
                 return True
             for lane, r in enumerate(self._lanes):
                 if r is req:
                     req.finish_reason = "cancelled"
                     req.out.put(_DONE)
+                    spans.end(req.span_tok, ok=False)
+                    req.span_tok = None
                     self.cache.free_lane(lane)
                     self._lanes[lane] = None
                     events.record("engine", "lane_evict", trace=req.trace,
@@ -336,6 +350,8 @@ class InferenceEngine:
                 req.out.put(_DONE)
                 self.cache.free_lane(lane)
                 self._lanes[lane] = None
+                spans.end(req.span_tok, ok=False)
+                req.span_tok = None
                 events.record("engine", "deadline_kill", trace=req.trace,
                               rid=req.rid, lane=lane,
                               produced=req.produced)
@@ -345,6 +361,8 @@ class InferenceEngine:
             self._waiting.remove(req)
             req.finish_reason = "deadline"
             req.out.put(_DONE)
+            spans.end(req.span_tok, ok=False)
+            req.span_tok = None
             events.record("engine", "deadline_kill", trace=req.trace,
                           rid=req.rid, lane=None, produced=0)
 
@@ -619,6 +637,15 @@ class InferenceEngine:
                 req.finish_reason = "length"
             elif int(self.cache.seq_lens[lane]) >= self.cache.max_seq_len:
                 req.finish_reason = "max_seq_len"
+            if req.trace is not None:
+                # Close the span ending at this emit (prefill for the
+                # first token, the previous decode gap otherwise) and
+                # open the next decode span unless the request is done.
+                spans.end(req.span_tok, tokens=req.produced)
+                req.span_tok = (
+                    None if req.finish_reason is not None else
+                    spans.begin("engine", "decode", ctx=req.trace,
+                                rid=req.rid, t=req.produced))
             if req.finish_reason is not None:
                 req.out.put(_DONE)
                 self.cache.free_lane(lane)

@@ -272,7 +272,94 @@ def cmd_serve(args) -> int:
 
 def cmd_metrics(args) -> int:
     from ray_tpu import state
+    if getattr(args, "json", False):
+        # Structured snapshot (per-node registries, un-merged) for
+        # scripting; the default stays Prometheus exposition text.
+        print(json.dumps(state.cluster_metrics(args.address), indent=2,
+                         default=str))
+        return 0
     print(state.prometheus_metrics(args.address), end="")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """ASCII span tree of one trace: every process's begin/end pairs,
+    clock-normalized and parent-linked, torn spans flagged (a crash dump
+    terminates its open spans at dump time)."""
+    from ray_tpu import state
+    tree = state.spans(args.trace_id, args.address, since=args.since)
+    if args.json:
+        print(json.dumps(tree, indent=2, default=str))
+        return 0
+    root = tree["root"]
+    if root is None:
+        print(f"no spans for trace {args.trace_id}")
+        return 1
+
+    def fmt(n) -> str:
+        dur = (f"{n['dur'] * 1e3:9.2f}ms" if n.get("dur") is not None
+               else "        ?ms")
+        flags = "".join([" TORN" if n.get("torn") else "",
+                         " ~trunc" if n.get("truncated") else ""])
+        where = (f" [{str(n.get('node_id') or '')[:8]}:{n.get('pid', '?')}]"
+                 if n.get("pid") else "")
+        payload = n.get("payload") or {}
+        extras = " ".join(f"{k}={v}" for k, v in payload.items()
+                          if k not in ("ph", "parent", "dur"))
+        return (f"{n['plane']}/{n['kind']:<12s} {dur}{flags}{where}"
+                + (f" {extras}" if extras else ""))
+
+    def walk(n, prefix: str, is_last: bool, is_root: bool):
+        if is_root:
+            print(fmt(n))
+            child_prefix = ""
+        else:
+            print(f"{prefix}{'└─ ' if is_last else '├─ '}{fmt(n)}")
+            child_prefix = prefix + ("   " if is_last else "│  ")
+        kids = sorted(n.get("children", []),
+                      key=lambda c: c.get("start") or 0.0)
+        for i, c in enumerate(kids):
+            walk(c, child_prefix, i == len(kids) - 1, False)
+
+    wall = ((root["end"] - root["start"]) * 1e3
+            if root.get("end") is not None and root.get("start") is not None
+            else 0.0)
+    print(f"trace {args.trace_id[:16]}  wall={wall:.2f}ms  "
+          f"{len(tree['spans'])} spans  {tree['torn']} torn")
+    walk(root, "", True, True)
+    cp = state.critical_path(args.trace_id, args.address, since=args.since)
+    if cp["by_kind"]:
+        print("critical path:")
+        for k, v in cp["by_kind"].items():
+            if v * 1e3 < 0.005:
+                continue  # zero-length bookkeeping segments
+            frac = v / cp["wall"] if cp["wall"] else 0.0
+            print(f"  {k:<22s} {v * 1e3:9.2f}ms  {frac:6.1%}")
+    return 0
+
+
+def cmd_analyze(args) -> int:
+    """Ranked per-phase latency table: where cluster wall clock goes,
+    per span kind (p50/p95/p99, total, fraction of the observed
+    window)."""
+    from ray_tpu import state
+    bd = state.latency_breakdown(args.address, plane=args.plane,
+                                 trace_id=args.trace, since=args.since)
+    if args.json:
+        print(json.dumps(bd, indent=2, default=str))
+        return 0
+    if not bd["phases"]:
+        print("no span data (is RAY_TPU_EVENTS on? did anything run "
+              "under a trace?)")
+        return 1
+    print(f"-- latency breakdown (window {bd['wall']:.3f}s) --")
+    print(f"{'phase':<24s} {'count':>7s} {'p50(ms)':>9s} {'p95(ms)':>9s} "
+          f"{'p99(ms)':>9s} {'total(s)':>9s} {'%wall':>7s}")
+    for ph in bd["phases"]:
+        print(f"{ph['plane'] + '/' + ph['kind']:<24s} {ph['count']:>7d} "
+              f"{ph['p50'] * 1e3:>9.2f} {ph['p95'] * 1e3:>9.2f} "
+              f"{ph['p99'] * 1e3:>9.2f} {ph['total']:>9.3f} "
+              f"{ph['fraction']:>7.1%}")
     return 0
 
 
@@ -366,17 +453,22 @@ def cmd_top(args) -> int:
             lines.append("  (no histogram data yet)")
         return "\n".join(lines)
 
+    watch = getattr(args, "watch", None)
+    interval = watch if watch else args.interval
     i = 0
     try:
         while True:
             if i:
-                _time.sleep(args.interval)
+                _time.sleep(interval)
+            if watch:
+                # Clear + home, full-screen redraw (watch(1)-style).
+                print("\x1b[2J\x1b[H", end="")
             print(render(), flush=True)
             i += 1
             if args.count and i >= args.count:
                 break
     except KeyboardInterrupt:
-        pass
+        print()  # leave the shell prompt on its own line
     return 0
 
 
@@ -745,7 +837,34 @@ def main(argv=None) -> int:
                    help="refresh period")
     q.add_argument("--count", type=int, default=0,
                    help="stop after N refreshes (0 = until ctrl-c)")
+    q.add_argument("--watch", type=float, nargs="?", const=2.0,
+                   default=None, metavar="SECONDS",
+                   help="full-screen refresh every N seconds (clear + "
+                        "redraw; ctrl-c exits)")
     q.set_defaults(fn=cmd_top)
+
+    q = sub.add_parser("trace",
+                       help="ASCII span tree + critical path of one trace")
+    q.add_argument("trace_id")
+    q.add_argument("--address", required=True)
+    q.add_argument("--since", type=float, default=0.0,
+                   help="unix timestamp lower bound for the scrape")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_trace)
+
+    q = sub.add_parser("analyze",
+                       help="ranked per-phase latency breakdown from "
+                            "span durations")
+    q.add_argument("--address", required=True)
+    q.add_argument("--plane", default=None,
+                   help="narrow to one plane (sched/object/engine/serve/"
+                        "ckpt/ingest/train/proc)")
+    q.add_argument("--trace", default=None,
+                   help="narrow to one trace id")
+    q.add_argument("--since", type=float, default=0.0,
+                   help="unix timestamp lower bound for the scrape")
+    q.add_argument("--json", action="store_true")
+    q.set_defaults(fn=cmd_analyze)
 
     q = sub.add_parser("serve", help="serve control (deploy/status/shutdown)")
     q.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])

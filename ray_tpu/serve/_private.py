@@ -44,7 +44,7 @@ from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu.exceptions import (
     ActorDiedError, ActorUnavailableError, ReplicaStreamLostError,
     ServeOverloadedError, TaskError)
-from ray_tpu.util import events
+from ray_tpu.util import events, spans, tracing
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
@@ -209,6 +209,15 @@ class ReplicaActor:
         import asyncio
         import inspect
         _chaos_kill_point()
+        # Traced requests get a serve/replica span around the user-code
+        # invocation (child of the task exec span via the contextvar set
+        # by the worker); `ongoing` captures concurrent load at entry.
+        tok = (spans.begin("serve", "replica",
+                           method=method_name or "__call__",
+                           ongoing=self._ongoing)
+               if tracing.current_context() is not None else None)
+        cv = (tracing._ctx.set((tok.trace_id, tok.sid))
+              if tok is not None and tok.trace_id else None)
         self._ongoing += 1  # loop-thread only: no lock needed
         try:
             target = self._callable
@@ -258,6 +267,8 @@ class ReplicaActor:
                 self._streams[sid] = gen
                 self._stream_deadlines[sid] = deadline
                 self._ongoing += 1   # held until stream end
+                spans.end(tok, stream=True)
+                tok = None
                 return {"__serve_stream__": sid}
             if inspect.iscoroutinefunction(target) or (
                     not inspect.isfunction(target)
@@ -279,6 +290,9 @@ class ReplicaActor:
             return result
         finally:
             self._ongoing -= 1
+            spans.end(tok)
+            if cv is not None:
+                tracing._ctx.reset(cv)
 
     async def next_chunk(self, sid: int):
         """Pull ONE chunk of stream `sid`: {"chunk": value} or
@@ -1044,9 +1058,14 @@ class DeploymentHandle:
         the bounded queue until one frees up, the backpressure window
         closes, or the request deadline passes."""
         t0 = time.perf_counter()
+        # Traced requests get an explicit admit span (queue wait is the
+        # classic serve bottleneck); untraced ones keep the instant event.
+        tok = (spans.begin("serve", "admit", deployment=self._name)
+               if tracing.current_context() is not None else None)
         pick = self._pick_replica()
         if pick is not None:
             self._observe_admit(t0)
+            spans.end(tok, queued=False)
             return pick
         self._admission_enter()
         try:
@@ -1055,8 +1074,10 @@ class DeploymentHandle:
                 pick = self._pick_replica()
                 if pick is not None:
                     self._observe_admit(t0)
+                    spans.end(tok, queued=True)
                     return pick
                 if time.monotonic() > limit:
+                    spans.end(tok, granted=False)
                     raise TimeoutError(
                         f"no replica of {self._name!r} under its "
                         f"max_concurrent_queries cap before the deadline")
@@ -1073,9 +1094,12 @@ class DeploymentHandle:
     async def _acquire_replica_async(self, deadline: Optional[float]):
         import asyncio
         t0 = time.perf_counter()
+        tok = (spans.begin("serve", "admit", deployment=self._name)
+               if tracing.current_context() is not None else None)
         pick = self._pick_replica()
         if pick is not None:
             self._observe_admit(t0)
+            spans.end(tok, queued=False)
             return pick
         self._admission_enter()
         try:
@@ -1084,8 +1108,10 @@ class DeploymentHandle:
                 pick = self._pick_replica()
                 if pick is not None:
                     self._observe_admit(t0)
+                    spans.end(tok, queued=True)
                     return pick
                 if time.monotonic() > limit:
+                    spans.end(tok, granted=False)
                     raise TimeoutError(
                         f"no replica of {self._name!r} under its "
                         f"max_concurrent_queries cap before the deadline")
@@ -1108,13 +1134,29 @@ class DeploymentHandle:
 
     def _call(self, method, args, kwargs):
         t0 = time.time()
-        self._refresh()
-        deadline = self._request_deadline()
-        replica, key = self._acquire_replica(deadline)
-        ref = replica.handle_request.remote(
-            method, args, kwargs, False, self._remaining(deadline))
+        # Traced requests open a serve/request span covering submit ->
+        # result(); routing, admission and the task-lifecycle subtree all
+        # parent under it (the contextvar is scoped to this call so the
+        # span closes from _TrackedRef on whatever thread collects it).
+        tok = (spans.begin("serve", "request", deployment=self._name,
+                           method=method or "__call__")
+               if tracing.current_context() is not None else None)
+        cv = (tracing._ctx.set((tok.trace_id, tok.sid))
+              if tok is not None and tok.trace_id else None)
+        try:
+            self._refresh()
+            deadline = self._request_deadline()
+            replica, key = self._acquire_replica(deadline)
+            ref = replica.handle_request.remote(
+                method, args, kwargs, False, self._remaining(deadline))
+        except BaseException:
+            spans.end(tok, ok=False)
+            raise
+        finally:
+            if cv is not None:
+                tracing._ctx.reset(cv)
         return _TrackedRef(ref, self, key, method, args, kwargs,
-                           deadline=deadline, t0=t0)
+                           deadline=deadline, t0=t0, tok=tok)
 
     def stream(self, *args, **kwargs):
         """Synchronous streaming call: yields the chunks of a generator
@@ -1390,7 +1432,7 @@ class _TrackedRef:
     def __init__(self, ref, handle: DeploymentHandle, key: bytes,
                  method: str, args, kwargs, retried: bool = False,
                  deadline: Optional[float] = None,
-                 t0: Optional[float] = None):
+                 t0: Optional[float] = None, tok=None):
         self._ref = ref
         self._handle = handle
         self._idx = key
@@ -1398,6 +1440,7 @@ class _TrackedRef:
         self._retried = retried
         self._deadline = deadline
         self._t0 = t0 if t0 is not None else time.time()
+        self._tok = tok          # open serve/request span (traced only)
 
     def result(self, timeout: Optional[float] = None):
         from ray_tpu.exceptions import ActorDiedError, RayTpuTimeoutError
@@ -1407,11 +1450,15 @@ class _TrackedRef:
             self._handle._done(self._idx)
             if self._retried or (self._deadline is not None
                                  and time.monotonic() > self._deadline):
+                spans.end(self._tok, ok=False)
+                self._tok = None
                 raise
             _serve_metrics()["retries"].inc()
             events.record("serve", "retry",
                           deployment=self._handle._name,
                           method=self._request[0])
+            spans.end(self._tok, retried=True)
+            self._tok = None
             self._handle._on_replica_error()
             method, args, kwargs = self._request
             retry = self._handle._call(method, args, kwargs)
@@ -1420,16 +1467,21 @@ class _TrackedRef:
             return retry.result(timeout)
         except RayTpuTimeoutError:
             # Still executing on the replica: keep the slot charged until
-            # it actually finishes (admission-cap correctness).
+            # it actually finishes (admission-cap correctness).  The span
+            # stays open; a later result() (or the crash horizon) ends it.
             handle, key = self._handle, self._idx
             self._ref.future().add_done_callback(
                 lambda _: handle._done(key))
             raise
         except BaseException:
             self._handle._done(self._idx)
+            spans.end(self._tok, ok=False)
+            self._tok = None
             raise
         self._handle._done(self._idx)
         _serve_metrics()["e2e"].observe(time.time() - self._t0)
+        spans.end(self._tok)
+        self._tok = None
         return value
 
     @property

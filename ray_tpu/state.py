@@ -146,6 +146,64 @@ def list_tasks(address: Optional[str] = None,
     return list(reply.get("events", []))
 
 
+# A node's clock may be ahead of the caller's by up to this much without
+# its fresh events being pre-filtered away at the remote ring.  The raw
+# `since` forwarded to each node is widened by this slack — it is only a
+# bandwidth optimization; the authoritative cutoff is applied locally on
+# the skew-adjusted ts_adj.
+_SKEW_SLACK_S = 300.0
+
+
+def _normalize_events_reply(reply: Dict[str, Any], node_id: str,
+                            t0: float, t1: float) -> List[Dict[str, Any]]:
+    """Put one node's CollectEvents reply on the caller's clock.
+
+    The RPC midpoint approximates the remote `now` locally, so
+    ``ts_adj = ts + (local_midpoint - remote_now)`` (NTP-grade, good
+    enough to order cross-node decision sequences)."""
+    mid = (t0 + t1) / 2.0
+    offset = mid - reply.get("now", mid)
+    out = []
+    for e in reply.get("events", []):
+        e = dict(e)
+        e["node_id"] = node_id
+        e["ts_adj"] = e["ts"] + offset
+        out.append(e)
+    return out
+
+
+def _merge_event_streams(streams: List[List[Dict[str, Any]]], *,
+                         plane: Optional[str] = None,
+                         kind: Optional[str] = None,
+                         trace_id: Optional[str] = None,
+                         since: float = 0.0) -> List[Dict[str, Any]]:
+    """Pure merge of already-normalized per-process event streams:
+    dedup by (pid, seq) preferring live copies over crash-dump copies
+    of the same event, apply every filter AFTER normalization (`since`
+    compares ts_adj, never the raw per-process ts), order by ts_adj."""
+    best: Dict[tuple, Dict[str, Any]] = {}
+    extra: List[Dict[str, Any]] = []
+    for stream in streams:
+        for e in stream:
+            key = (e.get("pid"), e.get("seq"))
+            if key[0] is None or key[1] is None:
+                extra.append(e)
+                continue
+            cur = best.get(key)
+            if cur is None or (cur.get("source") == "crash"
+                               and e.get("source") != "crash"):
+                best[key] = e
+    evs = list(best.values()) + extra
+    evs = [e for e in evs
+           if e.get("ts_adj", e["ts"]) >= since
+           and (plane is None or e.get("plane") == plane)
+           and (kind is None or e.get("kind") == kind)
+           and (trace_id is None or e.get("trace_id") == trace_id)]
+    evs.sort(key=lambda e: (e.get("ts_adj", e["ts"]),
+                            str(e.get("pid")), e.get("seq") or 0))
+    return evs
+
+
 def events(address: Optional[str] = None, *, plane: Optional[str] = None,
            kind: Optional[str] = None, trace_id: Optional[str] = None,
            since: float = 0.0) -> List[Dict[str, Any]]:
@@ -154,21 +212,22 @@ def events(address: Optional[str] = None, *, plane: Optional[str] = None,
     dumps from dead processes) plus the connected driver's own ring,
     time-skew normalized and merged into one ordered stream.
 
-    Skew normalization: each node reply carries its wall clock (`now`);
-    the RPC midpoint approximates the same instant locally, so
-    ``ts_adj = ts + (local_midpoint - remote_now)`` puts every node's
-    events on the caller's clock (NTP-grade, good enough to order
-    cross-node decision sequences).  Filters: plane / kind / trace_id /
-    since (raw remote ts)."""
+    Filter semantics: `since` (like the ordering) applies to the
+    skew-adjusted ``ts_adj`` after the merge — a node whose clock runs
+    behind the caller's cannot leak stale events past the cutoff, and
+    one running ahead cannot hide fresh ones.  The remote rings are
+    pre-filtered with a widened window (`_SKEW_SLACK_S`) purely to
+    bound reply size."""
     import os
     import time as _time
 
     addr = _gcs_address(address)
+    pre_since = max(0.0, since - _SKEW_SLACK_S)
 
     async def _collect():
         from ray_tpu._private.rpc import RpcClient
         nodes = (await _gcs_call(addr, "get_nodes"))["nodes"]
-        out: List[Dict[str, Any]] = []
+        streams: List[List[Dict[str, Any]]] = []
         for n in nodes:
             if not n.alive:
                 continue
@@ -176,41 +235,272 @@ def events(address: Optional[str] = None, *, plane: Optional[str] = None,
             try:
                 t0 = _time.time()
                 reply = await client.call(
-                    "NodeManager", "CollectEvents", {"since": since},
+                    "NodeManager", "CollectEvents", {"since": pre_since},
                     timeout=10)
                 t1 = _time.time()
             except Exception:
                 continue
             finally:
                 await client.close()
-            mid = (t0 + t1) / 2.0
-            offset = mid - reply.get("now", mid)
-            for e in reply.get("events", []):
-                e = dict(e)
-                e["node_id"] = n.node_id.hex()
-                e["ts_adj"] = e["ts"] + offset
-                out.append(e)
-        return out
+            streams.append(_normalize_events_reply(
+                reply, n.node_id.hex(), t0, t1))
+        return streams
 
-    evs = _run(_collect())
+    streams = _run(_collect())
     # The caller's own ring: serve routers and train drivers record from
-    # the driver process, which no hostd scrapes.
+    # the driver process, which no hostd scrapes.  The driver's clock IS
+    # the reference clock, so ts_adj == ts.
     from ray_tpu import api
     from ray_tpu.util import events as ev
-    if api._worker is not None and address is None:
+    # Included whenever this process is connected — even with an explicit
+    # address (the in-process CLI path): the driver ring holds the
+    # submit-side spans no hostd can see.
+    if api._worker is not None:
         driver_pid = os.getpid()
-        seen = {(e.get("pid"), e.get("seq")) for e in evs}
-        for e in ev.snapshot(since=since):
-            if (driver_pid, e.get("seq")) in seen:
+        streams.append([
+            dict(e, pid=driver_pid, source="live", node_id="driver",
+                 ts_adj=e["ts"])
+            for e in ev.snapshot(since=pre_since)])
+    return _merge_event_streams(streams, plane=plane, kind=kind,
+                                trace_id=trace_id, since=since)
+
+
+# ---------------------------------------------------------------------------
+# Spans: durational reconstruction over the merged event stream
+# ---------------------------------------------------------------------------
+
+
+def build_spans(evs: List[Dict[str, Any]],
+                trace_id: Optional[str] = None
+                ) -> tuple[Dict[str, Dict[str, Any]],
+                           List[Dict[str, Any]]]:
+    """Pair ``ph="B"``/``ph="E"`` events from a merged, ts_adj-ordered
+    stream into span records and link them into trees.
+
+    Tolerant by construction: events may arrive out of order (fields
+    just fill in), a missing begin (ring overflow dropped it) marks the
+    span ``truncated`` and back-dates its start from the end event's
+    ``dur``, and a missing end marks it ``torn`` and terminates it at
+    its process's crash-dump time (the black box pins when the process
+    died) or, failing that, at the observation horizon.  Returns
+    ``(spans_by_sid, roots)`` — roots are spans whose parent is absent
+    from the stream (including spans orphaned by overflow)."""
+    crash_time: Dict[Any, float] = {}
+    horizon = 0.0
+    for e in evs:
+        t = e.get("ts_adj", e["ts"])
+        if t > horizon:
+            horizon = t
+        if e.get("source") == "crash":
+            p = e.get("pid")
+            if t > crash_time.get(p, 0.0):
+                crash_time[p] = t
+    table: Dict[str, Dict[str, Any]] = {}
+    for e in evs:
+        pl = e.get("payload") or {}
+        ph = pl.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        if trace_id is not None and e.get("trace_id") != trace_id:
+            continue
+        sid = e.get("span_id")
+        if sid is None:
+            continue
+        rec = table.get(sid)
+        if rec is None:
+            rec = table[sid] = {
+                "sid": sid, "trace_id": e.get("trace_id"),
+                "plane": e.get("plane"), "kind": e.get("kind"),
+                "parent": None, "start": None, "end": None, "dur": None,
+                "pid": e.get("pid"), "node_id": e.get("node_id"),
+                "torn": False, "truncated": False, "payload": {},
+                "children": [],
+            }
+        if ph == "B":
+            rec["start"] = e.get("ts_adj", e["ts"])
+            rec["parent"] = pl.get("parent")
+            rec["pid"] = e.get("pid")
+            rec["node_id"] = e.get("node_id")
+        else:
+            rec["end"] = e.get("ts_adj", e["ts"])
+            rec["dur"] = pl.get("dur")
+        for k, v in pl.items():
+            if k not in ("ph", "parent", "dur"):
+                rec["payload"][k] = v
+    for rec in table.values():
+        if rec["start"] is None:
+            rec["truncated"] = True
+            if rec["end"] is not None and rec["dur"] is not None:
+                rec["start"] = rec["end"] - rec["dur"]
+            else:
+                rec["start"] = rec["end"]
+        if rec["end"] is None:
+            rec["torn"] = True
+            t = crash_time.get(rec["pid"])
+            if t is not None and rec["start"] is not None \
+                    and t >= rec["start"]:
+                rec["end"] = t
+            else:
+                rec["end"] = max(horizon, rec["start"] or 0.0)
+        if rec["dur"] is None and rec["start"] is not None \
+                and rec["end"] is not None:
+            rec["dur"] = rec["end"] - rec["start"]
+    roots: List[Dict[str, Any]] = []
+    ordered = sorted(table.values(),
+                     key=lambda r: (r["start"] is None, r["start"] or 0.0))
+    for rec in ordered:
+        p = rec["parent"]
+        if p is not None and p != rec["sid"] and p in table:
+            table[p]["children"].append(rec)
+        else:
+            roots.append(rec)
+    return table, roots
+
+
+def spans(trace_id: str, address: Optional[str] = None, *,
+          since: float = 0.0) -> Dict[str, Any]:
+    """Cluster-wide span tree for one trace: scrape every ring + crash
+    dump, normalize clocks, pair begins/ends, link parents.  The result
+    is rooted (a synthetic root is added when the trace's own root span
+    was lost) and annotated with torn/truncated markers."""
+    evs = events(address, since=since)
+    table, roots = build_spans(evs, trace_id)
+    flat = sorted(table.values(), key=lambda r: r["start"] or 0.0)
+    torn = sum(1 for r in flat if r["torn"])
+    if not flat:
+        return {"trace_id": trace_id, "root": None, "spans": [],
+                "torn": 0}
+    if len(roots) == 1:
+        root = roots[0]
+    else:
+        root = {
+            "sid": "(root)", "trace_id": trace_id, "plane": "proc",
+            "kind": "trace", "parent": None,
+            "start": min(r["start"] for r in flat),
+            "end": max(r["end"] for r in flat),
+            "pid": None, "node_id": None, "torn": False,
+            "truncated": True, "payload": {}, "children": roots,
+        }
+        root["dur"] = root["end"] - root["start"]
+    return {"trace_id": trace_id, "root": root, "spans": flat,
+            "torn": torn}
+
+
+def _critical_segments(node: Dict[str, Any], lo: float, hi: float,
+                       segs: List[Dict[str, Any]], depth: int = 0) -> None:
+    """Append segments attributing (lo, hi] along the critical path, in
+    reverse time order: walk backward from `hi`, descend into the child
+    that ends latest before the cursor, and charge gaps between
+    children to the node itself."""
+    if depth > 64 or hi - lo <= 0:
+        return
+    cursor = hi
+    kids = [c for c in node.get("children", [])
+            if c.get("start") is not None and c.get("end") is not None
+            and c["end"] > lo and c["start"] < hi]
+    while cursor - lo > 1e-9:
+        best = None
+        for c in kids:
+            if c["start"] >= cursor:
                 continue
-            evs.append(dict(e, pid=driver_pid, source="live",
-                            node_id="driver", ts_adj=e["ts"]))
-    evs = [e for e in evs
-           if (plane is None or e.get("plane") == plane)
-           and (kind is None or e.get("kind") == kind)
-           and (trace_id is None or e.get("trace_id") == trace_id)]
-    evs.sort(key=lambda e: e.get("ts_adj", e["ts"]))
-    return evs
+            if best is None or min(c["end"], cursor) > \
+                    min(best["end"], cursor):
+                best = c
+        if best is None:
+            segs.append({"sid": node["sid"], "plane": node.get("plane"),
+                         "kind": node["kind"], "start": lo, "end": cursor,
+                         "torn": bool(node.get("torn"))})
+            return
+        ce = min(best["end"], cursor)
+        if cursor - ce > 1e-9:
+            segs.append({"sid": node["sid"], "plane": node.get("plane"),
+                         "kind": node["kind"], "start": ce, "end": cursor,
+                         "torn": bool(node.get("torn"))})
+        cs = max(best["start"], lo)
+        _critical_segments(best, cs, ce, segs, depth + 1)
+        cursor = cs
+        kids = [c for c in kids if c is not best and c["start"] < cursor]
+
+
+def critical_path(trace_id: str, address: Optional[str] = None, *,
+                  since: float = 0.0) -> Dict[str, Any]:
+    """The sequence of spans that bound this trace's wall clock: at any
+    instant, the deepest span covering it on the latest-ending-child
+    walk.  Shrinking any segment on the path shrinks the trace."""
+    tree = spans(trace_id, address, since=since)
+    root = tree["root"]
+    if root is None:
+        return {"trace_id": trace_id, "wall": 0.0, "segments": [],
+                "by_kind": {}, "torn": 0}
+    segs: List[Dict[str, Any]] = []
+    _critical_segments(root, root["start"], root["end"], segs)
+    segs.reverse()
+    by_kind: Dict[str, float] = {}
+    for s in segs:
+        k = f'{s["plane"]}:{s["kind"]}'
+        by_kind[k] = by_kind.get(k, 0.0) + (s["end"] - s["start"])
+    by_kind = dict(sorted(by_kind.items(), key=lambda kv: -kv[1]))
+    return {"trace_id": trace_id, "wall": root["end"] - root["start"],
+            "segments": segs, "by_kind": by_kind, "torn": tree["torn"]}
+
+
+def _pctile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(q * len(sorted_vals) + 0.5) - 1))
+    return sorted_vals[idx]
+
+
+def build_breakdown(evs: List[Dict[str, Any]], *,
+                    plane: Optional[str] = None,
+                    trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """Aggregate per-(plane, kind) span durations from a merged stream:
+    count / p50 / p95 / p99 / total seconds and fraction of the
+    observed wall clock.  Root `trace` scopes are excluded (they span
+    the whole window and would attribute everything twice)."""
+    table, _ = build_spans(evs, trace_id)
+    lo = hi = None
+    groups: Dict[tuple, List[float]] = {}
+    for rec in table.values():
+        if rec["start"] is None or rec["end"] is None:
+            continue
+        if lo is None or rec["start"] < lo:
+            lo = rec["start"]
+        if hi is None or rec["end"] > hi:
+            hi = rec["end"]
+        if rec["kind"] == "trace":
+            continue
+        if plane is not None and rec["plane"] != plane:
+            continue
+        groups.setdefault((rec["plane"], rec["kind"]), []).append(
+            rec["dur"] if rec["dur"] is not None
+            else rec["end"] - rec["start"])
+    wall = (hi - lo) if lo is not None else 0.0
+    phases = []
+    for (pl, kd), durs in groups.items():
+        durs.sort()
+        total = sum(durs)
+        phases.append({
+            "plane": pl, "kind": kd, "count": len(durs),
+            "p50": _pctile(durs, 0.5), "p95": _pctile(durs, 0.95),
+            "p99": _pctile(durs, 0.99), "max": durs[-1],
+            "total": total,
+            "fraction": (total / wall) if wall > 0 else 0.0,
+        })
+    phases.sort(key=lambda r: -r["total"])
+    return {"wall": wall, "window": (lo, hi), "phases": phases}
+
+
+def latency_breakdown(address: Optional[str] = None, *,
+                      plane: Optional[str] = None,
+                      trace_id: Optional[str] = None,
+                      since: float = 0.0) -> Dict[str, Any]:
+    """Cluster-wide per-phase latency attribution: every span kind's
+    p50/p95/p99/total and fraction of wall clock, ranked.  `plane`
+    narrows to one plane; `trace_id` narrows to one trace."""
+    evs = events(address, since=since)
+    return build_breakdown(evs, plane=plane, trace_id=trace_id)
 
 
 def timeline(address: Optional[str] = None,

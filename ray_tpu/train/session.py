@@ -85,6 +85,9 @@ class _TrainSession:
         self._preempt_hook: Optional[Callable[[float], Any]] = None
         # Interruptible chaos stall (hang injection for the watchdog).
         self._stall_abort = threading.Event()
+        # Open train/step span between report() boundaries (always on:
+        # step cadence is orders of magnitude below the ring's budget).
+        self._step_span = None
 
         def run():
             global _session
@@ -96,6 +99,9 @@ class _TrainSession:
             except BaseException as e:  # noqa: BLE001
                 self.error = e
             finally:
+                from ray_tpu.util import spans
+                spans.end(self._step_span, final=True)
+                self._step_span = None
                 # Sentinel BEFORE the finished flag: a concurrent get_next
                 # must never see finished+empty while an error is pending.
                 try:
@@ -129,9 +135,15 @@ class _TrainSession:
         prev_t = self._beacon_t
         self._beacon_step += 1
         self._beacon_t = time.monotonic()
-        from ray_tpu.util import events
+        from ray_tpu.util import events, spans
         events.record("train", "beacon", step=self._beacon_step,
                       rank=self.context.world_rank)
+        # Durational step span: one per inter-report gap (the span for
+        # step N opens at report N-1 and closes here).
+        spans.end(self._step_span)
+        self._step_span = spans.begin(
+            "train", "step", step=self._beacon_step + 1,
+            rank=self.context.world_rank)
         if self._beacon_step > 1:
             # Wall time between step boundaries — the worker-side
             # train_step_time_s SLO histogram (first report excluded: it

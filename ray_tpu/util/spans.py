@@ -1,0 +1,140 @@
+"""Durational spans layered on the flight recorder.
+
+PR 10 gave every plane an *instant* event ring (`events.record`); this
+module turns pairs of those events into **spans** — named intervals with
+parent links — without changing the ring's cost model: a span is exactly
+two ring slots (a ``ph="B"`` begin and a ``ph="E"`` end carrying the
+duration), appended through the same signal-safe fast path.  With
+``RAY_TPU_EVENTS=0`` the whole module collapses to one global read per
+``begin()`` and ``end()`` returns immediately on the ``None`` token.
+
+Wire format (what ``state.spans()`` reconstructs from):
+
+  B:  (ts, plane, kind, (trace_id, sid), {"ph": "B", "parent": psid, ...})
+  E:  (ts, plane, kind, (trace_id, sid), {"ph": "E", "dur": seconds, ...})
+
+``sid`` is cluster-unique (a per-process random prefix plus a local
+counter), so begin/end pair by span id alone even after crash dumps from
+several processes are merged into one stream.  ``trace_id`` may be None:
+such spans never join a trace tree but still feed
+``state.latency_breakdown()`` aggregates.
+
+Pairing is structural, not by name: ``end()`` takes the token ``begin()``
+returned, so a begin can never be closed with a mismatched kind, and a
+token can cross threads or asyncio callbacks (scheduler-queue and
+dispatch spans ride on the pending-task object between the submitting
+thread and the io loop).
+
+Usage:
+    tok = spans.begin("sched", "lease_wait", key=key)   # may return None
+    ...
+    spans.end(tok, granted=True)
+
+    with spans.span("ingest", "h2d"):        # context form; nested spans
+        device_put(batch)                    # become children via tracing
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import secrets
+import time
+from typing import Any, Optional, Tuple
+
+from ray_tpu.util import events, tracing
+
+# Cluster-unique span ids: 3 random bytes of per-process prefix + local
+# counter.  Distinct from tracing's token_hex(4) task span ids on
+# purpose — a prefix collision between two processes would need ~2^12
+# concurrent processes (birthday bound on 2^24).
+_PREFIX = secrets.token_hex(3)
+_SEQ = itertools.count()
+
+
+class Span:
+    """Token returned by :func:`begin`; pass it to :func:`end`."""
+
+    __slots__ = ("plane", "kind", "trace_id", "sid", "t0")
+
+    def __init__(self, plane: str, kind: str, trace_id: Optional[str],
+                 sid: str, t0: float):
+        self.plane = plane
+        self.kind = kind
+        self.trace_id = trace_id
+        self.sid = sid
+        self.t0 = t0
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Span({self.plane}:{self.kind} sid={self.sid})"
+
+
+def _new_sid() -> str:
+    return f"{_PREFIX}{next(_SEQ):x}"
+
+
+def begin(plane: str, kind: str,
+          ctx: Optional[Tuple[Optional[str], Optional[str]]] = None,
+          sid: Optional[str] = None, parent: Optional[str] = None,
+          **payload: Any) -> Optional[Span]:
+    """Open a span.  Returns None when the recorder is off (the disabled
+    fast path is one global read, same as ``events.record``).
+
+    ``ctx`` is an explicit (trace_id, parent_span_id) — e.g. a task
+    spec's carried ``trace_ctx`` — and defaults to the calling context's
+    active trace.  ``sid`` pins the span id (used when another layer,
+    like ``tracing.enter_task``, already minted the id that children
+    will reference as their parent)."""
+    r = events._recorder
+    if r is None:
+        if events._initialized:
+            return None
+        r = events._init()
+        if r is None:
+            return None
+    if ctx is None:
+        ctx = tracing.current_context()
+    trace_id = ctx[0] if ctx else None
+    if parent is None and ctx is not None:
+        parent = ctx[1]
+    s = sid or _new_sid()
+    p: dict = {"ph": "B"}
+    if parent is not None:
+        p["parent"] = parent
+    if payload:
+        p.update(payload)
+    r.append(plane, kind, p, (trace_id, s))
+    return Span(plane, kind, trace_id, s, time.time())
+
+
+def end(tok: Optional[Span], **payload: Any) -> None:
+    """Close a span.  No-op on a None token (recorder was off at begin)
+    or when the recorder has been reset since."""
+    if tok is None:
+        return
+    r = events._recorder
+    if r is None:
+        return
+    p: dict = {"ph": "E", "dur": time.time() - tok.t0}
+    if payload:
+        p.update(payload)
+    r.append(tok.plane, tok.kind, p, (tok.trace_id, tok.sid))
+
+
+@contextlib.contextmanager
+def span(plane: str, kind: str,
+         ctx: Optional[Tuple[Optional[str], Optional[str]]] = None,
+         **payload: Any):
+    """Context-manager form.  While open, the span becomes the active
+    trace context (when it belongs to a trace), so nested spans and any
+    tasks submitted inside attach to it as children."""
+    tok = begin(plane, kind, ctx=ctx, **payload)
+    cv = None
+    if tok is not None and tok.trace_id is not None:
+        cv = tracing._ctx.set((tok.trace_id, tok.sid))
+    try:
+        yield tok
+    finally:
+        if cv is not None:
+            tracing._ctx.reset(cv)
+        end(tok)

@@ -1,0 +1,160 @@
+"""Pull-based telemetry endpoints: /metrics, /events, /healthz.
+
+A stdlib ``http.server`` thread that external scrapers (Prometheus, a
+dashboard, plain ``curl``) hit without going through the CLI or the RPC
+plane.  Both hostd and the driver run one:
+
+  * ``/metrics``  — Prometheus exposition text (``util.metrics``
+    ``prometheus_text``; on hostd this is the node-level merge of the
+    daemon's registry plus every live worker's).
+  * ``/events``   — the flight-recorder ring as JSON, filterable with
+    ``?plane=&kind=&trace_id=&since=&limit=`` (on hostd: the node-level
+    CollectEvents merge, crash dumps included).
+  * ``/healthz``  — liveness + identity, for load balancers and the
+    impatient.
+
+The server rides the flight-recorder switch: with ``RAY_TPU_EVENTS=0``
+``start_server`` returns None and nothing is bound.  Ports default to
+ephemeral (several hostds share a laptop in tests); the bound port is
+announced as a ``proc``/``telemetry_listen`` ring event, so
+``state.events(kind="telemetry_listen")`` discovers every endpoint in
+the cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+# metrics_fn() -> prometheus exposition text
+# events_fn(plane, kind, trace_id, since) -> list of event dicts
+MetricsFn = Callable[[], str]
+EventsFn = Callable[[Optional[str], Optional[str], Optional[str], float],
+                    List[Dict[str, Any]]]
+
+
+class TelemetryServer:
+    """One daemon thread serving the three endpoints.  All handler work
+    runs on short-lived per-request threads (ThreadingHTTPServer), so a
+    slow scrape never blocks the process's event loop."""
+
+    def __init__(self, *, metrics_fn: MetricsFn, events_fn: EventsFn,
+                 component: str = "", host: str = "127.0.0.1",
+                 port: int = 0,
+                 healthz_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+        self.metrics_fn = metrics_fn
+        self.events_fn = events_fn
+        self.healthz_fn = healthz_fn
+        self.component = component
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr chatter per scrape
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    url = urlparse(self.path)
+                    if url.path == "/metrics":
+                        body = outer.metrics_fn().encode()
+                        self._send(200, body, "text/plain; version=0.0.4")
+                    elif url.path == "/events":
+                        q = parse_qs(url.query)
+
+                        def one(name):
+                            v = q.get(name)
+                            return v[0] if v else None
+
+                        since = float(one("since") or 0.0)
+                        evs = outer.events_fn(one("plane"), one("kind"),
+                                              one("trace_id"), since)
+                        limit = one("limit")
+                        if limit:
+                            evs = evs[-int(limit):]
+                        body = json.dumps(
+                            {"events": evs, "count": len(evs)},
+                            default=repr).encode()
+                        self._send(200, body, "application/json")
+                    elif url.path == "/healthz":
+                        import os
+                        import time
+                        h = {"ok": True, "component": outer.component,
+                             "pid": os.getpid(), "ts": time.time()}
+                        if outer.healthz_fn is not None:
+                            h.update(outer.healthz_fn())
+                        self._send(200, json.dumps(h, default=repr).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # scrape bugs must not kill threads
+                    try:
+                        self._send(500, f"{e!r}\n".encode(), "text/plain")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TelemetryServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.5},
+            daemon=True, name="raytpu-telemetry")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def start_server(*, metrics_fn: MetricsFn, events_fn: EventsFn,
+                 component: str,
+                 healthz_fn: Optional[Callable[[], Dict[str, Any]]] = None
+                 ) -> Optional[TelemetryServer]:
+    """Bind + start the endpoints per config, or return None when the
+    flight recorder is off (``RAY_TPU_EVENTS=0`` disables telemetry
+    cleanly), telemetry_port is -1, or the bind fails."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.util import events
+    if not GLOBAL_CONFIG.events:
+        return None
+    port = GLOBAL_CONFIG.telemetry_port
+    if port < 0:
+        return None
+    try:
+        srv = TelemetryServer(metrics_fn=metrics_fn, events_fn=events_fn,
+                              component=component,
+                              host=GLOBAL_CONFIG.telemetry_host, port=port,
+                              healthz_fn=healthz_fn).start()
+    except OSError as e:
+        logger.warning("telemetry endpoints disabled: bind failed: %s", e)
+        return None
+    events.record("proc", "telemetry_listen", component=component,
+                  host=srv.host, port=srv.port)
+    logger.info("telemetry endpoints on http://%s:%d (%s)",
+                srv.host, srv.port, component)
+    return srv

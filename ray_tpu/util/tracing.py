@@ -44,17 +44,25 @@ def current_context() -> Optional[Tuple[str, str]]:
 @contextlib.contextmanager
 def trace(name: str = "trace"):
     """Open (or continue) a trace in this context; tasks submitted inside
-    join it as child spans."""
+    join it as child spans.  The scope itself is recorded as the trace's
+    root span (a B/E pair on the flight recorder), so `state.spans()`
+    reconstructs a rooted tree with the user's name on top."""
     parent = _ctx.get()
     if parent is None:
         trace_id = secrets.token_hex(8)
     else:
         trace_id = parent[0]
-    token = _ctx.set((trace_id, secrets.token_hex(4)))
+    sid = secrets.token_hex(4)
+    token = _ctx.set((trace_id, sid))
+    from ray_tpu.util import spans  # late: spans imports this module
+    tok = spans.begin("proc", "trace",
+                      ctx=(trace_id, parent[1] if parent else None),
+                      sid=sid, name=name)
     try:
         yield trace_id
     finally:
         _ctx.reset(token)
+        spans.end(tok)
 
 
 def enter_task(spec) -> Optional[Tuple[str, str, str]]:

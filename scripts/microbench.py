@@ -322,6 +322,43 @@ def bench_observability(results: dict):
 
     timeit("metrics_observe", metrics_observe, 200_000, results)
 
+    # One durational span = one begin + one end = two ring slots.  The
+    # budget is the same as two record() calls — a span edge must not
+    # cost more than the instant events it replaces.
+    from ray_tpu.util import spans
+
+    def span_begin_end(n):
+        begin, end = spans.begin, spans.end
+        for i in range(n):
+            end(begin("engine", "bench_span", i=i))
+
+    timeit("span_begin_end", span_begin_end, 100_000, results)
+    events.reset()
+
+    # Reconstruction throughput: each op pairs/links a 1k-span chain
+    # through the same build_spans path state.spans() uses, so the
+    # reported rate is trees/s over a ring-sized stream.
+    from ray_tpu import state as _state
+    _evs = []
+    for i in range(1000):
+        sid, parent = f"{i:06x}", (f"{i - 1:06x}" if i else None)
+        _evs.append({"ts": float(i), "ts_adj": float(i),
+                     "plane": "engine", "kind": "bench_span",
+                     "trace_id": "t1", "span_id": sid, "pid": 1,
+                     "seq": 2 * i, "node_id": "n1", "source": "live",
+                     "payload": {"ph": "B", "parent": parent}})
+        _evs.append({"ts": i + 0.5, "ts_adj": i + 0.5, "plane": "engine",
+                     "kind": "bench_span", "trace_id": "t1",
+                     "span_id": sid, "pid": 1, "seq": 2 * i + 1,
+                     "node_id": "n1", "source": "live",
+                     "payload": {"ph": "E", "dur": 0.5}})
+
+    def span_reconstruct(n):
+        for _ in range(n):
+            _state.build_spans(_evs, "t1")
+
+    timeit("span_reconstruct_1k", span_reconstruct, 30, results)
+
 
 def main():
     ray_tpu.init(num_cpus=8, object_store_memory=256 << 20)

@@ -151,7 +151,8 @@ def test_crash_dump_roundtrip(tmp_path, monkeypatch):
     # The dump itself is recorded, so forensics show the dump reason too.
     assert {"admit", "submit", "crash_dump"} <= kinds
     traced = [e for e in out if e["trace_id"] == tid]
-    assert {e["kind"] for e in traced} == {"admit", "submit"}
+    # The trace ctxmanager contributes its own root span edges (PR 11).
+    assert {e["kind"] for e in traced} == {"admit", "submit", "trace"}
 
 
 def test_read_dumps_skips_corrupt_files(tmp_path, monkeypatch):

@@ -1,0 +1,90 @@
+"""Static plane hygiene (PR 11 satellite): every literal call site of
+``events.record(...)`` / ``spans.begin(...)`` / ``spans.span(...)`` in
+the package uses a plane string from ``events.PLANES`` and a sane kind,
+and every file that opens spans imperatively also closes them.  Greps
+source so a typo'd plane ("sched " / "schedule") fails CI instead of
+silently fragmenting the `cli top` per-plane rates.
+"""
+
+import pathlib
+import re
+
+from ray_tpu.util import events
+
+PKG = pathlib.Path(events.__file__).resolve().parents[1]
+
+# events.record("plane", "kind", ... / spans.begin("plane", "kind", ...
+# Payloads stay on later lines; plane+kind may wrap one line break.
+_CALL = re.compile(
+    r"(?:events\.record|spans\.begin|spans\.span)\(\s*\n?\s*"
+    r"(['\"])([^'\"]*)\1\s*,\s*\n?\s*(['\"])([^'\"]*)\3",
+    re.MULTILINE)
+
+_KIND_OK = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _call_sites():
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        for m in _CALL.finditer(text):
+            line = text[:m.start()].count("\n") + 1
+            yield path.relative_to(PKG.parent), line, m.group(2), \
+                m.group(4)
+
+
+def test_call_sites_exist():
+    sites = list(_call_sites())
+    # The suite is vacuous if the grep regex rots; PR 11 alone
+    # instruments dozens of sites.
+    assert len(sites) > 30, f"grep found only {len(sites)} sites"
+
+
+def test_planes_are_registered():
+    bad = [(str(f), ln, pl, k) for f, ln, pl, k in _call_sites()
+           if pl not in events.PLANES]
+    assert not bad, f"unregistered plane strings: {bad}"
+
+
+def test_kinds_are_snake_case():
+    bad = [(str(f), ln, pl, k) for f, ln, pl, k in _call_sites()
+           if not _KIND_OK.match(k)]
+    assert not bad, f"malformed span/event kinds: {bad}"
+
+
+def test_imperative_begins_have_ends():
+    """A file using spans.begin() must also call spans.end() — the token
+    API is imperative, so a file-local end is the only way a begin can
+    ever close (the context form needs no end)."""
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        if "spans.begin(" in text and "spans.end(" not in text \
+                and path.name != "spans.py":
+            offenders.append(str(path.relative_to(PKG.parent)))
+    assert not offenders, \
+        f"files that begin spans but never end any: {offenders}"
+
+
+def test_span_kinds_do_not_collide_with_instant_kinds():
+    """One (plane, kind) must be either always-instant or always-span:
+    build_breakdown keys phases by (plane, kind), so a mixed kind would
+    split its statistics.  Known exceptions: none."""
+    span_kinds, instant_kinds = set(), set()
+    spans_call = re.compile(
+        r"(spans\.begin|spans\.span|events\.record)\(\s*\n?\s*"
+        r"(['\"])([^'\"]*)\2\s*,\s*\n?\s*(['\"])([^'\"]*)\4",
+        re.MULTILINE)
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in ("spans.py", "events.py"):
+            continue
+        for m in spans_call.finditer(path.read_text()):
+            key = (m.group(3), m.group(5))
+            if m.group(1) == "events.record":
+                instant_kinds.add(key)
+            else:
+                span_kinds.add(key)
+    mixed = span_kinds & instant_kinds
+    # serve/admit intentionally exists in both forms: the instant event
+    # is the always-on SLO sample, the span only appears under a trace.
+    mixed -= {("serve", "admit")}
+    assert not mixed, f"(plane, kind) used as both span and instant: {mixed}"

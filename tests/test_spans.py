@@ -1,0 +1,426 @@
+"""Span tracing + critical-path attribution suite (PR 11).
+
+Covers the ISSUE checklist: begin/end wire format over the event ring,
+cross-process span-tree reconstruction tolerant of out-of-order arrival,
+torn spans terminated at crash-dump time, ring-overflow truncation,
+skew-normalized `since` filtering (the ts_adj regression), the
+critical-path walk, and the `cli trace` / `cli analyze` renderings —
+including the chaos acceptance run where a killed serve replica's crash
+dump is stitched into one trace with its replacement.
+"""
+
+import io
+import re
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.util import events, spans, tracing
+from ray_tpu.util.events import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    events.reset()
+    yield
+    events.reset()
+    GLOBAL_CONFIG.invalidate_cache()
+
+
+def _local_stream():
+    """This process's ring as a merged-stream shaped list (ts_adj=ts)."""
+    return [dict(e, pid=1, node_id="n1", source="live", ts_adj=e["ts"])
+            for e in events.snapshot()]
+
+
+# ---------------------------------------------------------------------------
+# Wire format + pairing
+# ---------------------------------------------------------------------------
+
+
+def test_begin_end_wire_format():
+    tok = spans.begin("sched", "submit", ctx=("t1", None), name="f")
+    time.sleep(0.01)
+    spans.end(tok, status=0)
+    snap = events.snapshot(kind="submit")
+    assert len(snap) == 2
+    b, e = snap
+    assert b["payload"]["ph"] == "B" and b["payload"]["name"] == "f"
+    assert e["payload"]["ph"] == "E" and e["payload"]["status"] == 0
+    assert b["span_id"] == e["span_id"] and b["trace_id"] == "t1"
+    assert e["payload"]["dur"] >= 0.01
+
+
+def test_end_none_token_is_noop():
+    spans.end(None)  # recorder off at begin time: must not raise
+    assert events.snapshot() == []
+
+
+def test_disabled_collapses_to_none(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_EVENTS", "0")
+    GLOBAL_CONFIG.invalidate_cache()
+    events.reset()
+    assert spans.begin("sched", "submit") is None
+    with spans.span("ingest", "h2d") as tok:
+        assert tok is None
+    assert events.snapshot() == []
+
+
+def test_span_context_manager_nests():
+    with tracing.trace("nest") as tid:
+        with spans.span("train", "step", step=1):
+            with spans.span("ingest", "h2d"):
+                pass
+    table, roots = state.build_spans(_local_stream(), tid)
+    by_kind = {r["kind"]: r for r in table.values()}
+    assert by_kind["h2d"]["parent"] == by_kind["step"]["sid"]
+    assert by_kind["step"]["parent"] == by_kind["trace"]["sid"]
+    assert len(roots) == 1 and roots[0]["kind"] == "trace"
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction edge cases (synthetic multi-process streams)
+# ---------------------------------------------------------------------------
+
+
+def _ev(ts, plane, kind, tid, sid, payload, pid=1, source="live"):
+    return {"ts": ts, "ts_adj": ts, "plane": plane, "kind": kind,
+            "trace_id": tid, "span_id": sid, "payload": payload,
+            "pid": pid, "node_id": f"n{pid}", "source": source,
+            "seq": int(ts * 1e6) % (1 << 30)}
+
+
+def test_out_of_order_begin_end_across_processes():
+    """E before B, child before parent, interleaved pids: fields fill in
+    regardless of arrival order."""
+    evs = [
+        _ev(10.5, "sched", "exec", "t", "w1", {"ph": "E", "dur": 0.4},
+            pid=2),
+        _ev(10.0, "proc", "trace", "t", "root", {"ph": "B"}, pid=1),
+        _ev(10.1, "sched", "exec", "t", "w1",
+            {"ph": "B", "parent": "root"}, pid=2),
+        _ev(11.0, "proc", "trace", "t", "root", {"ph": "E", "dur": 1.0},
+            pid=1),
+    ]
+    for perm in (evs, evs[::-1], [evs[2], evs[0], evs[3], evs[1]]):
+        table, roots = state.build_spans(perm, "t")
+        assert len(roots) == 1 and roots[0]["sid"] == "root"
+        w = table["w1"]
+        assert w["start"] == pytest.approx(10.1)
+        assert w["end"] == pytest.approx(10.5)
+        assert not w["torn"] and not w["truncated"]
+        assert roots[0]["children"] == [w]
+
+
+def test_missing_end_terminates_at_crash_time():
+    """A span whose process crash-dumped ends at the dump's timestamp,
+    not at the observation horizon, and is marked torn."""
+    evs = [
+        _ev(10.0, "proc", "trace", "t", "root", {"ph": "B"}, pid=1),
+        _ev(10.2, "engine", "decode", "t", "d1",
+            {"ph": "B", "parent": "root"}, pid=9, source="crash"),
+        _ev(10.6, "proc", "crash_dump", "t", None, {}, pid=9,
+            source="crash"),
+        _ev(20.0, "proc", "trace", "t", "root", {"ph": "E", "dur": 10.0},
+            pid=1),
+    ]
+    table, _ = state.build_spans(evs, "t")
+    d = table["d1"]
+    assert d["torn"]
+    assert d["end"] == pytest.approx(10.6)      # crash time, not 20.0
+    assert d["dur"] == pytest.approx(0.4)
+
+
+def test_missing_end_without_dump_uses_horizon():
+    evs = [
+        _ev(10.0, "sched", "task", "t", "s1", {"ph": "B"}, pid=1),
+        _ev(12.5, "proc", "tick", None, None, {}, pid=1),
+    ]
+    table, _ = state.build_spans(evs, "t")
+    assert table["s1"]["torn"]
+    assert table["s1"]["end"] == pytest.approx(12.5)
+
+
+def test_ring_overflow_truncates_span():
+    """Overflow evicts the B slot: the span is marked truncated and its
+    start is back-dated from the end event's carried duration."""
+    events.reset()
+    events._recorder = FlightRecorder(capacity=16)
+    events._initialized = True
+    tok = spans.begin("sched", "task", ctx=("t", None), name="victim")
+    time.sleep(0.02)
+    for i in range(40):          # flood: the B slot is long gone
+        events.record("proc", "tick", i=i)
+    spans.end(tok)
+    table, roots = state.build_spans(_local_stream(), "t")
+    rec = table[tok.sid]
+    assert rec["truncated"] and not rec["torn"]
+    assert rec["start"] == pytest.approx(rec["end"] - rec["dur"])
+    assert rec["dur"] >= 0.02
+    assert rec in roots          # orphaned: parentless after overflow
+
+
+# ---------------------------------------------------------------------------
+# ts_adj merge + since regression (two skewed "processes")
+# ---------------------------------------------------------------------------
+
+
+def test_since_applies_to_skew_adjusted_time():
+    """A node whose clock runs 100s behind must not leak stale events
+    past `since`, and one running ahead must not hide fresh ones.  The
+    regression: filtering on raw remote ts did both."""
+    now = 1000.0
+    # Node A's clock is 100s BEHIND: its events carry ts-100.
+    reply_a = {"now": now - 100.0, "events": [
+        {"ts": now - 100.0 - 5.0, "plane": "sched", "kind": "old",
+         "trace_id": None, "span_id": None, "payload": {}, "pid": 11,
+         "seq": 1, "source": "live"},       # really 5s old
+        {"ts": now - 100.0 - 0.5, "plane": "sched", "kind": "fresh_a",
+         "trace_id": None, "span_id": None, "payload": {}, "pid": 11,
+         "seq": 2, "source": "live"},       # really 0.5s old
+    ]}
+    # Node B's clock is 100s AHEAD.
+    reply_b = {"now": now + 100.0, "events": [
+        {"ts": now + 100.0 - 0.2, "plane": "sched", "kind": "fresh_b",
+         "trace_id": None, "span_id": None, "payload": {}, "pid": 22,
+         "seq": 1, "source": "live"},       # really 0.2s old
+    ]}
+    sa = state._normalize_events_reply(reply_a, "aaaa", now, now)
+    sb = state._normalize_events_reply(reply_b, "bbbb", now, now)
+    merged = state._merge_event_streams([sa, sb], plane=None, kind=None,
+                                        trace_id=None, since=now - 1.0)
+    kinds = [e["kind"] for e in merged]
+    assert kinds == ["fresh_a", "fresh_b"]   # skew-corrected order
+    for e in merged:
+        assert e["ts_adj"] >= now - 1.0
+    # The adjusted clocks agree to within the RPC round trip (0 here).
+    assert merged[0]["ts_adj"] == pytest.approx(now - 0.5)
+    assert merged[1]["ts_adj"] == pytest.approx(now - 0.2)
+
+
+def test_merge_dedups_crash_vs_live_copy():
+    """The same (pid, seq) arriving from a live ring and a crash dump
+    collapses to one event, preferring the live copy."""
+    base = {"ts": 5.0, "ts_adj": 5.0, "plane": "sched", "kind": "k",
+            "trace_id": None, "span_id": None, "payload": {}, "pid": 7,
+            "seq": 3}
+    live = dict(base, source="live")
+    crash = dict(base, source="crash")
+    merged = state._merge_event_streams(
+        [[crash], [live]], plane=None, kind=None, trace_id=None,
+        since=0.0)
+    assert len(merged) == 1 and merged[0]["source"] == "live"
+
+
+# ---------------------------------------------------------------------------
+# Critical path + breakdown on a live single-node cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mini_cluster():
+    info = ray_tpu.init(num_cpus=2, object_store_memory=64 << 20)
+    try:
+        yield info
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.invalidate_cache()
+
+
+def test_task_trace_critical_path(mini_cluster):
+    @ray_tpu.remote
+    def f(x):
+        time.sleep(0.05)
+        return x + 1
+
+    ray_tpu.get(f.remote(0))          # warm the lease pool
+    with tracing.trace("cp") as tid:
+        ray_tpu.get([f.remote(i) for i in range(3)])
+    time.sleep(0.3)
+    tree = state.spans(tid)
+    kinds = {(s["plane"], s["kind"]) for s in tree["spans"]}
+    assert ("sched", "submit") in kinds
+    assert ("sched", "exec") in kinds
+    assert tree["root"]["kind"] == "trace"
+    cp = state.critical_path(tid)
+    assert cp["wall"] > 0.05
+    # The path must tile the whole wall clock, in order, gap-free.
+    segs = cp["segments"]
+    assert segs and segs[0]["start"] == pytest.approx(
+        tree["root"]["start"], abs=1e-6)
+    assert segs[-1]["end"] == pytest.approx(tree["root"]["end"], abs=1e-6)
+    for a, b in zip(segs, segs[1:]):
+        assert b["start"] == pytest.approx(a["end"], abs=1e-6)
+    covered = sum(v for v in cp["by_kind"].values())
+    assert covered == pytest.approx(cp["wall"], rel=1e-6)
+    # A sleep-bound workload is execution-dominated.  The driver-side
+    # dispatch span covers the full push->exec->reply round trip (the
+    # worker's task span is its *sibling*: trace_ctx is serialized into
+    # the push payload at submit time, so the task parents on the trace
+    # root), so the backward walk may charge the window to either kind.
+    top = max(cp["by_kind"], key=cp["by_kind"].get)
+    assert top in ("sched:exec", "sched:dispatch")
+    # The per-phase breakdown sees the worker-side span directly and must
+    # rank exec as the dominant phase regardless.
+    bd = state.latency_breakdown(trace_id=tid)
+    execs = [p for p in bd["phases"] if p["kind"] == "exec"]
+    assert execs and execs[0]["p50"] >= 0.04
+
+
+def test_latency_breakdown_fractions(mini_cluster):
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.02)
+
+    with tracing.trace("bd"):
+        ray_tpu.get([f.remote() for _ in range(3)])
+    time.sleep(0.3)
+    bd = state.latency_breakdown()
+    phases = {f'{p["plane"]}/{p["kind"]}': p for p in bd["phases"]}
+    assert "sched/exec" in phases
+    p = phases["sched/exec"]
+    assert p["count"] >= 3 and p["p50"] >= 0.02
+    assert 0.0 < p["fraction"] <= 1.0 + 1e-9
+    assert bd["wall"] > 0.0
+    # Root trace scopes are excluded from attribution.
+    assert "proc/trace" not in phases
+
+
+def test_untraced_tasks_emit_no_lifecycle_spans(mini_cluster):
+    """The hot path stays span-free without an explicit trace: one None
+    check per site, no B/E ring traffic."""
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(5)])
+    time.sleep(0.2)
+    evs = state.events()
+    lifecycle = [e for e in evs
+                 if e["kind"] in ("submit", "sched_queue", "dispatch",
+                                  "task", "exec", "arg_fetch",
+                                  "result_seal")
+                 and isinstance(e.get("payload"), dict)
+                 and e["payload"].get("ph") in ("B", "E")
+                 and e.get("plane") == "sched"]
+    assert lifecycle == []
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: killed replica's crash dump stitched into the trace
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serve_chaos_cluster(request):
+    from ray_tpu._private import fault_injection as fi
+    cfg = dict(getattr(request, "param", {}))
+    info = ray_tpu.init(num_cpus=4, object_store_memory=64 << 20,
+                        _system_config=cfg)
+    from ray_tpu import serve
+    serve.start()
+    try:
+        yield info
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        from ray_tpu.serve import _private as sp
+        with sp._router_states_lock:
+            sp._router_states.clear()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "serve_chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 31,
+      "chaos_kill_replica_salts": "*",
+      "chaos_kill_replica_at": 4,
+      "chaos_max_faults": 1}],
+    indirect=True)
+def test_chaos_kill_span_tree_stitches_torn_span(serve_chaos_cluster):
+    """ISSUE acceptance criterion: `state.critical_path` on a trace that
+    includes a chaos-killed serve replica reconstructs the full tree
+    across the killed process's crash dump and its replacement — one
+    trace id, the torn span marked — and `cli trace` renders it."""
+    from ray_tpu import serve
+    from ray_tpu.scripts import cli
+
+    handle = serve.run(serve.LLMDeployment.options(
+        name="llm_spans").bind(model="gpt", config="nano", max_lanes=4,
+                               seed=0))
+    with tracing.trace("chaos-spans") as tid:
+        got = list(handle.options("generate",
+                                  failover=serve.llm_stream_resume)
+                   .stream([1, 2, 3], 8))
+    assert len(got) == 8
+
+    deadline = time.time() + 20
+    tree = {"spans": [], "torn": 0}
+    while time.time() < deadline:
+        tree = state.spans(tid)
+        pids = {s["pid"] for s in tree["spans"] if s["pid"]}
+        if tree["torn"] >= 1 and len(pids) >= 2:
+            break
+        time.sleep(0.5)
+
+    # One trace id spans the killed incarnation AND its replacement.
+    pids = {s["pid"] for s in tree["spans"] if s["pid"]}
+    assert len(pids) >= 2, f"tree never crossed processes: {tree}"
+    torn = [s for s in tree["spans"] if s["torn"]]
+    assert torn, "the killed replica's open span was not marked torn"
+    # Torn spans were terminated (crash dump or horizon): end is set,
+    # so the tree is fully renderable.
+    for s in tree["spans"]:
+        assert s["end"] is not None and s["start"] is not None
+    assert tree["root"] is not None
+
+    cp = state.critical_path(tid)
+    assert cp["wall"] > 0 and cp["segments"]
+    assert cp["torn"] >= 1
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["trace", tid, "--address",
+                       serve_chaos_cluster["gcs_address"]])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "TORN" in out
+    assert "critical path:" in out
+    # Both engine-side and serve-side phases render in one tree.
+    assert re.search(r"engine/(prefill|decode)", out)
+    assert "serve/" in out
+
+
+# ---------------------------------------------------------------------------
+# cli analyze
+# ---------------------------------------------------------------------------
+
+
+def test_cli_analyze_renders_table(mini_cluster):
+    from ray_tpu.scripts import cli
+
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.02)
+
+    with tracing.trace("an"):
+        ray_tpu.get([f.remote() for _ in range(2)])
+    time.sleep(0.3)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["analyze", "--address",
+                       mini_cluster["gcs_address"]])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "latency breakdown" in out
+    assert "sched/exec" in out
+    assert "%wall" in out

@@ -1,0 +1,173 @@
+"""Pull-based telemetry endpoint suite (PR 11).
+
+`/metrics` serves parseable Prometheus exposition text, `/events`
+filtered JSON, `/healthz` identity — on both hostd and the driver, with
+ports discovered through the `proc/telemetry_listen` ring event.  With
+``RAY_TPU_EVENTS=0`` nothing binds.
+"""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.util import events, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    events.reset()
+    yield
+    events.reset()
+    GLOBAL_CONFIG.invalidate_cache()
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=2, object_store_memory=64 << 20)
+    try:
+        yield info
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.invalidate_cache()
+
+
+def _endpoints(deadline_s: float = 10.0):
+    """component -> (host, port) from the announce events."""
+    deadline = time.time() + deadline_s
+    found = {}
+    while time.time() < deadline:
+        for e in state.events(kind="telemetry_listen"):
+            p = e.get("payload") or {}
+            if "port" in p:
+                found[p.get("component")] = (p.get("host"), p["port"])
+        if {"hostd", "driver"} <= set(found):
+            return found
+        time.sleep(0.2)
+    return found
+
+
+def _get(host, port, path, timeout=5):
+    with urllib.request.urlopen(
+            f"http://{host}:{port}{path}", timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+# Prometheus exposition text: comment/blank lines, or `name{labels} value`.
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})?\s+'
+    r'([+-]?(\d+\.?\d*([eE][+-]?\d+)?|Inf|NaN))$')
+
+
+def _parse_prometheus(text: str):
+    """Minimal exposition-format check; returns (families, samples)."""
+    families, samples = set(), 0
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            families.add(line.split()[2])
+            continue
+        if line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+        samples += 1
+    return families, samples
+
+
+def test_metrics_endpoints_serve_prometheus_text(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    eps = _endpoints()
+    assert "hostd" in eps, f"hostd never announced an endpoint: {eps}"
+    assert "driver" in eps
+
+    status, ctype, body = _get(*eps["hostd"], "/metrics")
+    assert status == 200 and ctype.startswith("text/plain")
+    families, samples = _parse_prometheus(body.decode())
+    assert samples > 0 and families
+    assert 'component="hostd"' in body.decode()
+
+    status, _, body = _get(*eps["driver"], "/metrics")
+    assert status == 200
+    _parse_prometheus(body.decode())
+    assert 'component="driver"' in body.decode()
+
+
+def test_events_endpoint_filters_json(cluster):
+    events.record("serve", "admit", deployment="d1")
+    events.record("sched", "grant", n=1)
+    eps = _endpoints()
+    host, port = eps["driver"]
+
+    status, ctype, body = _get(host, port, "/events?plane=serve")
+    assert status == 200 and ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert doc["count"] == len(doc["events"]) > 0
+    assert all(e["plane"] == "serve" for e in doc["events"])
+
+    _, _, body = _get(host, port, "/events?plane=serve&kind=nope")
+    assert json.loads(body)["count"] == 0
+
+    _, _, body = _get(host, port, f"/events?since={time.time() + 60}")
+    assert json.loads(body)["count"] == 0
+
+    _, _, body = _get(host, port, "/events?limit=1")
+    assert json.loads(body)["count"] == 1
+
+    # hostd's endpoint serves the node-level merge (worker rings too).
+    status, _, body = _get(*eps["hostd"], "/events")
+    assert status == 200
+    assert json.loads(body)["count"] >= 0
+
+
+def test_healthz(cluster):
+    eps = _endpoints()
+    status, _, body = _get(*eps["hostd"], "/healthz")
+    assert status == 200
+    h = json.loads(body)
+    assert h["ok"] is True and h["component"] == "hostd"
+    assert "node_id" in h and "workers" in h
+
+    status, _, body = _get(*eps["driver"], "/healthz")
+    assert json.loads(body)["component"] == "driver"
+
+
+def test_unknown_path_404(cluster):
+    eps = _endpoints()
+    host, port = eps["driver"]
+    try:
+        _get(host, port, "/nope")
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 404
+    assert raised
+
+
+def test_disabled_when_events_off(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_EVENTS", "0")
+    GLOBAL_CONFIG.invalidate_cache()
+    events.reset()
+    srv = telemetry.start_server(metrics_fn=lambda: "",
+                                 events_fn=lambda *a: [],
+                                 component="test")
+    assert srv is None
+
+
+def test_disabled_when_port_negative(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TELEMETRY_PORT", "-1")
+    GLOBAL_CONFIG.invalidate_cache()
+    events.reset()
+    srv = telemetry.start_server(metrics_fn=lambda: "",
+                                 events_fn=lambda *a: [],
+                                 component="test")
+    assert srv is None
