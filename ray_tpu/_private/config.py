@@ -140,6 +140,14 @@ _D("serve_request_deadline_s", 0.0, float,
    "handle.options(timeout_s=...)")
 _D("serve_failover_attempts", 2, int,
    "max mid-stream failover resubmissions per streaming request")
+_D("spec_k", 4, int,
+   "default speculative draft length when an engine/deployment enables "
+   "speculative decoding: up to this many draft tokens ride each verify "
+   "step (the verify dispatch shape is spec_k+1)")
+_D("spec_adaptive", True, _bool,
+   "adapt each lane's draft length to its measured acceptance: grow on "
+   "full acceptance, back off on rejection, so incompressible streams "
+   "stop paying rejected verify FLOPs")
 # -- train fault tolerance -------------------------------------------------
 _D("train_hang_timeout_s", 60.0, float,
    "gang declared hung when NO worker makes observable progress (a "

@@ -6,8 +6,14 @@ attention (Pallas kernel in ops/attention.py, masked-dense fallback),
 and a continuous-batching scheduler: one jitted decode step over a
 fixed-capacity lane array, sequences admitted into free lanes as others
 finish, so decode throughput scales with concurrency instead of
-resetting per batch.  serve/llm.py exposes it as an LLMDeployment.
+resetting per batch.  Self-speculative decoding (speculative.py) lifts
+the one-token-per-step ceiling: n-gram / prompt-lookup drafts verified
+k+1-at-a-time by the same jitted step, token-exact by construction.
+serve/llm.py exposes it all as an LLMDeployment.
 """
 
 from ray_tpu.inference.kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
 from ray_tpu.inference.engine import InferenceEngine  # noqa: F401
+from ray_tpu.inference.speculative import (  # noqa: F401
+    DraftProposer, ModelDraftProposer, NgramProposer,
+    resolve_draft_proposer)

@@ -24,6 +24,17 @@ tokens produced), so sampled output is reproducible per request seed
 regardless of batch composition, and the per-step device->host transfer
 is one int32 per lane — never the [B, V] logits.
 
+Speculative decoding (``spec_k > 0``, speculative.py) lifts the
+one-token-per-step ceiling: a host-side draft proposer suggests up to k
+continuation tokens per decode lane from the request's own history, the
+step verifies all k+1 positions at once (the chunked-prefill dispatch
+shape, per-position in-graph sampling with the SAME fold_in(seed,
+produced+j) keys the plain step would use), the longest draft prefix
+matching the model's own sampled output commits as one atomic burst,
+and the rejected tail rolls back through paged-KV block truncation —
+token-exact vs the non-speculative engine by construction, for greedy
+and seeded sampling alike.
+
 The engine is host-driven: block allocation, admission and stream
 fan-out are Python; the model math (sampling included) is one jax.jit'ed
 call per dispatched population with pools donated on TPU (in-place
@@ -88,6 +99,20 @@ def _metrics() -> dict:
                 "inference_tbt_s",
                 "Time between tokens (per-decode emit gap)",
                 buckets=_LATENCY_BUCKETS),
+            "spec_drafted": Counter(
+                "inference_spec_drafted_tokens",
+                "Draft tokens proposed for speculative verification"),
+            "spec_accepted": Counter(
+                "inference_spec_accepted_tokens",
+                "Draft tokens accepted by the verify step"),
+            "spec_steps": Counter(
+                "inference_spec_steps",
+                "Speculative verify dispatches"),
+            "spec_per_step": Histogram(
+                "inference_spec_tokens_per_step",
+                "Tokens emitted per lane per speculative verify step "
+                "(plain decode would be exactly 1)",
+                buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)),
         }
     return _MET
 
@@ -122,6 +147,10 @@ class _Request:
     last_token: int = 0
     emitted: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
+    # Speculative state: the lane's current adaptive draft ceiling and
+    # the draft tokens riding the in-flight verify dispatch.
+    spec_k: int = 0
+    draft: tuple = ()
 
     @property
     def prefilling(self) -> bool:
@@ -135,6 +164,10 @@ class GenerationHandle:
     def __init__(self, req: _Request, engine: "InferenceEngine" = None):
         self._req = req
         self._engine = engine
+        # A speculative burst arrives as ONE queue item (a list): the
+        # commit is atomic — a consumer never observes a partially
+        # delivered draft burst — and iteration unwraps it here.
+        self._buf: collections.deque = collections.deque()
 
     def cancel(self) -> bool:
         """Abort the request: evict its engine lane (or dequeue it) and
@@ -148,9 +181,14 @@ class GenerationHandle:
         return self
 
     def __next__(self) -> int:
+        if self._buf:
+            return self._buf.popleft()
         item = self._req.out.get()
         if item is _DONE:
             raise StopIteration
+        if isinstance(item, list):
+            self._buf.extend(item)
+            return self._buf.popleft()
         return item
 
     def tokens(self, timeout: Optional[float] = None) -> List[int]:
@@ -162,7 +200,8 @@ class GenerationHandle:
         vanished consumer must not leave the engine generating for
         nobody) and TimeoutError is raised (never queue.Empty)."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        out: List[int] = []
+        out: List[int] = list(self._buf)
+        self._buf.clear()
         while True:
             if deadline is None:
                 item = self._req.out.get()
@@ -182,7 +221,10 @@ class GenerationHandle:
                         f"({len(out)} token(s) received)") from None
             if item is _DONE:
                 return out
-            out.append(item)
+            if isinstance(item, list):
+                out.extend(item)
+            else:
+                out.append(item)
 
     @property
     def finish_reason(self) -> Optional[str]:
@@ -210,6 +252,14 @@ class InferenceEngine:
     microbenchmarks).  `prefix_cache=False` disables content-addressed
     block reuse (every prompt prefills from token zero — the cold
     baseline bench_prefix.py measures against).
+
+    `spec_k > 0` enables speculative decoding: `draft_proposer`
+    (``"ngram"`` or a speculative.DraftProposer) suggests up to spec_k
+    continuation tokens per decode lane and one verify dispatch commits
+    the accepted prefix as a burst.  `spec_adaptive` backs each lane's
+    draft length off when its acceptance is low (and grows it back on
+    full acceptance) so incompressible streams stop paying rejected
+    verify FLOPs.
     """
 
     def __init__(self, model="gpt", config="nano", params=None, *,
@@ -217,7 +267,9 @@ class InferenceEngine:
                  num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: int = 32, seed: int = 0,
-                 prefix_cache: bool = True, auto_start: bool = True):
+                 prefix_cache: bool = True, auto_start: bool = True,
+                 spec_k: int = 0, draft_proposer="ngram",
+                 spec_adaptive: bool = True):
         self.model = _resolve_model(model)
         self.config = (self.model.CONFIGS[config] if isinstance(config, str)
                        else config)
@@ -236,6 +288,15 @@ class InferenceEngine:
             self.model, self.config, num_blocks=num_blocks,
             block_size=block_size, max_lanes=max_lanes,
             max_seq_len=max_seq_len, prefix_cache=prefix_cache)
+        self.spec_k = int(spec_k)
+        self._spec_adaptive = bool(spec_adaptive)
+        if self.spec_k > 0:
+            from ray_tpu.inference.speculative import resolve_draft_proposer
+            self._proposer = resolve_draft_proposer(draft_proposer)
+        else:
+            self._proposer = None
+        self._spec_stats = {"drafted": 0, "accepted": 0, "emitted": 0,
+                            "steps": 0, "bursts": 0}
         self._lanes: List[Optional[_Request]] = [None] * max_lanes
         self._waiting: "collections.deque[_Request]" = collections.deque()
         self._rid = itertools.count(1)
@@ -278,7 +339,8 @@ class InferenceEngine:
                        deadline=(None if deadline_s is None
                                  else time.monotonic() + deadline_s),
                        trace=tracing.current_context(),
-                       submitted=time.time())
+                       submitted=time.time(),
+                       spec_k=self.spec_k)
         events.record("engine", "submit", trace=req.trace, rid=rid,
                       prompt_len=len(prompt), max_new=max_new_tokens)
         if req.trace is not None:
@@ -390,8 +452,10 @@ class InferenceEngine:
         return len(self._waiting)
 
     def stats(self) -> dict:
-        """Engine occupancy + prefix-cache effectiveness counters."""
+        """Engine occupancy + prefix-cache effectiveness counters +
+        speculative acceptance counters."""
         cs = self.cache.stats
+        st = self._spec_stats
         return {
             "active": self.num_active,
             "waiting": self.num_waiting,
@@ -403,6 +467,15 @@ class InferenceEngine:
             "prefix_hit_tokens": cs["hit_tokens"],
             "prefix_miss_tokens": cs["miss_tokens"],
             "blocks_evicted": self.cache.allocator.evictions,
+            "spec_k": self.spec_k,
+            "spec_drafted_tokens": st["drafted"],
+            "spec_accepted_tokens": st["accepted"],
+            "spec_emitted_tokens": st["emitted"],
+            "spec_steps": st["steps"],
+            # Tokens per lane per verify step — plain decode is 1.0, so
+            # anything above 1 is the speculative multiplier.
+            "spec_accepted_per_step": (st["emitted"] / st["bursts"]
+                                       if st["bursts"] else 0.0),
         }
 
     # ---------------- scheduler ----------------
@@ -479,11 +552,39 @@ class InferenceEngine:
                           n=evictions - self._evictions_reported)
             self._evictions_reported = evictions
 
+    def _propose(self, lane: int, req: _Request) -> tuple:
+        """Draft for one decode lane: ask the proposer for up to the
+        lane's adaptive draft length, clamped so the verify chunk can
+        never write past max_seq_len and never drafts beyond the token
+        budget (the burst from k drafts is at most k+1 tokens)."""
+        limit = min(req.spec_k,
+                    req.max_new_tokens - req.produced - 1,
+                    self.cache.max_seq_len - 1
+                    - int(self.cache.seq_lens[lane]))
+        if limit <= 0:
+            return ()
+        draft = self._proposer.propose(req.prompt + req.emitted, limit)
+        vocab = self.config.vocab_size
+        out = []
+        for t in draft[:limit]:
+            t = int(t)
+            if not 0 <= t < vocab:
+                break       # garbage proposal: verify nothing past it
+            out.append(t)
+        return tuple(out)
+
     def step(self) -> bool:
         """One scheduler iteration: admit, then advance every live lane.
         Decode lanes and prefilling lanes dispatch as SEPARATE jitted
         steps (T=1 and T=prefill_chunk) so neither population pays the
-        other's FLOP shape.  Returns False when fully idle."""
+        other's FLOP shape.  When speculation is on and any decode lane
+        drafted, the decode population dispatches as ONE verify step
+        sized to the WIDEST draft actually proposed this step
+        (T = 1+max drafts, never more than spec_k+1) — draftless lanes
+        ride along at chunk=1, so mixed speculative/plain lanes share
+        the step, and adaptive-k backoff shrinks the verify FLOPs it
+        pays for instead of padding to the configured maximum.
+        Returns False when fully idle."""
         with self._lock:
             self._expire_deadlines()
             self._admit()
@@ -494,18 +595,36 @@ class InferenceEngine:
             plans = []
             decode = [(i, r) for i, r in live if not r.prefilling]
             if decode:
-                plans.append((decode,) + self._build_batch(decode, 1))
+                spec = False
+                if self._proposer is not None:
+                    dtok = spans.begin("engine", "spec_draft")
+                    drafted = 0
+                    for lane, req in decode:
+                        req.draft = self._propose(lane, req)
+                        drafted += len(req.draft)
+                    spec = drafted > 0
+                    spans.end(dtok, lanes=len(decode), drafted=drafted)
+                t = 1 + max(len(r.draft) for _, r in decode) if spec else 1
+                plans.append((spec, decode) + self._build_batch(decode, t))
             prefill = [(i, r) for i, r in live if r.prefilling]
             if prefill:
-                plans.append((prefill,)
+                plans.append((False, prefill)
                              + self._build_batch(prefill, self.prefill_chunk))
             events.record("engine", "step", decode=len(decode),
                           prefill=len(prefill),
                           waiting=len(self._waiting))
         done = []
-        for lanes, batch, chunks in plans:
-            next_tok = self._run_step(batch)
-            done.append((lanes, chunks, np.asarray(next_tok)))
+        for spec, lanes, batch, chunks in plans:
+            vtok = spans.begin("engine", "spec_verify") if spec else None
+            next_tok = self._run_step(batch, spec)
+            toks = np.asarray(next_tok)
+            if toks.ndim == 1:      # plain/prefill: one token per lane
+                toks = toks[:, None]
+            spans.end(vtok, lanes=len(lanes))
+            if spec:
+                self._spec_stats["steps"] += 1
+                _metrics()["spec_steps"].inc()
+            done.append((lanes, chunks, toks))
         with self._work:
             for lanes, chunks, toks in done:
                 self._commit(lanes, chunks, toks)
@@ -532,8 +651,11 @@ class InferenceEngine:
                 chunk = min(t, len(req.prompt) - req.fed)
                 tokens[lane, :chunk] = req.prompt[req.fed:req.fed + chunk]
             else:
-                chunk = 1
-                tokens[lane, 0] = req.last_token
+                # Speculative lanes feed [last_token, d_1 .. d_k]; the
+                # verify step samples every position.  Draftless lanes
+                # are the plain chunk=1 decode, masked alongside.
+                chunk = 1 + len(req.draft)
+                tokens[lane, :chunk] = (req.last_token,) + tuple(req.draft)
             positions[lane] = start + np.arange(t)
             valid[lane, :chunk] = True
             ctx_lens[lane] = start + chunk
@@ -553,17 +675,17 @@ class InferenceEngine:
                   jnp.asarray(counters)))
         return batch, chunks
 
-    def _run_step(self, batch):
+    def _run_step(self, batch, spec: bool = False):
         t, sample, args = batch
-        key = (t, sample)
+        key = (t, sample, spec)
         fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._step_fns[key] = self._make_step_fn(sample)
+            fn = self._step_fns[key] = self._make_step_fn(sample, spec)
         next_tok, k, v = fn(self.params, self.cache.k, self.cache.v, *args)
         self.cache.update_pools(k, v)
         return next_tok
 
-    def _make_step_fn(self, sample: bool):
+    def _make_step_fn(self, sample: bool, spec: bool = False):
         model, config = self.model, self.config
 
         def step(params, k, v, tokens, positions, valid, tables, ctx_lens,
@@ -571,6 +693,34 @@ class InferenceEngine:
             x, k, v = model.forward_cached(
                 params, tokens, positions, valid, k, v, tables, ctx_lens,
                 config)
+            if spec:
+                # Verify shape: EVERY position's next token is sampled
+                # in-graph — position j draws with the key the plain
+                # step would use after j more commits, fold_in(seed,
+                # counter + j), so the accepted prefix is token-exact
+                # with non-speculative decode.  T = spec_k+1 is small;
+                # the [B, T, V] logits stay on device and the step's
+                # only non-pool output is [B, T] int32.
+                logits = model.lm_head(params, x, config)    # [B, T, V]
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if not sample:
+                    return greedy, k, v
+                offs = jnp.arange(logits.shape[1], dtype=jnp.int32)
+
+                def draw_lane(rows, temp, seed, counter):
+                    def draw_pos(row, off):
+                        key = jax.random.fold_in(jax.random.key(seed),
+                                                 counter + off)
+                        z = row.astype(jnp.float32) / jnp.maximum(temp,
+                                                                  1e-6)
+                        return jax.random.categorical(key, z).astype(
+                            jnp.int32)
+
+                    return jax.vmap(draw_pos)(rows, offs)
+
+                sampled = jax.vmap(draw_lane)(logits, temps, seeds,
+                                              counters)
+                return jnp.where(temps[:, None] > 0, sampled, greedy), k, v
             # Only each lane's last valid position reaches the lm head —
             # a prefill chunk never materializes [B, T, V], and the
             # logits never leave the device: sampling happens HERE and
@@ -594,48 +744,115 @@ class InferenceEngine:
             next_tok = jnp.where(temps > 0, sampled, greedy)
             return next_tok, k, v
 
-        self._step_impls[sample] = step
+        self._step_impls[(sample, "spec") if spec else sample] = step
         # Donating the pools makes the cache update in-place on TPU; CPU
         # ignores donation with a warning, so only ask for it on TPU.
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def _commit(self, live, chunks, next_tok):
+    def _commit(self, live, chunks, toks):
         """Apply one dispatch's results: advance prefill cursors, seal
-        newly-full blocks into the prefix index, stream sampled tokens,
-        finish + free lanes."""
+        newly-full blocks into the prefix index, stream sampled tokens
+        (a multi-token speculative burst commits ATOMICALLY — one queue
+        item), roll back rejected draft blocks, finish + free lanes.
+
+        `toks` is [max_lanes, T]: T=1 rows for prefill/plain decode, the
+        per-position verify samples for a speculative dispatch."""
+        met = _metrics()
         for lane, req in live:
             if self._lanes[lane] is not req:
                 continue  # shutdown()/cancel() cleared the lane mid-step
-            if req.prefilling:
+            row = toks[lane]
+            draft = req.draft
+            req.draft = ()
+            was_prefill = req.prefilling
+            if was_prefill:
                 req.fed += chunks[lane]
                 self.cache.seq_lens[lane] += chunks[lane]
                 self.cache.seal_full_blocks(lane, req.prompt)
                 if req.prefilling:
                     continue  # more prompt to go; nothing sampled yet
+                burst = [int(row[0])]
+                accepted = 0
             else:
-                self.cache.seq_lens[lane] += 1
-                self.cache.seal_full_blocks(lane, req.prompt + req.emitted)
-            tok = int(next_tok[lane])
-            req.last_token = tok
-            req.emitted.append(tok)
-            req.produced += 1
+                # Exact-match verification: position j's K/V and sample
+                # are only valid if every earlier fed draft matched the
+                # model's own output, so the burst is the accepted draft
+                # prefix plus the first divergent (or bonus) sample.
+                accepted = 0
+                while (accepted < len(draft)
+                       and int(row[accepted]) == draft[accepted]):
+                    accepted += 1
+                burst = [int(row[j]) for j in range(accepted + 1)]
+            # Clamp the burst when a stop condition lands mid-burst:
+            # tokens past eos / the max_new_tokens budget were never
+            # "generated" — they are discarded, not streamed.
+            emit: List[int] = []
+            for tok in burst:
+                emit.append(tok)
+                if req.eos_id is not None and tok == req.eos_id:
+                    req.finish_reason = "eos"
+                    break
+                if req.produced + len(emit) >= req.max_new_tokens:
+                    req.finish_reason = "length"
+                    break
+            m = len(emit)
+            if not was_prefill:
+                # Commit K/V for the m verified positions, release the
+                # blocks the rejected tail claimed, and seal only what
+                # is now committed history (drafted blocks never enter
+                # the prefix index early: sealing is bounded by
+                # seq_lens, which counts accepted tokens only).
+                self.cache.seq_lens[lane] += m
+                if chunks[lane] > m:
+                    self.cache.truncate_lane(
+                        lane, int(self.cache.seq_lens[lane]))
+                self.cache.seal_full_blocks(
+                    lane, req.prompt + req.emitted + emit)
             # SLO latency accounting: first emit is TTFT (queue wait +
-            # prefill included), every later emit is one TBT gap.
+            # prefill included); a later burst of m tokens closes m TBT
+            # gaps of the mean inter-token latency this step achieved.
             now = time.time()
-            met = _metrics()
-            if req.produced == 1:
+            first = req.produced == 0
+            if first:
                 if req.submitted:
                     met["ttft"].observe(now - req.submitted)
             elif req.last_emit:
-                met["tbt"].observe(now - req.last_emit)
+                gap = (now - req.last_emit) / m
+                for _ in range(m):
+                    met["tbt"].observe(gap)
             req.last_emit = now
-            req.out.put(tok)
-            if req.eos_id is not None and tok == req.eos_id:
-                req.finish_reason = "eos"
-            elif req.produced >= req.max_new_tokens:
-                req.finish_reason = "length"
-            elif int(self.cache.seq_lens[lane]) >= self.cache.max_seq_len:
+            req.last_token = emit[-1]
+            req.emitted.extend(emit)
+            req.produced += m
+            if self._proposer is not None and not was_prefill:
+                self._spec_stats["emitted"] += m
+                self._spec_stats["bursts"] += 1
+                met["spec_per_step"].observe(m)
+            if draft:
+                self._spec_stats["drafted"] += len(draft)
+                self._spec_stats["accepted"] += accepted
+                met["spec_drafted"].inc(len(draft))
+                met["spec_accepted"].inc(accepted)
+                events.record("engine", "spec_accept", trace=req.trace,
+                              rid=req.rid, lane=lane, drafted=len(draft),
+                              accepted=accepted, emitted=m)
+                if self._spec_adaptive:
+                    # Per-lane draft length: grow on full acceptance,
+                    # halve on total rejection, otherwise track what
+                    # the stream actually sustains.
+                    if accepted == len(draft):
+                        req.spec_k = min(self.spec_k, req.spec_k + 1)
+                    elif accepted == 0:
+                        req.spec_k = max(1, req.spec_k // 2)
+                    else:
+                        req.spec_k = max(1, min(req.spec_k, accepted + 1))
+                self._proposer.observe(len(draft), accepted)
+            # The consumer sees a burst as ONE item: no partial-draft
+            # exposure, and failover snapshots never split a burst.
+            req.out.put(emit[0] if m == 1 else list(emit))
+            if req.finish_reason is None \
+                    and int(self.cache.seq_lens[lane]) >= self.cache.max_seq_len:
                 req.finish_reason = "max_seq_len"
             if req.trace is not None:
                 # Close the span ending at this emit (prefill for the

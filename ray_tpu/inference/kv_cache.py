@@ -345,6 +345,26 @@ class PagedKVCache:
             blocks.append(b)
             self._dev_tables = None
 
+    def truncate_lane(self, lane: int, new_len: int) -> None:
+        """Speculative rollback: release the table-tail blocks past what
+        ``new_len`` committed tokens need.  Rejected draft tokens were
+        written at positions >= the committed length; their K/V is
+        garbage the attention mask already hides (positions >= ctx_len
+        never get attended, and real tokens overwrite those slots before
+        the context grows across them), so rollback is pure block
+        accounting.  Only wholly-uncommitted tail blocks are released —
+        they are always fresh, exclusively-owned allocations (shared
+        prefix blocks live at the front of the table, and the sealed
+        boundary never passes the committed length), so decref returns
+        them straight to the free list."""
+        blocks = self._lane_blocks[lane]
+        keep = max(self.blocks_needed(new_len), self._lane_sealed[lane])
+        while len(blocks) > keep:
+            b = blocks.pop()
+            self.allocator.decref(b)
+            self.block_tables[lane, len(blocks)] = 0
+            self._dev_tables = None
+
     def free_lane(self, lane: int) -> None:
         """Sequence finish: drop this lane's share of every block.
         Sealed+indexed blocks whose refcount hits 0 park on the LRU

@@ -79,13 +79,22 @@ class LLMDeployment:
                  num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: int = 32, seed: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, speculative: bool = False,
+                 spec_k: Optional[int] = None, draft_proposer="ngram"):
+        from ray_tpu._private.config import GLOBAL_CONFIG
         from ray_tpu.inference import InferenceEngine  # jax: replica-only
+        # `speculative=True` opts the replica into speculative decoding;
+        # the draft length defaults to the cluster-wide `spec_k` config
+        # knob unless pinned per deployment.
+        if spec_k is None:
+            spec_k = GLOBAL_CONFIG.spec_k if speculative else 0
         self._engine = InferenceEngine(
             model, config, params, max_lanes=max_lanes,
             block_size=block_size, num_blocks=num_blocks,
             max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
-            seed=seed, prefix_cache=prefix_cache)
+            seed=seed, prefix_cache=prefix_cache,
+            spec_k=int(spec_k), draft_proposer=draft_proposer,
+            spec_adaptive=GLOBAL_CONFIG.spec_adaptive)
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
@@ -125,7 +134,8 @@ class LLMDeployment:
         return handle.tokens(timeout=_deadline_s)
 
     def stats(self) -> dict:
-        """Engine occupancy + prefix-cache counters (the same numbers the
-        engine exports through util.metrics, so `cli metrics` scrapes
-        them from the replica process)."""
+        """Engine occupancy + prefix-cache + speculative-acceptance
+        counters (the same numbers the engine exports through
+        util.metrics, so `cli metrics` scrapes them from the replica
+        process)."""
         return self._engine.stats()

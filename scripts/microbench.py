@@ -549,6 +549,40 @@ def main():
     timeit("prefill_miss", prefill_miss, 32, results)
     eng.shutdown()
 
+    # --- inference: speculative drafting + verify step ---------------------
+    # spec_draft: host-side n-gram prompt-lookup over a 256-token
+    # repetitive context — this runs per decode lane per step, so it
+    # must stay orders of magnitude cheaper than a jitted step.
+    # spec_verify: steady-state verify-dispatch rate (T=spec_k+1) of an
+    # 8-lane speculative engine on cyclic text; aggregate tokens/s =
+    # ops_s * lanes * accepted-per-step, so the number to compare with
+    # decode_step_lanes8 is ops_s scaled by the acceptance multiplier.
+    from ray_tpu.inference import NgramProposer
+
+    proposer = NgramProposer()
+    spec_ctx = [(j % 8) + 1 for j in range(256)]
+
+    def spec_draft(n):
+        for _ in range(n):
+            proposer.propose(spec_ctx, 4)
+
+    timeit("spec_draft", spec_draft, 20_000, results)
+
+    eng = InferenceEngine("gpt", "nano", max_lanes=8, block_size=16,
+                          prefill_chunk=8, auto_start=False, spec_k=4)
+
+    def spec_verify(n, eng=eng):
+        hs = [eng.submit([(j % 4) + 1 for j in range(8)],
+                         max_new_tokens=5 * n + 8) for _ in range(8)]
+        eng.step()                    # prefill + first sampled token
+        for _ in range(n):
+            eng.step()                # one verify dispatch per call
+        for h in hs:
+            h.cancel()
+
+    timeit("spec_verify", spec_verify, 64, results)
+    eng.shutdown()
+
     # --- data: ingest assembly / device feed / steal leases ----------------
     bench_ingest(results)
 
