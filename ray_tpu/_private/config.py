@@ -106,6 +106,9 @@ _D("native_task_transport", True, _bool,
    "push tasks over the native framed-TCP plane (taskrpc.cc) instead of "
    "the Python RPC layer")
 _D("heartbeat_interval_s", 0.5, float, "hostd -> GCS heartbeat period")
+_D("gcs_flush_interval_ms", 200.0, float,
+   "GCS persistence debounce: a burst of table mutations becomes one "
+   "sqlite executemany transaction at most this often")
 _D("node_death_timeout_s", 5.0, float,
    "missed-heartbeat window before a node is declared dead")
 # -- spilling --------------------------------------------------------------
@@ -215,6 +218,23 @@ _D("telemetry_host", "127.0.0.1", str,
 # -- scheduling ------------------------------------------------------------
 _D("scheduler_spread_threshold", 0.5, float,
    "hybrid policy: pack until this utilization, then best-node")
+_D("sched_batch_max", 8, int,
+   "worker grants requested per LeaseWorker RPC: a deep same-key queue "
+   "asks the hostd for up to this many workers in ONE round trip "
+   "instead of one RPC per lease (the hostd grants what it can and the "
+   "driver re-pumps for the rest); 1 = legacy single-grant leasing")
+_D("sched_batch_wait_ms", 0.0, float,
+   "optional submit-side coalescing window: the fast-path drain waits "
+   "up to this long for more same-burst submissions before flushing "
+   "its per-worker dispatch batches (0 = flush at the end of the "
+   "current loop tick, the latency-neutral default)")
+_D("zygote_spawn_parallelism", 8, int,
+   "forks per zygote wakeup: concurrent spawn requests coalesce into "
+   "one batched fork request of up to this many children (and the "
+   "hostd pre-warm pool seeds at most this many workers per tick)")
+_D("worker_prewarm", True, _bool,
+   "hostd pre-warms idle workers sized by recent lease demand while "
+   "the zygote is serving, so storms stop paying cold-spawn per lease")
 # -- rpc retry -------------------------------------------------------------
 _D("rpc_max_retries", 4, int,
    "transient-failure (UNAVAILABLE/disconnect) retries per RpcClient.call; "

@@ -246,6 +246,10 @@ class CoreWorker:
         self._fast_scheduled = False
         from ray_tpu._private.config import GLOBAL_CONFIG as _gc
         self._native_on = _gc.native_task_transport
+        # Optional dispatch-coalescing window (sched_batch_wait_ms): a
+        # burst's per-worker batches park in _tick_batches for up to this
+        # long so trailing submissions ride the same library call.
+        self._batch_wait_s = max(0.0, _gc.sched_batch_wait_ms) / 1000.0
         if mode == "worker" and _gc.native_task_transport:
             try:
                 from ray_tpu._private.task_transport import NativeReceiver
@@ -1281,9 +1285,35 @@ class CoreWorker:
                 self._fast_submit(rest[0], batches=batches)
             else:
                 self._fast_submit_actor(*rest, batches=batches)
-        if batches:
+        if not batches:
+            return
+        if self._batch_wait_s > 0:
+            # Park this burst's batches in the tick dict: more
+            # submissions arriving within the window append to the same
+            # per-worker vectors and ship in ONE call_spec_batch.
+            tb = self._tick_batches
             for naddr, items in batches.items():
-                self._native_sub.call_spec_batch(naddr, items)
+                tb.setdefault(naddr, []).extend(items)
+            if not self._tick_flush_scheduled:
+                self._tick_flush_scheduled = True
+                self.io.loop.call_later(self._batch_wait_s,
+                                        self._flush_tick_batches)
+            return
+        for naddr, items in batches.items():
+            self._ship_batch(naddr, items)
+
+    def _ship_batch(self, naddr, items):
+        """Flush one per-worker dispatch batch.  Items carry an optional
+        `sched/dispatch` span token in slot 3: the span covers dispatch
+        DECISION through this ship (the actual scheduler work); the
+        residency tail — shipped until the push completes — is a
+        separate `sched/inflight` span closed by each completion
+        callback, so pipelined waiting is never booked as dispatch."""
+        self._native_sub.call_spec_batch(
+            naddr, [(p, t, cb) for p, t, cb, _tok in items])
+        for _p, _t, _cb, tok in items:
+            if tok is not None:
+                spans.end(tok)
 
     def _shared_batches(self) -> dict:
         """Per-loop-tick native dispatch batch: every _pump triggered
@@ -1302,11 +1332,10 @@ class CoreWorker:
         if not b:
             return
         self._tick_batches = {}
-        sub = self._native_sub
-        if not sub:
+        if not self._native_sub:
             return
         for naddr, items in b.items():
-            sub.call_spec_batch(naddr, items)
+            self._ship_batch(naddr, items)
 
     def _pending_dep_events(self, spec: TaskSpec) -> list:
         """asyncio.Events for this task's UNRESOLVED owned dependencies.
@@ -2008,7 +2037,7 @@ class CoreWorker:
                 cb = (lambda status, data: self._on_actor_push_done(
                     sub, task_id, addr, status, data, ver))
                 batches.setdefault(naddr, []).append(
-                    (pending.payload, pending.template, cb))
+                    (pending.payload, pending.template, cb, None))
                 return
         asyncio.ensure_future(self._run_actor_task(sub, task_id))
 
@@ -2646,13 +2675,14 @@ class _KeyScheduler:
         self.MAX_PENDING_LEASES = GLOBAL_CONFIG.max_pending_lease_requests
         self.IDLE_TTL = GLOBAL_CONFIG.lease_idle_ttl_s
         self.DEPTH = GLOBAL_CONFIG.lease_pipeline_depth
+        self.BATCH_MAX = max(1, GLOBAL_CONFIG.sched_batch_max)
         self.worker = worker
         self.key = key
         self.proto_spec = proto_spec     # any spec with this key (for pick)
         self.exclude = exclude
         self.queue: deque = deque()      # (spec, fut, exclusive)
         self.leases: list = []           # granted leases (dicts)
-        self.pending_leases = 0          # in-flight LeaseWorker RPCs
+        self.pending_leases = 0          # requested-but-ungranted workers
         self._reaper = None
         # Guards lease membership + inflight counts: the submitting
         # thread may claim a slot directly (try_direct) while the loop
@@ -2715,11 +2745,15 @@ class _KeyScheduler:
             cb = (lambda status, data: self._on_push_done(
                 spec, None, best, status, data))
         else:
-            def cb(status, data, _tok=tok):
-                spans.end(_tok, status=status)
+            itok = spans.begin("sched", "inflight", ctx=spec.trace_ctx,
+                               name=spec.name)
+
+            def cb(status, data, _itok=itok):
+                spans.end(_itok, status=status)
                 self._on_push_done(spec, None, best, status, data)
         sub.call_spec_batch(naddr, [(pending.payload, pending.template,
                                      cb)])
+        spans.end(tok)
         return True
 
     async def drain(self):
@@ -2787,22 +2821,26 @@ class _KeyScheduler:
             self.queue.popleft()
             self._dispatch(spec, sink, best, batches)
         if flush_here and batches:
-            sub = self.worker._native_sub
             for naddr, items in batches.items():
-                sub.call_spec_batch(naddr, items)
+                self.worker._ship_batch(naddr, items)
         # Lease demand scales by pipeline depth (a lease carries DEPTH
         # tasks).  Anything still queued found every held lease full, so
         # the remaining queue needs NEW leases; only the number of
-        # in-flight lease REQUESTS is capped (reference
+        # in-flight lease GRANTS is capped (reference
         # lease_policy/max_pending_lease_requests_per_scheduling_category)
         # — total held leases are bounded by cluster resources at the
-        # hostd, not by the client.
+        # hostd, not by the client.  Demand is amortized into batched
+        # requests: ONE LeaseWorker RPC carries up to BATCH_MAX grants,
+        # so a deep queue costs ceil(want / BATCH_MAX) round trips
+        # instead of `want`.
         want = min((len(self.queue) + self.DEPTH - 1) // self.DEPTH
                    - self.pending_leases,
                    self.MAX_PENDING_LEASES - self.pending_leases)
-        for _ in range(max(0, want)):
-            self.pending_leases += 1
-            asyncio.ensure_future(self._acquire_lease())
+        while want > 0:
+            n = min(want, self.BATCH_MAX)
+            want -= n
+            self.pending_leases += n
+            asyncio.ensure_future(self._acquire_lease(n))
 
     def _dispatch(self, spec, sink, lease, batches):
         """Native-route dispatches accumulate into `batches` (flushed by
@@ -2827,11 +2865,18 @@ class _KeyScheduler:
                     cb = (lambda status, data: self._on_push_done(
                         spec, sink, lease, status, data))
                 else:
-                    def cb(status, data, _tok=tok):
-                        spans.end(_tok, status=status)
+                    # Residency on the worker's pipeline (shipped ->
+                    # push completion) is its own span; the dispatch
+                    # token is closed by _ship_batch once the frame is
+                    # handed to the transport.
+                    itok = spans.begin("sched", "inflight",
+                                       ctx=spec.trace_ctx, name=spec.name)
+
+                    def cb(status, data, _itok=itok):
+                        spans.end(_itok, status=status)
                         self._on_push_done(spec, sink, lease, status, data)
                 batches.setdefault(naddr, []).append(
-                    (pending.payload, pending.template, cb))
+                    (pending.payload, pending.template, cb, tok))
                 return
         asyncio.ensure_future(self._run_on_lease(spec, sink, lease, tok))
 
@@ -2949,7 +2994,11 @@ class _KeyScheduler:
         # tick; their re-dispatches coalesce into one flush per worker.
         self._pump(self.worker._shared_batches())
 
-    async def _acquire_lease(self):
+    async def _acquire_lease(self, count: int = 1):
+        """Request up to `count` worker grants in ONE LeaseWorker RPC.
+        The hostd grants what it can immediately (parking only when it
+        can grant zero); a partial fill resolves here and the follow-up
+        _pump re-requests the remainder."""
         worker = self.worker
         spec = self.proto_spec
         # Lease demand is driven by the queue head: attribute the wait to
@@ -2958,7 +3007,7 @@ class _KeyScheduler:
         head = self.queue[0][0] if self.queue else spec
         tok = (spans.begin("sched", "lease_wait",
                            ctx=getattr(head, "trace_ctx", None),
-                           key=str(self.key)[:64])
+                           key=str(self.key)[:64], count=count)
                if getattr(head, "trace_ctx", None) is not None else None)
         try:
             bundle = None
@@ -3005,7 +3054,8 @@ class _KeyScheduler:
                     "NodeManager", "LeaseWorker",
                     {"resources": spec.resources.to_dict(),
                      "job_id": worker._job_int(), "bundle": bundle,
-                     "runtime_env": spec.runtime_env},
+                     "runtime_env": spec.runtime_env,
+                     "count": count},
                     timeout=60)
             except Exception as e:
                 raise _RetryableSubmitError(f"lease rpc failed: {e}",
@@ -3016,7 +3066,7 @@ class _KeyScheduler:
                     busy=lease.get("reason") in ("busy", "resources"))
         except BaseException as e:  # noqa: BLE001 - routed to a queued task
             spans.end(tok, granted=False)
-            self.pending_leases -= 1
+            self.pending_leases -= count
             # A busy rejection while we HOLD leases is not a task failure:
             # queued tasks are draining through the held workers; failing
             # one would send it to the back of the queue after a pointless
@@ -3039,22 +3089,31 @@ class _KeyScheduler:
             self._pump()
             self._maybe_gc()
             return
-        spans.end(tok, granted=True)
-        self.pending_leases -= 1
-        lease["node_address"] = node.address
-        lease["node_id"] = node.node_id
-        lease["idle_since"] = time.monotonic()
-        lease["inflight"] = 0
-        port = lease.get("native_port", 0)
-        waddr = lease.get("worker_address", "")
-        if port and waddr and waddr not in worker._native_addrs:
-            # The grant carries the worker's native route: the FIRST push
-            # to a fresh worker already goes over the native plane (no
-            # NativePort discovery RPC, no coroutine detour).
-            worker._native_addrs[waddr] = (
-                f"{waddr.rsplit(':', 1)[0]}:{port}")
+        # A batched reply carries one grant dict per worker; a legacy
+        # single-grant reply IS the grant.  Partial fills are normal —
+        # the hostd returns what it could satisfy without parking.
+        grants = lease.get("grants") or [lease]
+        spans.end(tok, granted=True, grants=len(grants))
+        self.pending_leases -= count
+        fresh = []
+        for g in grants:
+            g = dict(g)
+            g["node_address"] = node.address
+            g["node_id"] = node.node_id
+            g["idle_since"] = time.monotonic()
+            g["inflight"] = 0
+            port = g.get("native_port", 0)
+            waddr = g.get("worker_address", "")
+            if port and waddr and waddr not in worker._native_addrs:
+                # The grant carries the worker's native route: the FIRST
+                # push to a fresh worker already goes over the native
+                # plane (no NativePort discovery RPC, no coroutine
+                # detour).
+                worker._native_addrs[waddr] = (
+                    f"{waddr.rsplit(':', 1)[0]}:{port}")
+            fresh.append(g)
         with self.tlock:
-            self.leases.append(lease)
+            self.leases.extend(fresh)
         if self._reaper is None:
             self._reaper = asyncio.ensure_future(self._reap_idle())
         self._pump()

@@ -47,6 +47,10 @@ def _metrics():
             "placement_groups_created": mt.Counter(
                 "placement_groups_created", "placement groups scheduled"),
             "nodes_alive": mt.Gauge("nodes_alive", "alive nodes"),
+            "gcs_flush_rows": mt.Counter(
+                "gcs_flush_rows", "rows written by GCS persistence flushes"),
+            "gcs_flush_seconds": mt.Counter(
+                "gcs_flush_seconds", "seconds spent in GCS flush commits"),
         }
     return _M
 
@@ -227,16 +231,25 @@ class GcsServer:
         # sleep-polling (reference: pubsub/publisher.h long-poll channels).
         self._change_event = asyncio.Event()
         self._actor_events: dict = {}   # ActorID -> Event (targeted polls)
+        self._wake_scheduled = False    # coalesces broadcast wakes per tick
 
     def _bump(self, tab: str | None = None, key=None):
         """Record a state change and wake every waiter.  With (tab, key)
         the changed record is marked dirty for the incremental persist
         flush; without them the change is volatile (resource heartbeats)
-        and only wakes waiters."""
+        and only wakes waiters.
+
+        The broadcast wake is coalesced to once per loop tick: a batched
+        mutation (N actors registered in one RPC burst) fires the parked
+        long-polls a single time instead of N times, while targeted
+        per-actor wakes stay immediate."""
         self._cluster_version += 1
-        ev = self._change_event
-        self._change_event = asyncio.Event()
-        ev.set()
+        if not self._wake_scheduled:
+            self._wake_scheduled = True
+            try:
+                asyncio.get_running_loop().call_soon(self._fire_change)
+            except RuntimeError:   # no loop (teardown/test) — fire inline
+                self._fire_change()
         if tab == "actors" and key is not None:
             # Targeted wake for per-actor long-polls: during an actor
             # storm, hundreds of get_actor_info polls are parked, and
@@ -248,6 +261,12 @@ class GcsServer:
         if tab is not None:
             self._dirty.add((tab, key))
             self._schedule_persist()
+
+    def _fire_change(self):
+        self._wake_scheduled = False
+        ev = self._change_event
+        self._change_event = asyncio.Event()
+        ev.set()
 
     def _mark_dirty(self, tab: str, key) -> None:
         self._dirty.add((tab, key))
@@ -273,7 +292,7 @@ class GcsServer:
         """Debounced incremental flush: a burst of changes becomes ONE
         transaction writing only the dirtied rows (O(delta), reference
         redis_store_client role) plus a constant meta row."""
-        await asyncio.sleep(0.2)
+        await asyncio.sleep(max(0.0, _cfg().gcs_flush_interval_ms) / 1000.0)
         self._persist_pending = False
         import pickle
         async with self._persist_lock:
@@ -307,10 +326,19 @@ class GcsServer:
                      pickle.dumps(self.next_job, protocol=5)))
         puts.append(("meta", b"cluster_version",
                      pickle.dumps(self._cluster_version, protocol=5)))
+        from ray_tpu.util import spans
+        tok = spans.begin("gcs", "flush",
+                          rows=len(puts) + len(dels), dirty=len(dirty))
+        t0 = time.monotonic()
         try:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.storage.write_rows, puts, dels)
+            spans.end(tok)
+            m = _metrics()
+            m["gcs_flush_rows"].inc(len(puts) + len(dels))
+            m["gcs_flush_seconds"].inc(time.monotonic() - t0)
         except Exception:
+            spans.end(tok, error=True)
             logger.exception("GCS persistence write failed")
             # Re-mark AND reschedule: without the reschedule a transient
             # write failure during a quiescent period would leave durable
@@ -1155,6 +1183,14 @@ class GcsServer:
 
     async def ping(self, req):
         return {"ok": True, "version": self._cluster_version}
+
+    async def collect_events(self, req):
+        """Own flight-recorder ring.  The GCS is its own process — no
+        hostd scrapes it — so without this the `gcs/flush` spans and
+        actor-manager events would be invisible to state.events()."""
+        from ray_tpu.util import events as ev
+        return {"events": ev.snapshot(since=req.get("since", 0.0)),
+                "now": time.time()}
 
     # ---------------- lifecycle ----------------
 

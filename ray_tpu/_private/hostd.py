@@ -28,6 +28,7 @@ from ray_tpu._private.object_store import ObjectStore
 from ray_tpu._private.protocol import NodeInfo
 from ray_tpu._private.rpc import ClientPool, RpcClient, RpcServer
 from ray_tpu.util import events
+from ray_tpu.util import spans
 
 logger = logging.getLogger("ray_tpu.hostd")
 
@@ -164,13 +165,21 @@ class _ForkedProc:
 
 
 class _Zygote:
-    """Manages the fork-server process (see worker_zygote.py).  Requests
-    are serialized under a lock; a fork round-trip is ~1-2ms, so blocking
-    the caller briefly beats a thread handoff."""
+    """Manages the fork-server process (see worker_zygote.py).
 
-    def __init__(self, env: dict):
+    Spawn requests COALESCE: concurrent callers (the spawn thread pool
+    during a storm or a batched lease) enqueue their request and one of
+    them — whoever wins the pipe lock — ships every pending request as a
+    single batched {"spawn": [...]} line, so the zygote forks K children
+    per select wakeup instead of one pipe round trip per worker.  A lone
+    caller degenerates to the old one-request/one-reply exchange cost."""
+
+    def __init__(self, env: dict, batch_max: int = 8):
         import threading
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()        # pipe ownership
+        self._qlock = threading.Lock()       # pending-request queue
+        self._pending: list = []             # [req, Event, pid, exc]
+        self.batch_max = max(1, batch_max)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_zygote"],
             env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -181,16 +190,55 @@ class _Zygote:
             raise RuntimeError("zygote failed to start")
 
     def spawn(self, argv: list, env: dict, stdout: str, stderr: str) -> int:
+        import threading
+        item = [{"argv": argv, "env": env, "stdout": stdout,
+                 "stderr": stderr}, threading.Event(), None, None]
+        with self._qlock:
+            self._pending.append(item)
+        while not item[1].is_set():
+            # Whoever holds the pipe flushes EVERYONE's pending requests;
+            # the rest block here until their reply (or help flush the
+            # next wave once the pipe frees up).
+            if not self._lock.acquire(timeout=0.05):
+                continue
+            try:
+                if item[1].is_set():
+                    break
+                with self._qlock:
+                    batch = self._pending[:self.batch_max]
+                    del self._pending[:len(batch)]
+                if batch:
+                    self._spawn_batch(batch)
+            finally:
+                self._lock.release()
+        if item[3] is not None:
+            raise item[3]
+        return item[2]
+
+    def _spawn_batch(self, batch: list) -> None:
+        """Ship one batched fork request; runs under self._lock."""
         import json as _json
-        req = _json.dumps({"argv": argv, "env": env,
-                           "stdout": stdout, "stderr": stderr}) + "\n"
-        with self._lock:
-            self.proc.stdin.write(req.encode())
+        line = None
+        exc = None
+        try:
+            self.proc.stdin.write((_json.dumps(
+                {"spawn": [it[0] for it in batch]}) + "\n").encode())
             self.proc.stdin.flush()
             line = self.proc.stdout.readline()
-        if not line:
-            raise RuntimeError("zygote died")
-        return int(_json.loads(line)["pid"])
+        except Exception as e:  # noqa: BLE001 - fanned to every waiter
+            exc = e
+        if exc is None and not line:
+            exc = RuntimeError("zygote died")
+        if exc is None:
+            pids = _json.loads(line).get("pids", [])
+            if len(pids) != len(batch):
+                exc = RuntimeError("zygote spawn reply shape mismatch")
+        for i, it in enumerate(batch):
+            if exc is not None:
+                it[3] = exc
+            else:
+                it[2] = int(pids[i])
+            it[1].set()
 
     def poll_exits(self, into: dict) -> None:
         """Drain the zygote's reap reports into `into` ({pid: code})."""
@@ -237,6 +285,7 @@ class WorkerHandle:
         self.exit_reason: str | None = None
         self.log_paths: dict = {}
         self.log_offsets: dict = {}
+        self.boot_span = None    # sched/worker_boot, closed by WorkerReady
         self.ready = asyncio.Event()
 
 
@@ -299,9 +348,14 @@ class NodeDaemon:
         # counts in-executor spawns for the startup throttle.
         from concurrent.futures import ThreadPoolExecutor
         self._spawn_exec = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="spawn")
+            max_workers=max(4, _cfg().zygote_spawn_parallelism),
+            thread_name_prefix="spawn")
         self._spawning = 0
         self._spawn_seq = 0
+        # Recent lease demand, (t, (job_id, env_hash, tpu)) — drives the
+        # pre-warm pool (see _prewarm_tick): a storm's lease rate sizes
+        # how many idle workers to keep forked ahead of the next wave.
+        self._lease_demand: deque = deque(maxlen=512)
         self._capacity_freed: asyncio.Event | None = None  # made on start()
         # Parked lease waiters, FIFO: capacity events hand off to ONE
         # waiter (see _notify_capacity).
@@ -368,13 +422,22 @@ class NodeDaemon:
                 "--node-id", self.node_id.hex(),
                 "--job-id", str(job_id)]
         self._spawning += 1
+        # Spawn-path attribution (actor_storm mode in scale_attrib.py):
+        # zygote_fork covers process creation (fork round trip or cold
+        # Popen), worker_boot the child's interpreter/runtime ramp until
+        # its WorkerReady lands.
+        ftok = spans.begin("sched", "zygote_fork",
+                           cold=self._zygote is None or tpu)
         try:
             proc = await asyncio.get_running_loop().run_in_executor(
                 self._spawn_exec, self._make_proc, argv, env, log_base,
                 tpu)
         finally:
             self._spawning -= 1
+            spans.end(ftok)
         handle = WorkerHandle(proc, job_id, renv.env_hash(runtime_env), tpu)
+        handle.boot_span = spans.begin("sched", "worker_boot",
+                                       pid=proc.pid)
         handle.log_paths = {"stdout": log_base + ".out",
                             "stderr": log_base + ".err"}
         handle.log_offsets = {"stdout": 0, "stderr": 0}
@@ -429,7 +492,8 @@ class NodeDaemon:
                 zenv = dict(os.environ)
                 zenv.pop("PALLAS_AXON_POOL_IPS", None)
                 zenv["JAX_PLATFORMS"] = "cpu"
-                self._zygote = _Zygote(zenv)
+                self._zygote = _Zygote(
+                    zenv, batch_max=_cfg().zygote_spawn_parallelism)
             except Exception:
                 logger.exception("zygote failed to start; cold spawns only")
             finally:
@@ -454,6 +518,9 @@ class NodeDaemon:
         handle.native_port = req.get("native_port", 0)
         handle.state = "idle"
         handle.idle_since = time.monotonic()
+        if handle.boot_span is not None:
+            spans.end(handle.boot_span)
+            handle.boot_span = None
         handle.ready.set()
         # Wake lease requests parked behind the startup throttle.
         self._notify_capacity()
@@ -579,19 +646,34 @@ class NodeDaemon:
                     self.resources_total.get(k, float("inf")))
         self._notify_capacity()
 
-    def _notify_capacity(self):
+    def _notify_capacity(self, n: int | None = None):
         if self._capacity_freed is not None:
             self._capacity_freed.set()
             self._capacity_freed = asyncio.Event()
-        # Hand one freed worker/slot to ONE parked lease: broadcasting to
-        # every parked waiter is O(waiters x workers) per event — the
-        # measured collapse mode of a 1,000-actor storm (each ready wakes
-        # 1,000 leases, each rescanning 1,000 handles).
-        while self._worker_waiters:
+        # Hand freed workers/slots to as many parked leases as current
+        # capacity can plausibly satisfy in ONE pass — under batched
+        # grants a single release can unblock several small leases, and
+        # a one-baton handoff serialized them a release event apart.
+        # Still bounded: broadcasting to EVERY parked waiter is
+        # O(waiters x workers) per event — the measured collapse mode of
+        # a 1,000-actor storm (each ready wakes 1,000 leases, each
+        # rescanning 1,000 handles) — so the wake count is capped by
+        # idle workers plus startup-throttle headroom (a woken waiter
+        # that can't use the slot re-parks, which self-limits).
+        if not self._worker_waiters:
+            return
+        if n is None:
+            idle = sum(1 for w in self.workers.values()
+                       if w.state == "idle" and not w.reserved)
+            starting = sum(1 for w in self.workers.values()
+                           if w.state == "starting") + self._spawning
+            headroom = self.max_startup_concurrency - starting
+            n = max(1, idle + max(0, headroom))
+        while self._worker_waiters and n > 0:
             fut = self._worker_waiters.popleft()
             if not fut.done():
                 fut.set_result(None)
-                break
+                n -= 1
 
     async def _wait_capacity(self, timeout: float):
         if self._capacity_freed is None:
@@ -663,29 +745,53 @@ class NodeDaemon:
         demand = req.get("resources", {})
         bundle = tuple(req["bundle"]) if req.get("bundle") else None
         job_id = req.get("job_id", 0)
+        # Batched grants: the request carries how many same-key leases the
+        # driver's queue wants; grant as many as this node can satisfy
+        # RIGHT NOW in one reply (parking only while it can grant zero).
+        # Worker acquisition for a multi-grant runs concurrently, so N
+        # cold spawns coalesce into one batched zygote fork.
+        count = max(1, int(req.get("count", 1)))
+        tpu = _wants_tpu(demand)
+        self._note_lease_demand(job_id, req.get("runtime_env"), tpu, count)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + req.get("queue_timeout", 10.0)
+        grants: list[WorkerHandle] = []
         while True:
-            reserved = (self._bundle_reserve(bundle, demand) if bundle
-                        else self._reserve(demand))
-            if reserved:
-                handle = await self._get_worker(
-                    job_id, runtime_env=req.get("runtime_env"),
-                    tpu=_wants_tpu(demand))
-                if handle is not None:
+            k = 0
+            while len(grants) + k < count:
+                reserved = (self._bundle_reserve(bundle, demand) if bundle
+                            else self._reserve(demand))
+                if not reserved:
                     break
-                if bundle:
-                    self._bundle_unreserve(bundle, demand)
-                else:
-                    self._unreserve(demand)
-                if not any(w.state == "idle" or w.proc.poll() is None
-                           for w in self.workers.values()):
+                k += 1
+            if k:
+                handles = await asyncio.gather(*[
+                    self._get_worker(job_id,
+                                     runtime_env=req.get("runtime_env"),
+                                     tpu=tpu)
+                    for _ in range(k)])
+                for handle in handles:
+                    if handle is not None:
+                        grants.append(handle)
+                        continue
+                    if bundle:
+                        self._bundle_unreserve(bundle, demand)
+                    else:
+                        self._unreserve(demand)
+                if not grants and not any(
+                        w.state == "idle" or w.proc.poll() is None
+                        for w in self.workers.values()):
                     events.record("sched", "lease_reject",
                                   reason="no_worker")
                     return {"granted": False, "reason": "no_worker"}
-            elif bundle and bundle not in self.bundles:
+            elif not grants and bundle and bundle not in self.bundles:
                 events.record("sched", "lease_reject", reason="no_bundle")
                 return {"granted": False, "reason": "no_bundle"}
+            if grants:
+                # Partial fills return immediately: the driver re-pumps
+                # for the remainder; holding granted workers hostage to
+                # the stragglers would idle them for the parking window.
+                break
             remaining = deadline - loop.time()
             if remaining <= 0:
                 events.record("sched", "lease_reject", reason="busy",
@@ -693,22 +799,30 @@ class NodeDaemon:
                 return {"granted": False, "reason": "busy"}
             await self._wait_worker_slot(remaining)
         # Chain wake: capacity may remain (fractional demand) — pass the
-        # baton to the next parked lease instead of broadcasting.
+        # baton to the next parked leases instead of broadcasting.
         self._notify_capacity()
-        self._lease_seq += 1
-        _metrics()["leases_granted"].inc()
-        lease_id = f"{self.node_id.hex()[:8]}-{self._lease_seq}"
-        logger.info("lease %s -> worker pid=%d", lease_id, handle.proc.pid)
-        events.record("sched", "lease_grant", lease_id=lease_id,
-                      pid=handle.proc.pid)
-        handle.leased_at = time.monotonic()
-        handle.state = "leased"
-        handle.lease_id = lease_id
-        handle.lease_resources = demand
-        handle.lease_bundle = bundle
-        return {"granted": True, "worker_address": handle.address,
-                "native_port": handle.native_port,
-                "lease_id": lease_id, "node_id": self.node_id}
+        out = []
+        for handle in grants:
+            self._lease_seq += 1
+            _metrics()["leases_granted"].inc()
+            lease_id = f"{self.node_id.hex()[:8]}-{self._lease_seq}"
+            handle.leased_at = time.monotonic()
+            handle.state = "leased"
+            handle.lease_id = lease_id
+            handle.lease_resources = dict(demand)
+            handle.lease_bundle = bundle
+            out.append({"worker_address": handle.address,
+                        "native_port": handle.native_port,
+                        "lease_id": lease_id, "node_id": self.node_id})
+        logger.info("lease %s -> %d worker(s), head pid=%d", out[0]["lease_id"],
+                    len(out), grants[0].proc.pid)
+        events.record("sched", "lease_grant", lease_id=out[0]["lease_id"],
+                      pid=grants[0].proc.pid, granted=len(out),
+                      requested=count)
+        reply = dict(out[0])
+        reply["granted"] = True
+        reply["grants"] = out
+        return reply
 
     async def return_worker(self, req):
         for handle in self.workers.values():
@@ -740,6 +854,9 @@ class NodeDaemon:
             return {"granted": False, "reason": "preempting"}
         demand = req.get("resources", {})
         bundle = tuple(req["bundle"]) if req.get("bundle") else None
+        self._note_lease_demand(req.get("job_id", 0),
+                                req.get("runtime_env"),
+                                _wants_tpu(demand))
         loop = asyncio.get_running_loop()
         deadline = loop.time() + req.get("queue_timeout", 30.0)
         while True:
@@ -1601,7 +1718,70 @@ class NodeDaemon:
                 elif (handle.state == "idle"
                       and now - handle.idle_since > _cfg().worker_idle_ttl_s):
                     self._kill_worker(handle)
+            self._prewarm_tick()
             await asyncio.sleep(0.2)
+
+    def _note_lease_demand(self, job_id: int, runtime_env, tpu: bool,
+                           count: int = 1) -> None:
+        from ray_tpu._private import runtime_env as renv
+        key = (job_id, renv.env_hash(runtime_env), tpu)
+        t = time.monotonic()
+        for _ in range(min(count, 64)):
+            self._lease_demand.append((t, key, runtime_env))
+
+    def _prewarm_tick(self, window_s: float = 5.0):
+        """Keep idle workers forked ahead of demand: recent lease traffic
+        for a (job, env, non-TPU) pool seeds up to zygote_spawn_parallelism
+        spare workers per tick, so the next storm wave claims an idle fork
+        instead of paying a cold spawn inside its lease RPC.  Only while
+        the zygote is serving (forks are ~1-2ms; pre-warming cold Popens
+        would fight the startup throttle it exists to protect)."""
+        if (self._zygote is None or self.preempting
+                or not _cfg().worker_prewarm or not self._lease_demand):
+            return
+        # Pre-warm only fills SPARE startup capacity.  During a storm the
+        # lease path keeps the throttle saturated on its own; unthrottled
+        # extra forks would steal CPU from boots already in flight (which
+        # is strictly worse than doing nothing — measured 23/s -> 8/s on
+        # a 1-core actor storm before this guard existed).
+        starting = sum(1 for w in self.workers.values()
+                       if w.state == "starting") + self._spawning
+        headroom = self.max_startup_concurrency - starting
+        if headroom <= 0:
+            return
+        horizon = time.monotonic() - window_s
+        while self._lease_demand and self._lease_demand[0][0] < horizon:
+            self._lease_demand.popleft()
+        if not self._lease_demand:
+            return
+        demand: dict = {}
+        envs: dict = {}
+        for _, key, runtime_env in self._lease_demand:
+            demand[key] = demand.get(key, 0) + 1
+            envs[key] = runtime_env
+        live = sum(1 for w in self.workers.values()
+                   if w.proc.returncode is None)
+        budget = min(_cfg().zygote_spawn_parallelism, headroom,
+                     self.max_workers - live)
+        for (job_id, env_hash, tpu), seen in sorted(
+                demand.items(), key=lambda kv: -kv[1]):
+            if budget <= 0:
+                break
+            if tpu:
+                continue   # TPU workers never fork; no cheap pre-warm
+            # Supply = every live matching worker, whatever its state:
+            # leases counted in `seen` were served by workers that are
+            # now leased/actor — counting only idle+starting here would
+            # re-buy satisfied demand every tick.
+            have = sum(1 for w in self.workers.values()
+                       if w.job_id == job_id and w.env_hash == env_hash
+                       and not w.tpu and w.proc.returncode is None)
+            want = min(budget, seen - have)
+            for _ in range(max(0, want)):
+                budget -= 1
+                asyncio.ensure_future(
+                    self._spawn_worker(job_id, envs[(job_id, env_hash,
+                                                     tpu)], False))
 
     async def start(self, port: int = 0) -> int:
         self.server.register("NodeManager", "WorkerReady", self.worker_ready)

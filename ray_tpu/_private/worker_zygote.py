@@ -11,6 +11,8 @@ worker is an os.fork() of it (~1-2ms, memory shared copy-on-write).
 Protocol (line-delimited JSON over the zygote's stdin/stdout):
   hostd -> zygote: {"argv": [...], "env": {k: v}, "stdout": path, "stderr": path}
   zygote -> hostd: {"pid": <child pid>}       (one reply per request)
+  hostd -> zygote: {"spawn": [<req>, ...]}    (batched: K forks per wakeup)
+  zygote -> hostd: {"pids": [<pid>, ...]}     (order matches the request)
 The zygote emits {"ready": true} once imports are done.  EOF on stdin or
 the hostd's death (orphan watch) shuts it down; forked children notice
 the zygote's death via their own ppid watch (worker_main.orphan_watch).
@@ -129,6 +131,17 @@ def main() -> None:
                 _exited.pop(k, None)   # copy and clear() must not be lost
             wr.write((json.dumps({"exited": list(out.items())})
                       + "\n").encode())
+            wr.flush()
+            continue
+        if "spawn" in req:
+            # Batched spawn: K forks per select wakeup, one reply line.
+            pids = []
+            for sub in req["spawn"]:
+                pid = os.fork()
+                if pid == 0:
+                    _child(sub)  # never returns
+                pids.append(pid)
+            wr.write((json.dumps({"pids": pids}) + "\n").encode())
             wr.flush()
             continue
         pid = os.fork()

@@ -244,6 +244,19 @@ def events(address: Optional[str] = None, *, plane: Optional[str] = None,
                 await client.close()
             streams.append(_normalize_events_reply(
                 reply, n.node_id.hex(), t0, t1))
+        # The GCS runs in its own process with its own ring (gcs/flush
+        # spans, actor-manager events) that no hostd scrapes.
+        client = RpcClient(addr)
+        try:
+            t0 = _time.time()
+            reply = await client.call("Gcs", "collect_events",
+                                      {"since": pre_since}, timeout=10)
+            t1 = _time.time()
+            streams.append(_normalize_events_reply(reply, "gcs", t0, t1))
+        except Exception:
+            pass
+        finally:
+            await client.close()
         return streams
 
     streams = _run(_collect())

@@ -295,6 +295,44 @@ def bench_train_ft(results: dict):
     timeit("train_resize_down", resize_down, 2, results, settle=1.0)
 
 
+def bench_control_plane(results: dict):
+    """Batched control-plane microbenches (PR 14).
+
+    `batched_dispatch_burst`: drain rate of a one-shot 8k-task burst
+    over held leases — the driver coalesces same-key specs into
+    per-worker dispatch vectors, so this number moves with
+    `sched_batch_max` and the vectorized result_seal path.
+
+    `zygote_spawn_batch`: actors/s for an 8-actor storm where every
+    actor needs a dedicated worker — each op pays lease, batched zygote
+    fork (`zygote_spawn_parallelism` children per wakeup), boot, and
+    first ping, then kills the actors so the next round forks fresh."""
+
+    @ray_tpu.remote
+    def nopc():
+        return None
+
+    def batched_dispatch(n):
+        ray_tpu.get([nopc.remote() for _ in range(n)])
+
+    batched_dispatch(2000)   # warm the lease pool past ramp-up
+    timeit("batched_dispatch_burst", batched_dispatch, 8000, results,
+           settle=1.0)
+
+    @ray_tpu.remote
+    class Spawn:
+        def ping(self):
+            return None
+
+    def zygote_spawn(n):
+        actors = [Spawn.remote() for _ in range(n)]
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+        for a in actors:
+            ray_tpu.kill(a)
+
+    timeit("zygote_spawn_batch", zygote_spawn, 8, results, settle=2.0)
+
+
 def bench_observability(results: dict):
     """Observability hot-path costs: `events_append` is the per-record()
     overhead every instrumented plane pays (budget: < 5 µs/event, i.e.
@@ -485,6 +523,9 @@ def main():
     print(f"{'tasks_10ms_x500_concurrent':32s} {med:10,.1f} ops/s   "
           f"(median of {ROUNDS}x500, range "
           f"{min(rates):,.0f}-{max(rates):,.0f})")
+
+    # --- control plane: batched dispatch + zygote spawn --------------------
+    bench_control_plane(results)
 
     # --- inference: continuous-batching decode step ------------------------
     # Steady-state decode-step rate of the paged-KV engine (nano model so

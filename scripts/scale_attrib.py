@@ -1,13 +1,22 @@
-"""Critical-path attribution for the SCALE.json queued-task workload.
+"""Critical-path attribution for the SCALE.json workloads.
 
-Re-runs `scale_bench.bench_queued_tasks`'s shape (warm pool, burst
-submit, drain) under a trace, then slices the submit->drain wall clock
-into lifecycle phases from the recorded spans and writes
+Default mode re-runs `scale_bench.bench_queued_tasks`'s shape (warm
+pool, burst submit, drain) under a trace, then slices the submit->drain
+wall clock into lifecycle phases from the recorded spans and writes
 SCALE_ATTRIB.json: per-phase attributed seconds, the top phases, and
 the attribution coverage (the ISSUE gate: >= 90% of the gap named).
 
+`python scripts/scale_attrib.py actor_storm` instead attributes the
+actor-creation path: the spawn-side spans the hostd records without a
+trace context (sched/zygote_fork, sched/worker_boot, proc/boot) are
+scraped cluster-wide via state.events() + build_spans and unioned with
+the driver-side lease/dispatch spans, so SCALE_ATTRIB.json shows where
+an actor storm's wall clock goes (fork vs boot vs first ping vs lease
+wait).  The result lands under an "actor_storm" key alongside the
+queued-task attribution.
+
 Attribution is a priority union-sweep, not a per-span sum: overlapping
-spans (dispatch covers push->exec->reply; task covers arg_fetch/exec/
+spans (inflight covers ship->exec->reply; task covers arg_fetch/exec/
 result_seal) would double-count, so each instant of wall clock is
 charged to the highest-priority phase covering it — innermost phases
 first, wrappers soak up only what their children left unexplained.
@@ -35,7 +44,17 @@ from ray_tpu.util import tracing  # noqa: E402
 # Innermost first: a slice covered by exec belongs to exec even though
 # dispatch/task also span it.
 PHASE_PRIORITY = ("exec", "arg_fetch", "result_seal", "task", "dispatch",
-                  "sched_queue", "lease_wait", "submit", "transfer")
+                  "inflight", "sched_queue", "lease_wait", "submit",
+                  "transfer")
+
+# Actor-storm phases: spawn-path spans first (they are the storm's
+# substance), then the generic task phases the first ping rides on.
+# `exec` here IS the first ping (plus the trivial __init__ task) — the
+# storm runs no other user code — so it is reported as `first_ping`.
+ACTOR_PHASE_PRIORITY = ("zygote_fork", "exec", "arg_fetch", "result_seal",
+                        "boot", "worker_boot", "task", "dispatch",
+                        "inflight", "sched_queue", "lease_wait", "submit")
+ACTOR_RELABEL = {"exec": "first_ping", "boot": "worker_main_boot"}
 
 
 def _union(ivals):
@@ -74,7 +93,7 @@ def _len(ivals):
     return sum(e - s for s, e in ivals)
 
 
-def attribute(spans_flat, t0, t1):
+def attribute(spans_flat, t0, t1, priority=PHASE_PRIORITY):
     """Charge [t0, t1] to phases by priority; returns (per-phase seconds,
     unattributed seconds)."""
     by_kind = {}
@@ -86,13 +105,98 @@ def attribute(spans_flat, t0, t1):
             by_kind.setdefault(rec["kind"], []).append((s, e))
     covered = []
     phases = {}
-    for kind in PHASE_PRIORITY:
+    for kind in priority:
         ivals = _union(by_kind.get(kind, []))
         fresh = _subtract(ivals, covered)
         phases[kind] = _len(fresh)
         covered = _union(covered + fresh)
     wall = t1 - t0
     return phases, wall - _len(covered)
+
+
+def _write(update: dict):
+    """Merge `update` into SCALE_ATTRIB.json (modes accumulate, so the
+    queued-task row survives an actor_storm run and vice versa)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SCALE_ATTRIB.json")
+    doc = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError:
+            doc = {}
+    doc.update(update)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}")
+
+
+def _report(ranked, total_s, unattributed, coverage):
+    for k, v in ranked:
+        print(f"  {k:16s} {v:8.3f}s  {v / total_s:6.1%}")
+    print(f"  {'unattributed':16s} {unattributed:8.3f}s  "
+          f"{unattributed / total_s:6.1%}   (coverage {coverage:.1%})")
+
+
+def run_actor_storm(n: int = 200):
+    """Attribute an actor storm's wall clock to spawn-path phases.
+
+    The hostd's fork/boot spans carry no trace context (no task is
+    active while a worker spawns), so instead of state.spans(tid) the
+    whole cluster event stream for the storm window is scraped and
+    paired; the union sweep then charges the window across fork, boot,
+    first ping and the driver-side lease/dispatch phases."""
+    ray_tpu.init(
+        num_cpus=2, object_store_memory=256 << 20,
+        _system_config={"events_ring_size": 1 << 18,
+                        "max_workers_per_node": max(600, n + 50)})
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return os.getpid()
+
+    with tracing.trace("actor_storm_attrib"):
+        t0 = time.time()
+        actors = [Pinger.remote() for _ in range(n)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+        t1 = time.time()
+    total_s = t1 - t0
+    print(f"actor_storm(traced): {n} actors created+pinged in "
+          f"{total_s:.2f}s ({n / total_s:.1f}/s)")
+    time.sleep(1.5)                                     # let rings settle
+
+    evs = state.events(since=t0 - 1.0)
+    table, _roots = state.build_spans(evs)
+    flat = list(table.values())
+    phases, unattributed = attribute(flat, t0, t1,
+                                     priority=ACTOR_PHASE_PRIORITY)
+    phases = {ACTOR_RELABEL.get(k, k): v for k, v in phases.items()}
+    coverage = 1.0 - unattributed / total_s
+    ranked = sorted(((k, v) for k, v in phases.items() if v > 0),
+                    key=lambda kv: -kv[1])
+    doc = {
+        "n": n,
+        "wall_clock_s": round(total_s, 3),
+        "create_rate_per_s": round(n / total_s, 1),
+        "spans_observed": len(flat),
+        "phases_s": {k: round(v, 3) for k, v in ranked},
+        "phases_frac": {k: round(v / total_s, 4) for k, v in ranked},
+        "top_phases": [k for k, _ in ranked[:3]],
+        "unattributed_s": round(unattributed, 3),
+        "coverage": round(coverage, 4),
+    }
+    _report(ranked, total_s, unattributed, coverage)
+    _write({"actor_storm": doc})
+    ray_tpu.shutdown()
+    # Spawn-path phases MUST be visible — that is this mode's point.
+    # Coverage is reported but not gated at 0.9: parked-lease park time
+    # on the hostd side is intentionally unspanned.
+    have = set(doc["phases_s"])
+    missing = {"zygote_fork", "first_ping"} - have
+    assert not missing, f"spawn-path phases absent from attribution: {missing}"
 
 
 def main():
@@ -136,20 +240,14 @@ def main():
         "unattributed_s": round(unattributed, 3),
         "coverage": round(coverage, 4),
     }
-    for k, v in ranked:
-        print(f"  {k:12s} {v:8.3f}s  {v / total_s:6.1%}")
-    print(f"  {'unattributed':12s} {unattributed:8.3f}s  "
-          f"{unattributed / total_s:6.1%}   (coverage {coverage:.1%})")
-
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SCALE_ATTRIB.json")
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=2)
-        f.write("\n")
-    print(f"wrote {path}")
+    _report(ranked, total_s, unattributed, coverage)
+    _write(doc)
     ray_tpu.shutdown()
     assert coverage >= 0.9, f"attribution coverage {coverage:.1%} < 90%"
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "actor_storm":
+        run_actor_storm(int(sys.argv[2]) if len(sys.argv) > 2 else 200)
+    else:
+        main()

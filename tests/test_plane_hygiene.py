@@ -65,6 +65,25 @@ def test_imperative_begins_have_ends():
         f"files that begin spans but never end any: {offenders}"
 
 
+def test_control_plane_span_kinds_present():
+    """The batched control plane (PR 14) is attributable only because
+    these spans exist: scale_attrib's actor_storm mode needs the spawn
+    path (fork/boot), `cli analyze` needs gcs/flush, and the batched
+    lease/dispatch path keeps the PR 11 per-task kinds.  Losing any of
+    them silently blinds the attribution tooling, so pin them here."""
+    sites = {(pl, k) for _, _, pl, k in _call_sites()}
+    required = {
+        ("sched", "zygote_fork"),   # hostd: batched fork via the zygote
+        ("sched", "worker_boot"),   # hostd: fork -> worker_ready
+        ("gcs", "flush"),           # gcs: coalesced write_rows commit
+        ("sched", "lease_wait"),    # driver: one per (batched) lease RPC
+        ("sched", "dispatch"),      # driver: still one per task
+        ("sched", "inflight"),      # driver: shipped -> push completion
+    }
+    missing = required - sites
+    assert not missing, f"control-plane span kinds vanished: {missing}"
+
+
 def test_span_kinds_do_not_collide_with_instant_kinds():
     """One (plane, kind) must be either always-instant or always-span:
     build_breakdown keys phases by (plane, kind), so a mixed kind would

@@ -121,3 +121,103 @@ def test_dependency_chain_on_cold_workers_no_deadlock(cluster):
     out = ray_tpu.get(chains + [deep], timeout=120)
     assert out[:12] == [10.0 * (i + 1) for i in range(12)]
     assert out[-1] == 7.0
+
+
+def test_batched_dispatch_semantics(cluster):
+    """Batched lease grants amortize the control plane without changing
+    task semantics: a burst of N same-key tasks costs far fewer GRANTED
+    lease RPCs than N (each RPC carries a count and may grant several
+    workers in one reply), every task keeps its own result or error,
+    and the trace still carries one dispatch + one exec span per task."""
+    import time
+
+    from ray_tpu import state
+    from ray_tpu.exceptions import TaskError
+    from ray_tpu.util import events as ev
+    from ray_tpu.util import tracing
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    @ray_tpu.remote
+    def batched(i):
+        if i == 13:
+            raise ValueError(f"task {i} boom")
+        return i * 2
+
+    n = 64
+    batch = max(1, GLOBAL_CONFIG.sched_batch_max)
+    # Quiesce: leases held over from earlier tests in this module would
+    # serve the burst without a single new lease RPC (reuse is the
+    # point of the pool, but this test must observe acquisition).  Held
+    # leases are returned after lease_idle_ttl_s of idleness.
+    time.sleep(GLOBAL_CONFIG.lease_idle_ttl_s + 1.5)
+    t0 = time.time()
+    with tracing.trace("batched_dispatch") as tid:
+        refs = [batched.remote(i) for i in range(n)]
+        # Per-task errors: exactly the poisoned task fails, nobody else.
+        with pytest.raises(TaskError, match="task 13 boom"):
+            ray_tpu.get(refs[13], timeout=60)
+        got = ray_tpu.get(refs[:13] + refs[14:], timeout=120)
+    assert got == [i * 2 for i in range(n) if i != 13]
+
+    # Lease amortization: the driver ring records one sched/lease_wait
+    # span per LeaseWorker RPC.  Count the granted ones (busy probes
+    # while the queue drains through held leases are retried/swallowed
+    # and don't grant anything).
+    rec = ev.get_recorder()
+    assert rec is not None
+    ends = [e for e in rec.snapshot(since=t0, plane="sched",
+                                    kind="lease_wait")
+            if (e["payload"] or {}).get("ph") == "E"
+            and (e["payload"] or {}).get("granted")]
+    assert ends, "no granted lease RPC recorded"
+    # A hard ceil(n / batch) bound would be wrong twice over: the 4-CPU
+    # node caps any one reply at 4 grants, and an idle lease returned
+    # mid-run re-leases through an extra granted RPC under CPU
+    # contention.  What batching actually guarantees: granted-RPC count
+    # is a function of lease churn (leases are reused task after task),
+    # not of task count — far fewer RPCs than tasks.
+    assert len(ends) <= n // 4, (
+        f"{len(ends)} granted lease RPCs for {n} tasks (batch={batch})")
+
+    # The multi-grant reply itself is checked deterministically against
+    # the hostd: the e2e burst above may legitimately satisfy itself
+    # with count=1 requests whenever the pump keeps pace with the
+    # submit loop, so observing a batched grant there is a race.  One
+    # LeaseWorker RPC carrying count=3 on a quiesced node must collect
+    # several workers in a single reply.
+    from ray_tpu import api as _api
+
+    cw = _api._worker
+    # Quiesce for real: the driver's reaper returns idle leases lazily
+    # (spread over a few ticks past the TTL), so poll the hostd's
+    # worker table until no lease is held instead of sleeping a guess.
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        table = cw.io.run(cw.pool.get(cw.hostd_address).call(
+            "NodeManager", "ListWorkers", {}))
+        if not any(w["state"] == "leased" for w in table["workers"]):
+            break
+        time.sleep(0.2)
+    reply = cw.io.run(cw.pool.get(cw.hostd_address).call(
+        "NodeManager", "LeaseWorker",
+        {"resources": {"CPU": 1}, "job_id": cw._job_int(),
+         "runtime_env": None, "count": 3}, timeout=60))
+    try:
+        assert reply.get("granted"), reply
+        assert len(reply.get("grants", [])) >= 2, (
+            f"count=3 lease reply carried "
+            f"{len(reply.get('grants', []))} grant(s)")
+    finally:
+        for g in reply.get("grants", []):
+            cw.io.run(cw.pool.get(cw.hostd_address).call(
+                "NodeManager", "ReturnWorker",
+                {"lease_id": g["lease_id"]}))
+
+    # Trace integrity: batching must not merge per-task spans.
+    time.sleep(0.5)
+    tree = state.spans(tid)
+    kinds = {}
+    for rec_ in tree["spans"]:
+        kinds[rec_["kind"]] = kinds.get(rec_["kind"], 0) + 1
+    assert kinds.get("dispatch", 0) == n
+    assert kinds.get("task", 0) == n
