@@ -1,0 +1,196 @@
+"""MPMD pipeline benchmark: bubble fraction + tokens/s vs the dryrun.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Runs the same 4-stage tanh-MLP pipeline two ways on the same microbatch
+schedule:
+
+- **dryrun** — the single-program GPipe schedule in
+  `parallel/pipeline.py` (ppermute rotation inside one XLA program over
+  a `stage=4` mesh of forced-host CPU devices);
+- **mpmd** — the fault-tolerant MPMD trainer
+  (`train/pipeline_trainer.py`): one actor gang per stage, activations
+  crossing stages as objects over the shm transfer plane.
+
+The headline number is forward tokens/s for MPMD with `vs_baseline` the
+ratio over the dryrun; the MPMD train-step bubble fraction (from full
+1F1B fwd+bwd+update steps) and the fwd-loss parity check ride along.
+
+Honesty notes (single host): every "stage" here is a process on ONE
+machine, so the dryrun's ppermute is a memcpy and the MPMD transfer
+plane is shm-to-shm — neither pays real ICI/DCN latency, and the
+dryrun's whole-schedule XLA fusion gives it an advantage that shrinks
+with real per-stage compute.  The MPMD path's value on this box is the
+robustness contract (per-stage restart), not throughput; treat the
+ratio as overhead accounting, not a scaling claim.
+
+Each mode runs in a fresh interpreter: the dryrun needs
+XLA_FLAGS=--xla_force_host_platform_device_count=8 set before jax
+imports, and the MPMD mode must not inherit 8 fake devices per stage
+worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+D = 32
+N_STAGES = 4
+SEED = 7
+
+
+def _params(n_micro, micro_b):
+    import numpy as np
+    rng = np.random.default_rng(SEED)
+    params = [{"w": rng.normal(0, 0.3, (D, D)), "b": np.zeros(D)}
+              for _ in range(N_STAGES)]
+    xs = [rng.normal(size=(micro_b, D)) for _ in range(n_micro)]
+    ts = [rng.normal(size=(micro_b, D)) * 0.1 for _ in range(n_micro)]
+    return params, xs, ts
+
+
+def _run_dryrun(args):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.parallel import (MeshConfig, create_mesh,
+                                  pipeline_loss_dryrun, stack_stage_params)
+
+    params, xs, ts = _params(args.n_micro, args.micro_batch)
+    mesh = create_mesh(MeshConfig(data=2, stage=N_STAGES))
+    stacked = stack_stage_params(
+        [{"w": jnp.asarray(p["w"]), "b": jnp.asarray(p["b"])}
+         for p in params])
+    mb = jnp.asarray(np.stack(xs))
+    tg = jnp.asarray(np.stack(ts))
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    fn = jax.jit(lambda sp, m, t: pipeline_loss_dryrun(
+        stage_fn, loss_fn, mesh, sp, m, t))
+    loss = float(fn(stacked, mb, tg))            # compile + parity value
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        fn(stacked, mb, tg).block_until_ready()
+    wall = time.perf_counter() - t0
+    rows = args.reps * args.n_micro * args.micro_batch
+    return {"loss": loss, "fwd_tokens_per_s": rows / wall,
+            "wall_s": wall}
+
+
+def _run_mpmd(args):
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.train import PipelineTrainer, jax_stage_fns
+
+    def stage_fn(p, x):
+        import jax.numpy as jnp
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y, t):
+        import jax.numpy as jnp
+        return jnp.mean((y - t) ** 2)
+
+    params, xs, ts = _params(args.n_micro, args.micro_batch)
+    ray_tpu.init(num_cpus=N_STAGES + 2, object_store_memory=256 << 20)
+    tr = PipelineTrainer(
+        jax_stage_fns(stage_fn, loss_fn), params, lr=0.05,
+        n_microbatches=args.n_micro, schedule="1f1b",
+        queue_depth=args.queue_depth)
+    loss = tr.forward_only(xs, ts)               # warm workers + parity
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        tr.forward_only(xs, ts)
+    wall = time.perf_counter() - t0
+    rows = args.reps * args.n_micro * args.micro_batch
+
+    hist = tr.fit(lambda step: (xs, ts), args.train_steps)
+    bubble = float(np.mean([h["bubble_fraction"] for h in hist]))
+    step_s = float(np.mean([h["wall_s"] for h in hist]))
+    tr.shutdown()
+    ray_tpu.shutdown()
+    return {"loss": loss, "fwd_tokens_per_s": rows / wall, "wall_s": wall,
+            "bubble_fraction": bubble, "train_step_s": step_s}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-micro", type=int, default=8)
+    ap.add_argument("--micro-batch", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--train-steps", type=int, default=5)
+    ap.add_argument("--queue-depth", type=int, default=2)
+    ap.add_argument("--mode", choices=["dryrun", "mpmd"], default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.mode == "dryrun":
+        print(json.dumps(_run_dryrun(args)))
+        return
+    if args.mode == "mpmd":
+        print(json.dumps(_run_mpmd(args)))
+        return
+
+    def run(mode):
+        cmd = [sys.executable, os.path.abspath(__file__), "--mode", mode,
+               "--n-micro", str(args.n_micro),
+               "--micro-batch", str(args.micro_batch),
+               "--reps", str(args.reps),
+               "--train-steps", str(args.train_steps),
+               "--queue-depth", str(args.queue_depth)]
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=600)
+        if p.returncode != 0:
+            raise SystemExit(f"{mode} mode failed:\n{p.stderr[-2000:]}")
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    dryrun = run("dryrun")
+    mpmd = run("mpmd")
+
+    # The loss-exactness gate: same params, same schedule, same math.
+    drift = abs(mpmd["loss"] - dryrun["loss"])
+    tol = 1e-5 * max(1.0, abs(dryrun["loss"]))
+    if drift > tol:
+        raise SystemExit(
+            f"MPMD loss {mpmd['loss']} != dryrun loss {dryrun['loss']} "
+            f"(drift {drift:.3e} > tol {tol:.3e})")
+
+    print(json.dumps({
+        "metric": "pp_mpmd_fwd_tokens_per_s",
+        "value": round(mpmd["fwd_tokens_per_s"], 1),
+        "unit": "rows_per_s",
+        "vs_baseline": round(mpmd["fwd_tokens_per_s"]
+                             / max(dryrun["fwd_tokens_per_s"], 1e-9), 4),
+        "dryrun_fwd_tokens_per_s": round(dryrun["fwd_tokens_per_s"], 1),
+        "bubble_fraction": round(mpmd["bubble_fraction"], 4),
+        "train_step_s": round(mpmd["train_step_s"], 4),
+        "loss_mpmd": mpmd["loss"],
+        "loss_dryrun": dryrun["loss"],
+        "loss_drift": drift,
+        "stages": N_STAGES,
+        "n_micro": args.n_micro,
+        "micro_batch": args.micro_batch,
+        "schedule": "1f1b",
+        "single_host_caveat": "all stages on one machine; shm transfers, "
+                              "no ICI/DCN — overhead accounting, not a "
+                              "scaling claim",
+    }))
+
+
+if __name__ == "__main__":
+    main()

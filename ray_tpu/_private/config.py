@@ -272,6 +272,13 @@ _D("chaos_kill_worker_at", 0, int,
    "task-execution index at which a scripted worker kill fires")
 _D("chaos_kill_hostd", 0.0, float,
    "probability hostd kills itself at a heartbeat tick")
+_D("chaos_kill_hostd_salts", "", str,
+   "scripted hostd kills: csv of hostd spawn ordinals ('h1', 'h2', ... "
+   "as stamped by node.start_hostd; or '*' for any non-head hostd) that "
+   "die at their chaos_kill_hostd_at-th heartbeat tick (see "
+   "fault_injection.ChaosController.kill_hostd)")
+_D("chaos_kill_hostd_at", 0, int,
+   "heartbeat tick ordinal at which the scripted hostd kill fires")
 _D("chaos_ckpt_kill", 0.0, float,
    "probability the checkpoint writer kills its process right before the "
    "COMMIT rename (data fully written, directory left torn)")

@@ -199,10 +199,47 @@ class ChaosController:
             return False
         return self.should("serve", cfg.chaos_kill_replica, "kill")
 
-    def kill_hostd(self) -> bool:
-        """Kill this node daemon at the next heartbeat."""
-        return self.should(
-            "hostd", GLOBAL_CONFIG.chaos_kill_hostd, "kill")
+    def kill_hostd(self, is_head: bool = False) -> bool:
+        """Kill this node daemon at a heartbeat tick.
+
+        Two modes, like the serve/ckpt/preempt planes:
+
+        - scripted: `chaos_kill_hostd_salts` lists hostd spawn ordinals
+          ("h1", "h2", ... as stamped by node.start_hostd, or ``*`` for
+          any non-head hostd); a listed hostd dies at exactly its
+          `chaos_kill_hostd_at`-th heartbeat tick — the deterministic
+          way to lose one specific node of a multi-node cluster at a
+          known instant (the pipeline-under-node-loss gate).  A salt
+          match targets the named hostd even if it is the head; the
+          ``*`` wildcard never hits the head (killing the colocated GCS
+          just ends the test).  Respects `chaos_max_faults` so a
+          respawned/replacement hostd cannot re-fire forever.
+        - probabilistic: `chaos_kill_hostd` per tick, never on the head.
+
+        The tick ordinal advances on every call in both modes and on
+        head nodes too, so one (seed, salt) schedule reads the same
+        whichever mode is active.
+        """
+        cfg = GLOBAL_CONFIG
+        salts = str(cfg.chaos_kill_hostd_salts or "")
+        if salts:
+            listed = ((salts.strip() == "*" and not is_head)
+                      or (self.salt and self.salt in
+                          [s.strip() for s in salts.split(",")]))
+            with self._lock:
+                n = self._next_index("hostd")
+                if (listed and n == int(cfg.chaos_kill_hostd_at)
+                        and not (self.max_faults
+                                 and self._faults >= self.max_faults)):
+                    self._faults += 1
+                    self.schedule.append(("hostd", n, "kill"))
+                    return True
+            return False
+        if is_head:
+            with self._lock:
+                self._next_index("hostd")
+            return False
+        return self.should("hostd", cfg.chaos_kill_hostd, "kill")
 
     def preempt_hostd(self, is_head: bool) -> bool:
         """Inject a preemption NOTICE at a hostd heartbeat tick — the

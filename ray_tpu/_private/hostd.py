@@ -1566,8 +1566,7 @@ class NodeDaemon:
         last_ok = time.monotonic()
         while not self._shutdown.is_set():
             chaos = get_chaos()
-            if chaos is not None and not self.is_head \
-                    and chaos.kill_hostd():
+            if chaos is not None and chaos.kill_hostd(self.is_head):
                 # Injected node failure: die like a preempted host — no
                 # cleanup, no dereg.  The GCS health loop declares the
                 # node dead after node_death_timeout_s and fails over its

@@ -96,10 +96,15 @@ def start_gcs(session_dir: str, group: ProcessGroup, host="127.0.0.1",
     return f"{host}:{port}"
 
 
+_hostd_spawn_seq = 0
+
+
 def start_hostd(gcs_address: str, session_dir: str, group: ProcessGroup,
                 *, num_cpus=None, num_tpus=None, resources=None,
                 store_capacity=256 << 20, head=False,
                 host="127.0.0.1") -> dict:
+    global _hostd_spawn_seq
+    _hostd_spawn_seq += 1
     ready = os.path.join(session_dir, f"hostd_ready_{uuid.uuid4().hex[:6]}")
     log = open(os.path.join(session_dir, "logs",
                             f"hostd_{uuid.uuid4().hex[:6]}.err"), "ab")
@@ -115,7 +120,12 @@ def start_hostd(gcs_address: str, session_dir: str, group: ProcessGroup,
         cmd += ["--resources", ",".join(f"{k}={v}" for k, v in resources.items())]
     if head:
         cmd.append("--head")
-    proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=_daemon_env())
+    env = _daemon_env()
+    # Hostd chaos identity: scripted node-loss scenarios name a hostd by
+    # its spawn ordinal ("h1", "h2", ...).  The "h" prefix keeps hostd
+    # salts disjoint from the worker spawn ordinals hostd itself stamps.
+    env["RAY_TPU_CHAOS_PROC_SALT"] = f"h{_hostd_spawn_seq}"
+    proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=env)
     group.procs.append(proc)
     port, node_id, store_path = _wait_ready_file(
         ready, proc, what="hostd").strip().split("\n")

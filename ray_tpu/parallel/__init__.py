@@ -22,4 +22,8 @@ from ray_tpu.parallel.sharding import (  # noqa: F401
     tree_shardings,
     with_logical_constraint,
 )
-from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from ray_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_loss_dryrun,
+    stack_stage_params,
+)

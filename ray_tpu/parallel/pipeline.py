@@ -105,3 +105,21 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
 def stack_stage_params(per_stage_params: list) -> Any:
     """Stack a list of per-stage param pytrees along a new leading dim."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_loss_dryrun(stage_fn: Callable, loss_fn: Callable,
+                         mesh: Mesh, stage_params: Any,
+                         microbatches: jax.Array, targets: jax.Array,
+                         axis: str = "stage") -> jax.Array:
+    """Mean microbatch loss of the single-program GPipe dryrun — the
+    reference value the MPMD trainer (train/pipeline_trainer.py) must
+    match to fp tolerance on the same schedule (the standing parity
+    gate, tests/test_pipeline_mpmd.py).
+
+    `loss_fn(y, target) -> scalar` is applied per microbatch to the
+    final stage's outputs; `targets` has the same [n_micro, ...] leading
+    layout as `microbatches`."""
+    outputs = pipeline_apply(stage_fn, mesh, stage_params, microbatches,
+                             axis=axis)
+    losses = jax.vmap(loss_fn)(outputs, targets)
+    return jnp.mean(losses)

@@ -37,3 +37,11 @@ from ray_tpu.train.session import (  # noqa: F401
     report,
 )
 from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup  # noqa: F401
+from ray_tpu.train.pipeline_stage import (  # noqa: F401
+    PipelineStageActor,
+    StageGroup,
+)
+from ray_tpu.train.pipeline_trainer import (  # noqa: F401
+    PipelineTrainer,
+    jax_stage_fns,
+)

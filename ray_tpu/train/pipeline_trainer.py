@@ -1,0 +1,589 @@
+"""MPMD pipeline-parallel trainer: the driver-side schedule pump.
+
+`PipelineTrainer` maps each pipeline stage to its own `StageGroup` (an
+actor gang under its own placement group — see
+`train/pipeline_stage.py`), then runs 1F1B or GPipe microbatch schedules
+by pumping at most one compute op per gang member and letting activation
+and gradient ObjectRefs flow stage-to-stage over the native object
+plane.  The driver only ever fetches the small `meta` half of each
+`num_returns=2` stage call; the payload ref is handed to the next stage
+wrapped in a tuple so the bytes move shm-to-shm.
+
+Backpressure: a stage may run at most `queue_depth` microbatches ahead
+of its downstream consumer, and 1F1B additionally caps stage *i* at
+``n_stages - i`` forwards not yet backward-ed (the classic warmup
+depth), so queue growth is bounded and a stalled stage stalls its
+upstream instead of ballooning the store.
+
+Failure semantics (the headline):
+
+- a dead gang member marks its whole stage dead (params are replicated
+  but grad contributions are member-local); the stage re-forms in place
+  via `StageGroup.reform()` — fresh PG, fresh actors through the zygote
+  spawn path, params from the stage's latest COMMITTED checkpoint;
+- if the restored version equals the in-flight step, recovery is
+  *surgical*: only the dead stage's microbatches replay, re-fed from the
+  upstream stage's sealed activations and the downstream stage's sealed
+  grads (the node store outlives workers, so those refs stay readable);
+  surviving stages never restart and never recompute;
+- if the re-formed stage restored a *newer* version (it died after
+  applying + committing the step), it is marked applied and skips the
+  boundary;
+- anything else — or a recovery that finds no dead stage (e.g. objects
+  lost with a hostd) — falls back to a global rollback: every stage
+  loads the newest checkpoint step committed by *all* stages (survivors
+  load in place, without restarting), and `fit` resumes from there.
+
+All recoveries count against `max_failures`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu.train.pipeline_stage import StageGroup
+
+_M = None
+
+
+def _metrics():
+    global _M
+    if _M is None:
+        from ray_tpu.util import metrics as mt
+        _M = {
+            "bubble": mt.Histogram(
+                "pp_bubble_fraction",
+                "per-step pipeline bubble fraction: 1 - busy/(members*wall)",
+                buckets=(0.01, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7,
+                         0.8, 0.9, 1.0)),
+            "recoveries": mt.Counter(
+                "pp_recoveries",
+                "per-stage pipeline recoveries by kind",
+                tag_keys=("kind",)),
+            "step": mt.Histogram(
+                "pp_step_seconds", "pipeline train-step wall clock"),
+        }
+    return _M
+
+
+def jax_stage_fns(stage_fn: Callable, loss_fn: Callable):
+    """Build the (stage_fwd, stage_bwd, loss_fwd, loss_bwd) quartet from
+    a jax ``stage_fn(params, x) -> y`` / ``loss_fn(y, target) -> scalar``
+    pair via ``jax.vjp``.  The vjp closures live only inside the stage
+    worker (caches are never shipped), and outputs cross stages as numpy.
+    jax is imported lazily so numpy-only pipelines never pay for it."""
+
+    def stage_fwd(params, x):
+        import jax
+        y, vjp = jax.vjp(stage_fn, params, x)
+        return np.asarray(y), vjp
+
+    def stage_bwd(params, vjp, gy):
+        import jax.numpy as jnp
+        gparams, gx = vjp(jnp.asarray(gy))
+        import jax
+        return np.asarray(gx), jax.tree.map(np.asarray, gparams)
+
+    def loss_fwd(y, target):
+        import jax
+        loss, vjp = jax.vjp(loss_fn, y, target)
+        return float(loss), vjp
+
+    def loss_bwd(vjp):
+        gy, _gt = vjp(1.0)
+        return np.asarray(gy)
+
+    return stage_fwd, stage_bwd, loss_fwd, loss_bwd
+
+
+class _StageFailure(Exception):
+    """Internal: a stage op failed; recovery should run."""
+
+    def __init__(self, stage: int, reason: str):
+        super().__init__(f"stage {stage}: {reason}")
+        self.stage = stage
+        self.reason = reason
+
+
+class _Rollback(Exception):
+    """Internal: global rollback to `step` (all stages reloaded)."""
+
+    def __init__(self, step: int):
+        super().__init__(f"rollback to step {step}")
+        self.step = step
+
+
+class _Op:
+    __slots__ = ("stage", "member", "kind", "mb", "t")
+
+    def __init__(self, stage, member, kind, mb):
+        self.stage = stage
+        self.member = member
+        self.kind = kind
+        self.mb = mb
+        self.t = time.monotonic()
+
+
+class _StepState:
+    """Driver-side bookkeeping for one train step's schedule pump."""
+
+    def __init__(self, n_stages: int, n_micro: int):
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.fwd_disp = [set() for _ in range(n_stages)]
+        self.fwd_done = [set() for _ in range(n_stages)]
+        self.bwd_disp = [set() for _ in range(n_stages)]
+        self.bwd_done = [set() for _ in range(n_stages)]
+        self.busy: List[Dict[int, Any]] = [dict() for _ in range(n_stages)]
+        self.act: List[Dict[int, Any]] = [dict() for _ in range(n_stages)]
+        self.gout: List[Dict[int, Any]] = [dict() for _ in range(n_stages)]
+        self.losses: Dict[int, float] = {}
+        self.pending: Dict[Any, _Op] = {}
+        self.applied = [False] * n_stages
+
+    def reset_stage(self, i: int):
+        """Forget stage i's schedule progress (its gang re-formed with
+        empty caches): every microbatch replays through stage i, nothing
+        else changes.  Refs the stage produced earlier stay in act/gout
+        maps until the replay overwrites them — consumers that already
+        fetched them are unaffected (sealed objects are immutable)."""
+        self.fwd_disp[i] = set()
+        self.fwd_done[i] = set()
+        self.bwd_disp[i] = set()
+        self.bwd_done[i] = set()
+        self.busy[i] = {}
+        self.applied[i] = False
+        self.pending = {r: op for r, op in self.pending.items()
+                        if op.stage != i}
+
+    def compute_done(self) -> bool:
+        return all(self.applied[i]
+                   or len(self.bwd_done[i]) == self.n_micro
+                   for i in range(self.n_stages))
+
+
+class PipelineTrainer:
+    """Fault-tolerant MPMD pipeline-parallel SGD trainer.
+
+    Args:
+      stage_fns: (stage_fwd, stage_bwd, loss_fwd, loss_bwd) — see
+        `pipeline_stage` module docs, or build from jax via
+        `jax_stage_fns`.
+      stage_params: list of per-stage param pytrees (numpy leaves);
+        one entry per pipeline stage.
+      n_microbatches: microbatches per global step.
+      schedule: "1f1b" (bwd-first, bounded warmup) or "gpipe"
+        (all-fwd-then-bwd).
+      queue_depth: max microbatches a stage may run ahead of its
+        downstream consumer (the inter-stage queue bound).
+      workers_per_stage: gang size per stage (data parallel within a
+        stage; microbatch j lands on member j % gang at every stage).
+      storage_path: checkpoint root; per-stage trees commit under
+        `<root>/stage_XX`.  None disables checkpointing (and therefore
+        restart recovery — only surgical replay works).
+      ckpt_every: commit per-stage checkpoints every k steps.
+      max_failures: recoveries allowed across the fit before giving up.
+      stage_timeout_s: op-completion watchdog; an op outstanding this
+        long triggers a gang beacon probe.
+    """
+
+    def __init__(self, stage_fns: Tuple[Callable, Callable, Callable,
+                                        Callable],
+                 stage_params: List[Any], *, lr: float = 0.05,
+                 n_microbatches: int = 8, schedule: str = "1f1b",
+                 queue_depth: int = 2, workers_per_stage: int = 1,
+                 resources_per_worker: Optional[dict] = None,
+                 storage_path: Optional[str] = None, ckpt_every: int = 1,
+                 max_failures: int = 2, stage_timeout_s: float = 30.0,
+                 placement_strategy: str = "PACK",
+                 pg_timeout_s: float = 60.0):
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self.n_stages = len(stage_params)
+        self.n_micro = int(n_microbatches)
+        self.schedule = schedule
+        self.queue_depth = max(1, int(queue_depth))
+        self.gang = max(1, int(workers_per_stage))
+        self.max_failures = int(max_failures)
+        self.stage_timeout_s = float(stage_timeout_s)
+        self.ckpt_every = max(1, int(ckpt_every))
+        self.storage_path = storage_path
+        self._recoveries = 0
+        self.history: List[dict] = []
+        fwd, bwd, loss_fwd, loss_bwd = stage_fns
+        self.groups: List[StageGroup] = []
+        try:
+            for i, params in enumerate(stage_params):
+                root = ""
+                if storage_path:
+                    import os
+                    root = os.path.join(storage_path, f"stage_{i:02d}")
+                spec = {"stage": i, "n_stages": self.n_stages,
+                        "stage_fwd": fwd, "stage_bwd": bwd,
+                        "loss_fwd": loss_fwd, "loss_bwd": loss_bwd,
+                        "params": params, "lr": lr, "ckpt_root": root}
+                self.groups.append(StageGroup(
+                    i, spec, self.gang,
+                    resources_per_worker or {"CPU": 1},
+                    placement_strategy=placement_strategy,
+                    pg_timeout_s=pg_timeout_s))
+        except BaseException:
+            self.shutdown()
+            raise
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def _member(self, mb: int) -> int:
+        return mb % self.gang
+
+    def _fwd_ready(self, st: _StepState, i: int, mb: int) -> bool:
+        # Gate on the producer op having COMPLETED (activation sealed in
+        # the node store), not on the ref existing: a dispatch-time ref
+        # whose producer died unexecuted would feed the consumer a
+        # poisoned object.
+        if i == 0:
+            return True
+        return mb in st.fwd_done[i - 1]
+
+    def _bwd_ready(self, st: _StepState, i: int, mb: int) -> bool:
+        if mb not in st.fwd_done[i]:
+            return False
+        if i == self.n_stages - 1:
+            return True
+        return mb in st.bwd_done[i + 1]
+
+    def _next_mb(self, disp: set, member: int) -> Optional[int]:
+        for j in range(self.n_micro):
+            if j not in disp and self._member(j) == member:
+                return j
+        return None
+
+    def _fwd_window_ok(self, st: _StepState, i: int) -> bool:
+        if self.schedule == "1f1b":
+            warmup = max(1, self.n_stages - i)
+            if len(st.fwd_disp[i]) - len(st.bwd_done[i]) >= warmup:
+                return False
+        if i + 1 < self.n_stages:
+            # Bounded inter-stage queue: don't outrun the consumer.
+            ahead = len(st.fwd_done[i]) - len(st.fwd_done[i + 1])
+            if ahead >= self.queue_depth:
+                return False
+        return True
+
+    def _dispatch(self, step: int, st: _StepState, mbs, tgts):
+        last = self.n_stages - 1
+        for i, grp in enumerate(self.groups):
+            if st.applied[i]:
+                continue
+            for m, actor in enumerate(grp.members):
+                if m in st.busy[i]:
+                    continue
+                jb = self._next_mb(st.bwd_disp[i], m)
+                jf = self._next_mb(st.fwd_disp[i], m)
+                do_bwd = (jb is not None and self._bwd_ready(st, i, jb))
+                do_fwd = (jf is not None and self._fwd_ready(st, i, jf)
+                          and self._fwd_window_ok(st, i))
+                if self.schedule == "gpipe" and do_fwd:
+                    do_bwd = False      # all forwards drain first
+                if do_bwd:
+                    gyw = None if i == last else ((st.gout[i + 1][jb],))
+                    meta, gx = actor.backward.options(
+                        num_returns=2).remote(step, jb, gyw)
+                    st.gout[i][jb] = gx
+                    st.bwd_disp[i].add(jb)
+                    st.busy[i][m] = meta
+                    st.pending[meta] = _Op(i, m, "bwd", jb)
+                elif do_fwd:
+                    xw = (mbs[jf],) if i == 0 else ((st.act[i - 1][jf],))
+                    tw = (tgts[jf],) if i == last else None
+                    meta, y = actor.forward.options(
+                        num_returns=2).remote(step, jf, xw, tw)
+                    if i != last:
+                        st.act[i][jf] = y
+                    st.fwd_disp[i].add(jf)
+                    st.busy[i][m] = meta
+                    st.pending[meta] = _Op(i, m, "fwd", jf)
+
+    def _poll(self, st: _StepState):
+        """Consume completed op metas; raise _StageFailure on death or
+        on a silent stall past the op watchdog."""
+        if not st.pending:
+            time.sleep(0.005)
+            return
+        ready, _ = ray_tpu.wait(list(st.pending), num_returns=1,
+                                timeout=0.2)
+        for r in ready:
+            op = st.pending.pop(r)
+            st.busy[op.stage].pop(op.member, None)
+            try:
+                meta = ray_tpu.get(r)
+            except (exceptions.ActorError, exceptions.WorkerCrashedError,
+                    exceptions.ObjectLostError,
+                    exceptions.TaskError) as e:
+                # TaskError rides along: under node loss a replayed op
+                # can fetch a ref whose bytes died with the store — the
+                # rollback path, not a user bug (a genuine user error
+                # re-raises once recoveries exhaust max_failures, with
+                # this exception chained as the cause).
+                raise _StageFailure(op.stage, type(e).__name__) from e
+            if op.kind == "fwd":
+                st.fwd_done[op.stage].add(op.mb)
+                if op.stage == self.n_stages - 1:
+                    st.losses[op.mb] = meta["loss"]
+            else:
+                st.bwd_done[op.stage].add(op.mb)
+        if not ready and st.pending:
+            now = time.monotonic()
+            stale = [op for op in st.pending.values()
+                     if now - op.t > self.stage_timeout_s]
+            for op in stale:
+                beacons = self.groups[op.stage].beacons(timeout=5.0)
+                if any(b is None for b in beacons):
+                    raise _StageFailure(op.stage, "beacon_lost")
+                op.t = now      # alive but slow: re-arm the watchdog
+
+    # ------------------------------------------------------------------
+    # recovery
+    # ------------------------------------------------------------------
+
+    def _probe_dead_stages(self) -> List[int]:
+        dead = []
+        for i, grp in enumerate(self.groups):
+            if any(b is None for b in grp.beacons(timeout=5.0)):
+                dead.append(i)
+        return dead
+
+    def _recover(self, step: int, st: _StepState, failure: _StageFailure):
+        """Re-form dead gangs and pick the cheapest sound recovery.
+
+        Raises _Rollback when per-stage surgical replay is not provably
+        sufficient."""
+        from ray_tpu.util import events, spans
+        self._recoveries += 1
+        if self._recoveries > self.max_failures:
+            raise RuntimeError(
+                f"pipeline exceeded max_failures={self.max_failures}"
+            ) from failure
+        with spans.span("pp", "recover", step=step,
+                        reason=failure.reason):
+            dead = self._probe_dead_stages()
+            if failure.stage not in dead:
+                beacons = self.groups[failure.stage].beacons(timeout=5.0)
+                if any(b is None for b in beacons):
+                    dead.append(failure.stage)
+            events.record("pp", "stage_dead", step=step, stages=dead,
+                          reason=failure.reason)
+            if not dead:
+                # The op failed but every gang answers (e.g. an object
+                # was lost with its node): replay lineage is broken, so
+                # fall back to the checkpoint intersection.
+                _metrics()["recoveries"].inc(tags={"kind": "rollback"})
+                self._rollback(step)
+            for i in dead:
+                version = self.groups[i].reform()
+                restored = version if version is not None else 0
+                if restored == step:
+                    # Pre-apply params for the in-flight step: replay
+                    # only this stage's microbatches (surgical).
+                    events.record("pp", "replay", step=step, stage=i,
+                                  n_micro=self.n_micro)
+                    _metrics()["recoveries"].inc(tags={"kind": "replay"})
+                    st.reset_stage(i)
+                elif restored == step + 1:
+                    # Died after apply+commit: nothing to replay and the
+                    # boundary must not re-apply.  Done-sets read full so
+                    # neighbours (which, having reached the boundary,
+                    # already consumed this stage's sealed outputs) never
+                    # wait on it.
+                    _metrics()["recoveries"].inc(
+                        tags={"kind": "already_applied"})
+                    st.reset_stage(i)
+                    full = set(range(self.n_micro))
+                    st.fwd_disp[i] = set(full)
+                    st.fwd_done[i] = set(full)
+                    st.bwd_disp[i] = set(full)
+                    st.bwd_done[i] = set(full)
+                    st.applied[i] = True
+                else:
+                    _metrics()["recoveries"].inc(tags={"kind": "rollback"})
+                    self._rollback(step)
+
+    def _rollback(self, step: int):
+        """Load the newest step committed by ALL stages everywhere (no
+        gang restarts — survivors load in place), then unwind to `fit`."""
+        from ray_tpu.util import events
+        per_stage = []
+        for grp in self.groups:
+            try:
+                steps = ray_tpu.get(
+                    grp.members[0].committed_steps.remote(), timeout=30)
+            except Exception:
+                grp.reform()
+                steps = ray_tpu.get(
+                    grp.members[0].committed_steps.remote(), timeout=30)
+            per_stage.append(set(steps))
+        common = set.intersection(*per_stage) if per_stage else set()
+        target = max(common) if common else None
+        if target is None:
+            # Nothing commonly committed: restart from initial params.
+            for grp in self.groups:
+                grp.shutdown()
+                grp.incarnation += 1
+                grp._form()
+            events.record("pp", "rollback", step=step, to=0)
+            raise _Rollback(0)
+        refs = [a.load_ckpt.remote(target)
+                for grp in self.groups for a in grp.members]
+        ray_tpu.get(refs, timeout=120)
+        events.record("pp", "rollback", step=step, to=target)
+        raise _Rollback(target)
+
+    # ------------------------------------------------------------------
+    # step
+    # ------------------------------------------------------------------
+
+    def _boundary(self, step: int, st: _StepState):
+        """Grad fold + SGD apply + per-stage checkpoint commit, all
+        version-guarded so a mid-boundary death retries cleanly."""
+        partials: Dict[int, list] = {}
+        metas = {}
+        for i, grp in enumerate(self.groups):
+            if st.applied[i]:
+                continue
+            partials[i] = []
+            for a in grp.members:
+                meta, grads = a.partial_grads.options(
+                    num_returns=2).remote(step)
+                partials[i].append(grads)
+                metas[meta] = i
+        for meta, i in metas.items():
+            try:
+                ray_tpu.get(meta, timeout=self.stage_timeout_s)
+            except (exceptions.ActorError, exceptions.WorkerCrashedError,
+                    exceptions.ObjectLostError, exceptions.TaskError,
+                    exceptions.RayTpuTimeoutError) as e:
+                raise _StageFailure(
+                    i, f"partial_grads:{type(e).__name__}") from e
+        apply_refs: Dict[int, list] = {}
+        for i, grp in enumerate(self.groups):
+            if st.applied[i]:
+                continue
+            apply_refs[i] = [a.apply_update.remote(
+                step, partials[i], self.n_micro) for a in grp.members]
+        busy = idle = 0.0
+        for i, refs in apply_refs.items():
+            try:
+                for out in ray_tpu.get(refs, timeout=self.stage_timeout_s):
+                    busy += out.get("busy_s", 0.0)
+                    idle += out.get("idle_s", 0.0)
+            except (exceptions.ActorError, exceptions.WorkerCrashedError,
+                    exceptions.ObjectLostError, exceptions.TaskError,
+                    exceptions.RayTpuTimeoutError) as e:
+                raise _StageFailure(
+                    i, f"apply_update:{type(e).__name__}") from e
+            # This stage's gang fully applied: a boundary retry after a
+            # later stage's death must not re-enter it.
+            st.applied[i] = True
+        if self.storage_path and (step + 1) % self.ckpt_every == 0:
+            saves = {grp.members[0].save_ckpt.remote(step + 1): i
+                     for i, grp in enumerate(self.groups)}
+            for ref, i in saves.items():
+                try:
+                    ray_tpu.get(ref, timeout=90)
+                except (exceptions.ActorError,
+                        exceptions.WorkerCrashedError,
+                        exceptions.TaskError,
+                        exceptions.RayTpuTimeoutError) as e:
+                    raise _StageFailure(
+                        i, f"save_ckpt:{type(e).__name__}") from e
+        return busy, idle
+
+    def _train_step(self, step: int, mbs, tgts) -> dict:
+        from ray_tpu.util import spans
+        st = _StepState(self.n_stages, self.n_micro)
+        t0 = time.monotonic()
+        with spans.span("pp", "step", step=step,
+                        n_micro=self.n_micro):
+            while True:
+                try:
+                    while not st.compute_done():
+                        self._dispatch(step, st, mbs, tgts)
+                        self._poll(st)
+                    busy, idle = self._boundary(step, st)
+                    break
+                except _StageFailure as f:
+                    self._recover(step, st, f)
+        wall = time.monotonic() - t0
+        members = self.n_stages * self.gang
+        bubble = max(0.0, 1.0 - busy / (members * wall)) if wall > 0 \
+            else 0.0
+        _metrics()["bubble"].observe(bubble)
+        _metrics()["step"].observe(wall)
+        loss = (sum(st.losses.values()) / len(st.losses)
+                if st.losses else float("nan"))
+        return {"step": step, "loss": loss, "wall_s": wall,
+                "bubble_fraction": bubble, "busy_s": busy, "idle_s": idle,
+                "recoveries": self._recoveries}
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def fit(self, data_fn: Callable[[int], Tuple[list, list]],
+            num_steps: int) -> List[dict]:
+        """Run `num_steps` pipeline steps.  ``data_fn(step)`` returns
+        (microbatches, targets) — it must be deterministic per step,
+        because a rollback re-requests earlier steps' data."""
+        s = 0
+        while s < num_steps:
+            xs, ts = data_fn(s)
+            if len(xs) != self.n_micro or len(ts) != self.n_micro:
+                raise ValueError(
+                    f"data_fn(step) must return {self.n_micro} "
+                    f"microbatches, got {len(xs)}/{len(ts)}")
+            mbs = [ray_tpu.put(np.asarray(x)) for x in xs]
+            tgts = [ray_tpu.put(np.asarray(t)) for t in ts]
+            try:
+                rec = self._train_step(s, mbs, tgts)
+            except _Rollback as rb:
+                s = rb.step
+                continue
+            self.history.append(rec)
+            s += 1
+        return self.history
+
+    def forward_only(self, xs: list, ts: list) -> float:
+        """One fwd-only pass over the schedule; returns the mean loss.
+        No recovery (parity/bench probe).  Leaves no per-step state."""
+        st = _StepState(self.n_stages, self.n_micro)
+        mbs = [ray_tpu.put(np.asarray(x)) for x in xs]
+        tgts = [ray_tpu.put(np.asarray(t)) for t in ts]
+        # Forward-only wants no bwd dispatch: mark bwd complete up front.
+        for i in range(self.n_stages):
+            st.bwd_disp[i] = set(range(self.n_micro))
+            st.bwd_done[i] = set(range(self.n_micro))
+        while not all(len(st.fwd_done[i]) == self.n_micro
+                      for i in range(self.n_stages)):
+            self._dispatch(0, st, mbs, tgts)
+            self._poll(st)
+        ray_tpu.get([a.reset_step.remote(0)
+                     for g in self.groups for a in g.members], timeout=60)
+        return sum(st.losses.values()) / len(st.losses)
+
+    def stage_idents(self) -> List[List[dict]]:
+        return [list(grp.idents) for grp in self.groups]
+
+    def shutdown(self):
+        for grp in self.groups:
+            try:
+                grp.shutdown()
+            except Exception:
+                pass
+        self.groups = []

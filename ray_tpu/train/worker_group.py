@@ -86,13 +86,18 @@ class WorkerGroup:
         self._pg: Optional[PlacementGroup] = placement_group(
             [dict(resources_per_worker) for _ in range(num_workers)],
             strategy=placement_strategy)
-        if not self._pg.wait(pg_timeout_s):
-            remove_placement_group(self._pg)
-            raise RuntimeError(
-                f"could not reserve {num_workers} x {resources_per_worker} "
-                f"(strategy {placement_strategy}) within {pg_timeout_s:g}s")
         self.workers: List[Worker] = []
+        # Everything after the PG is created runs under the cleanup
+        # umbrella: a raising pg.wait() (GCS hiccup, interrupt) or a
+        # failure anywhere in actor construction must remove the
+        # just-reserved bundles, or repeated elastic restarts leak PG
+        # reservations until the cluster can't place anything.
         try:
+            if not self._pg.wait(pg_timeout_s):
+                raise RuntimeError(
+                    f"could not reserve {num_workers} x "
+                    f"{resources_per_worker} (strategy "
+                    f"{placement_strategy}) within {pg_timeout_s:g}s")
             res = dict(resources_per_worker)
             cpu = res.pop("CPU", 0)
             tpu = res.pop("TPU", None)
@@ -115,9 +120,9 @@ class WorkerGroup:
             for w, nid, pid in zip(self.workers, node_ids, pids):
                 w.node_id = nid
                 w.pid = pid
-        except Exception:
-            # Don't leak the gang's reserved bundles if construction fails
-            # partway (the wait-timeout path above already cleans up).
+        except BaseException:
+            # Don't leak the gang's reserved bundles if construction
+            # fails partway — including the wait-timeout/raise paths.
             self.shutdown()
             raise
 

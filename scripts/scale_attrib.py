@@ -56,6 +56,16 @@ ACTOR_PHASE_PRIORITY = ("zygote_fork", "exec", "arg_fetch", "result_seal",
                         "inflight", "sched_queue", "lease_wait", "submit")
 ACTOR_RELABEL = {"exec": "first_ping", "boot": "worker_main_boot"}
 
+# Pipeline phases, innermost first: a slice where any stage computes is
+# charged to compute; xfer only soaks the inter-stage fetch time no
+# compute covers.  The wrapping pp/step span is deliberately absent —
+# it covers the whole step, so including it would relabel the bubble as
+# driver time; instead whatever no inner pp span covers inside the fit
+# window IS the bubble (schedule gaps + driver pump + stage stall).
+PP_PHASE_PRIORITY = ("stage_fwd", "stage_bwd", "xfer", "apply", "ckpt",
+                     "recover")
+PP_RELABEL = {}
+
 
 def _union(ivals):
     """Merge [(s, e), ...] into disjoint sorted intervals."""
@@ -199,6 +209,105 @@ def run_actor_storm(n: int = 200):
     assert not missing, f"spawn-path phases absent from attribution: {missing}"
 
 
+def _pp_stage_fwd(params, x):
+    import numpy as np
+    y = np.tanh(x @ params["w"] + params["b"])
+    return y, (x, y)
+
+
+def _pp_stage_bwd(params, cache, gy):
+    import numpy as np
+    x, y = cache
+    gz = gy * (1.0 - y * y)
+    return gz @ params["w"].T, {"w": x.T @ gz, "b": gz.sum(axis=0)}
+
+
+def _pp_loss_fwd(y, t):
+    d = y - t
+    return float((d * d).mean()), (d, y.size)
+
+
+def _pp_loss_bwd(cache):
+    d, n = cache
+    return 2.0 * d / n
+
+
+def run_pipeline(steps: int = 6, stages: int = 4, n_micro: int = 8,
+                 micro_batch: int = 64, width: int = 256):
+    """Attribute an MPMD pipeline fit's wall clock to pp phases.
+
+    Stage workers record pp/stage_fwd, pp/stage_bwd, pp/xfer and the
+    update-boundary spans without a trace context, so (like actor_storm)
+    the whole cluster event stream for the fit window is scraped and
+    union-swept.  The leftover inside the window is the bubble the
+    schedule could not fill (plus driver pump overhead not under any
+    span), reported next to the metrics-side per-step bubble fraction.
+    """
+    import numpy as np
+
+    from ray_tpu.train import PipelineTrainer
+
+    ray_tpu.init(
+        num_cpus=stages + 2, object_store_memory=256 << 20,
+        _system_config={"events_ring_size": 1 << 18})
+    rng = np.random.default_rng(0)
+    params = [{"w": rng.normal(0, 0.3, (width, width)),
+               "b": np.zeros(width)} for _ in range(stages)]
+    tr = PipelineTrainer(
+        (_pp_stage_fwd, _pp_stage_bwd, _pp_loss_fwd, _pp_loss_bwd),
+        params, lr=0.05, n_microbatches=n_micro, schedule="1f1b")
+
+    def data(step):
+        r = np.random.default_rng(100 + step)
+        xs = [r.normal(size=(micro_batch, width)) for _ in range(n_micro)]
+        ts = [np.zeros((micro_batch, width)) for _ in range(n_micro)]
+        return xs, ts
+
+    t0 = time.time()
+    hist = tr.fit(data, steps)
+    t1 = time.time()
+    total_s = t1 - t0
+    print(f"pp(fit): {steps} steps x {n_micro} microbatches over "
+          f"{stages} MPMD stages in {total_s:.2f}s")
+    time.sleep(1.5)                                     # let rings settle
+
+    evs = state.events(since=t0 - 1.0)
+    table, _roots = state.build_spans(evs)
+    flat = [r for r in table.values() if r.get("plane") == "pp"]
+    phases, unattributed = attribute(flat, t0, t1,
+                                     priority=PP_PHASE_PRIORITY)
+    phases = {PP_RELABEL.get(k, k): v for k, v in phases.items()}
+    bubble = float(np.mean([h["bubble_fraction"] for h in hist]))
+    coverage = 1.0 - unattributed / total_s
+    ranked = sorted(((k, v) for k, v in phases.items() if v > 0),
+                    key=lambda kv: -kv[1])
+    doc = {
+        "workload": "pp_fit",
+        "stages": stages,
+        "n_micro": n_micro,
+        "steps": steps,
+        "wall_clock_s": round(total_s, 3),
+        "spans_observed": len(flat),
+        "phases_s": {k: round(v, 3) for k, v in ranked},
+        "phases_frac": {k: round(v / total_s, 4) for k, v in ranked},
+        "top_phases": [k for k, _ in ranked[:3]],
+        "bubble_s": round(unattributed, 3),
+        "bubble_frac_of_wall": round(1.0 - coverage, 4),
+        "bubble_fraction_metric": round(bubble, 4),
+        "coverage": round(coverage, 4),
+    }
+    _report(ranked, total_s, unattributed, coverage)
+    print(f"  (unattributed here = pipeline bubble + driver pump)")
+    print(f"  per-step bubble fraction (pp_bubble_fraction): {bubble:.1%}")
+    _write({"pp": doc})
+    tr.shutdown()
+    ray_tpu.shutdown()
+    # The pipeline phases MUST be visible — that is this mode's point.
+    have = set(doc["phases_s"])
+    missing = {"stage_fwd", "stage_bwd"} - have
+    assert not missing, f"pp phases absent from attribution: {missing}"
+
+
 def main():
     ray_tpu.init(
         num_cpus=2, object_store_memory=256 << 20,
@@ -249,5 +358,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "actor_storm":
         run_actor_storm(int(sys.argv[2]) if len(sys.argv) > 2 else 200)
+    elif len(sys.argv) > 1 and sys.argv[1] == "pp":
+        run_pipeline(int(sys.argv[2]) if len(sys.argv) > 2 else 6)
     else:
         main()

@@ -107,3 +107,29 @@ def test_span_kinds_do_not_collide_with_instant_kinds():
     # is the always-on SLO sample, the span only appears under a trace.
     mixed -= {("serve", "admit")}
     assert not mixed, f"(plane, kind) used as both span and instant: {mixed}"
+
+def test_pp_span_kinds_present():
+    """The MPMD pipeline trainer (PR 15) is attributable only because
+    these spans exist: scale_attrib's pp mode derives the bubble
+    fraction from the unattributed remainder of stage_fwd/stage_bwd/
+    xfer/apply/ckpt/recover, and the chaos gates key on the stage_dead/
+    replay/rollback instants.  Pin them so refactors cannot silently
+    blind the tooling."""
+    sites = {(pl, k) for _, _, pl, k in _call_sites()}
+    required_spans = {
+        ("pp", "stage_fwd"),    # stage actor: one microbatch forward
+        ("pp", "stage_bwd"),    # stage actor: one microbatch backward
+        ("pp", "xfer"),         # stage actor: resolve inter-stage object
+        ("pp", "apply"),        # stage actor: fold partials + SGD update
+        ("pp", "ckpt"),         # stage actor: per-stage sharded save
+        ("pp", "step"),         # driver: whole pipeline step
+        ("pp", "recover"),      # driver: reform/replay/rollback window
+    }
+    required_instants = {
+        ("pp", "bubble"),       # stage actor: idle gap between ops
+        ("pp", "stage_dead"),   # driver: a gang was declared dead
+        ("pp", "replay"),       # driver: surgical in-place replay chosen
+        ("pp", "rollback"),     # driver: global rollback chosen
+    }
+    missing = (required_spans | required_instants) - sites
+    assert not missing, f"pp plane kinds vanished: {missing}"
