@@ -1,0 +1,149 @@
+"""Head-availability benchmark: control-plane survival under a scripted
+GCS kill, with and without the supervised restart.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Runs the same probe workload twice against a cluster whose GCS
+`os._exit(1)`s at a scripted request ordinal (`chaos_kill_gcs_at`):
+once with `gcs_supervise` on (the launcher respawns the head at the
+same address from its sqlite tables; clients buffer-and-retry across
+the outage) and once with it off (the head stays dead).  Each probe
+round issues one control-plane call (KV probe through the GcsClient)
+and one data-plane call (an actor method, peer-to-peer) so the two
+planes' availability decouple: the data plane should ride out a head
+death in BOTH modes — that is the architectural claim — while
+control-plane availability is what supervision buys.
+
+`value` is supervised control-plane availability; `vs_baseline` is the
+ratio over the unsupervised run.  p99 control latency rides along so
+the ride-through cost (buffered calls during the respawn) is visible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def _run_mode(args, supervise):
+    """One cluster lifetime: boot, probe through the scripted kill,
+    tear down.  Returns per-plane (ok, attempts) plus latencies."""
+    import ray_tpu
+    from ray_tpu import api as _api
+    from ray_tpu._private import fault_injection as fi
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    ray_tpu.init(num_cpus=2, object_store_memory=64 << 20, _system_config={
+        "gcs_supervise": supervise,
+        # Without the supervisor the head stays dead: cap how long each
+        # buffered call waits so the unsupervised run finishes.
+        "gcs_outage_deadline_s": args.outage_deadline_s,
+        "chaos_enabled": True,
+        "chaos_seed": args.seed,
+        "chaos_kill_gcs_at": args.kill_at,
+        "chaos_max_faults": 1,
+    })
+    try:
+        @ray_tpu.remote
+        class Probe:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        actor = Probe.remote()
+        assert ray_tpu.get(actor.inc.remote(), timeout=60) == 1
+        w = _api._worker
+
+        # Drive the head's request ordinal to the scripted kill point
+        # while the measurement window is open.
+        def pump():
+            for _ in range(2 * args.kill_at):
+                try:
+                    w.io.run(w.gcs.call(
+                        "Kv", "kv_exists", {"ns": "bench", "key": "pump"}))
+                except Exception:
+                    return  # unsupervised mode: the head is gone
+
+        pt = threading.Thread(target=pump, daemon=True)
+        pt.start()
+
+        ctrl_ok = ctrl_n = data_ok = data_n = 0
+        ctrl_lat = []
+        end = time.monotonic() + args.window_s
+        while time.monotonic() < end:
+            ctrl_n += 1
+            t0 = time.perf_counter()
+            try:
+                w.io.run(w.gcs.call("Kv", "kv_exists",
+                                    {"ns": "bench", "key": "probe"}),
+                         timeout=args.outage_deadline_s + 5)
+                ctrl_ok += 1
+            except Exception:
+                pass
+            ctrl_lat.append(time.perf_counter() - t0)
+            data_n += 1
+            try:
+                ray_tpu.get(actor.inc.remote(), timeout=5)
+                data_ok += 1
+            except Exception:
+                pass
+            time.sleep(args.probe_interval_s)
+        pt.join(5)
+        sup = _api._cluster["group"].supervisors
+        restarts = sup[0].restarts if sup else 0
+        return (ctrl_ok, ctrl_n, data_ok, data_n, ctrl_lat, restarts)
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--window-s", type=float, default=12.0,
+                    help="measurement window per mode (seconds)")
+    ap.add_argument("--probe-interval-s", type=float, default=0.05)
+    ap.add_argument("--kill-at", type=int, default=300,
+                    help="scripted GCS request ordinal to die at")
+    ap.add_argument("--outage-deadline-s", type=float, default=4.0)
+    ap.add_argument("--seed", type=int, default=16)
+    args = ap.parse_args()
+
+    c_ok, c_n, d_ok, d_n, lat, restarts = _run_mode(args, supervise=True)
+    uc_ok, uc_n, ud_ok, ud_n, _, _ = _run_mode(args, supervise=False)
+
+    avail_sup = c_ok / max(1, c_n)
+    avail_unsup = uc_ok / max(1, uc_n)
+
+    print(json.dumps({
+        "metric": "gcs_availability_supervised",
+        "value": round(avail_sup, 4),
+        "unit": "fraction",
+        "vs_baseline": round(avail_sup / max(avail_unsup, 1e-9), 3),
+        "availability_unsupervised": round(avail_unsup, 4),
+        "data_plane_availability_supervised": round(d_ok / max(1, d_n), 4),
+        "data_plane_availability_unsupervised": round(
+            ud_ok / max(1, ud_n), 4),
+        "p99_control_ms_supervised": round(
+            _percentile(lat, 0.99) * 1000, 1),
+        "supervised_restarts": restarts,
+        "control_probes": c_n,
+        "window_s": args.window_s,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -242,6 +242,27 @@ _D("rpc_max_retries", 4, int,
 _D("rpc_retry_base_ms", 50.0, float,
    "first retry backoff; doubles per attempt with +/-50% jitter")
 _D("rpc_retry_max_ms", 2000.0, float, "backoff ceiling per retry sleep")
+# -- GCS fault tolerance ---------------------------------------------------
+_D("gcs_supervise", False, _bool,
+   "the launcher supervises the GCS child: on an unexpected death it "
+   "respawns `python -m ray_tpu._private.gcs` at the SAME address from "
+   "the same sqlite persistence path, so clients reconnect without "
+   "re-resolving anything.  Implies persistence (a gcs.sqlite under the "
+   "session dir) when RAY_TPU_GCS_PERSIST is unset")
+_D("gcs_supervisor_restarts", 10, int,
+   "supervised-GCS respawn budget per cluster lifetime; past it the "
+   "supervisor gives up and the cluster degrades to today's "
+   "head-is-gone behavior")
+_D("gcs_outage_deadline_s", 30.0, float,
+   "GcsClient ride-through window: control-plane calls buffer-and-retry "
+   "transport failures against the (restarting) GCS for up to this long "
+   "before surfacing the error.  The data plane is peer-to-peer and "
+   "never waits on this")
+_D("gcs_silent_window_s", 90.0, float,
+   "hostd suicide window: heartbeat loop exits the daemon after the GCS "
+   "has been unreachable this long — UNLESS gcs_supervise is on, in "
+   "which case the hostd rides the outage out and re-registers on "
+   "reconnect instead of orphaning its workers")
 # -- fault injection (chaos) ----------------------------------------------
 # Deterministic seeded chaos: see _private/fault_injection.py.  All
 # probabilities are per-event in [0,1]; flags propagate to daemons and
@@ -321,6 +342,34 @@ _D("chaos_stall_at", 0, int,
 _D("chaos_stall_s", 3600.0, float,
    "how long an injected train stall sleeps (interruptible; default "
    "is effectively forever relative to train_hang_timeout_s)")
+_D("chaos_kill_gcs_at", -1, int,
+   "scripted GCS kill: the GCS process os._exit(1)s right before "
+   "serving its N-th control-plane request (-1 = disabled).  Which "
+   "request lands on ordinal N is scenario-determined: a heartbeat, a "
+   "PG schedule, a KV put — the supervised restart must absorb any of "
+   "them (see fault_injection.ChaosController.kill_gcs)")
+_D("chaos_kill_gcs_salts", "gcs0", str,
+   "which GCS incarnations a scripted kill arms on: csv of process "
+   "salts ('gcs0' is the first boot, 'gcs1' the first supervised "
+   "respawn, ...; '*' = every incarnation).  The default arms only the "
+   "first boot so a supervised respawn converges instead of dying at "
+   "the same ordinal forever")
+_D("chaos_kill_gcs_flush_at", -1, int,
+   "scripted mid-flush GCS kill: os._exit(1) INSIDE the sqlite "
+   "write_rows transaction of the N-th persistence flush, after the "
+   "executemany but before commit (-1 = disabled).  Proves the "
+   "coalesced-write path is crash-atomic: the torn flush must roll "
+   "back wholesale on restore")
+_D("chaos_partition_links", "", str,
+   "scripted sustained network partitions: ';'-separated rules "
+   "'src>dst@start+duration', e.g. 'h2>gcs@40+6.0;driver>gcs@0+2'. "
+   "src names a process salt ('h2', 'gcs0', 'driver' for the saltless "
+   "driver, '*' for any); dst is 'gcs', a literal host:port, or '*'. "
+   "The rule blackholes every matching outbound rpc/native send "
+   "starting at the src process's start-th call on that link, for "
+   "duration seconds, then heals.  Directional — partition asymmetry "
+   "is expressed by listing one direction only (see "
+   "fault_injection.ChaosController.link_fault)")
 
 
 GLOBAL_CONFIG = RayTpuConfig()

@@ -52,7 +52,8 @@ from ray_tpu._private.protocol import (
     TaskSpec,
     ValueArg,
 )
-from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcClient, RpcServer
+from ray_tpu._private.rpc import (ClientPool, EventLoopThread, GcsClient,
+                                  RpcClient, RpcServer)
 
 
 def _pg_id_of(pg):
@@ -165,7 +166,12 @@ class CoreWorker:
         self.hostd_address = hostd_address
         self.host = host
         self.io = EventLoopThread()
-        self.gcs = RpcClient(gcs_address)
+        # GcsClient, not a bare RpcClient: control-plane calls ride
+        # through supervised-GCS restarts (buffer-and-retry up to
+        # gcs_outage_deadline_s) instead of failing the driver on a
+        # head blip.  The data plane (tasks/objects) is peer-to-peer
+        # and never routes through this channel.
+        self.gcs = GcsClient(gcs_address)
         self.pool = ClientPool()
         self.store = ObjectStore.attach(store_path) if store_path else None
         self.store_path = store_path

@@ -35,9 +35,21 @@ from __future__ import annotations
 import os
 import random
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from .config import GLOBAL_CONFIG
+
+# The GCS address, registered by whoever builds a GcsClient, so partition
+# rules can name the head symbolically ("h2>gcs@...") instead of by the
+# ephemeral host:port the session happened to bind.
+_gcs_address: Optional[str] = None
+
+
+def set_gcs_address(address: str) -> None:
+    """Label `address` as "gcs" for link-partition rule matching."""
+    global _gcs_address
+    _gcs_address = address
 
 
 class ChaosInjectedError(ConnectionError):
@@ -75,6 +87,10 @@ class ChaosController:
         self._faults = 0
         self._lock = threading.Lock()
         self.schedule: List[Tuple[str, int, str]] = []
+        # Link-partition plane state: parsed rules (cached per spec
+        # string) and per-destination call ordinals.
+        self._link_spec: Optional[str] = None
+        self._link_rules: List[dict] = []
 
     # -- deterministic draws ----------------------------------------------
 
@@ -319,6 +335,151 @@ class ChaosController:
                     return True
             return False
         return self.should("ckpt", cfg.chaos_ckpt_kill, "kill")
+
+    def kill_gcs(self) -> bool:
+        """Kill the GCS process right before serving its next request.
+
+        Scripted only: `chaos_kill_gcs_at` names the control-plane
+        request ordinal at which this GCS incarnation os._exit(1)s, and
+        `chaos_kill_gcs_salts` names which incarnations arm ('gcs0' is
+        the first boot; the supervisor stamps respawns 'gcs1', 'gcs2',
+        ...).  The default salts list arms only 'gcs0', so a supervised
+        respawn replays the surviving schedule instead of dying at the
+        same ordinal forever — multi-kill scenarios opt in by listing
+        more incarnations (or '*').  Respects `chaos_max_faults` like
+        the other scripted process kills.
+        """
+        cfg = GLOBAL_CONFIG
+        at = int(cfg.chaos_kill_gcs_at)
+        if at < 0:
+            return False
+        salts = str(cfg.chaos_kill_gcs_salts or "")
+        listed = (salts.strip() == "*"
+                  or (self.salt and self.salt in
+                      [s.strip() for s in salts.split(",")]))
+        with self._lock:
+            n = self._next_index("gcs")
+            if (listed and n == at
+                    and not (self.max_faults
+                             and self._faults >= self.max_faults)):
+                self._faults += 1
+                self.schedule.append(("gcs", n, "kill"))
+                return True
+        return False
+
+    def kill_gcs_flush(self) -> bool:
+        """Kill the GCS *inside* the N-th sqlite persistence flush —
+        after the executemany, before the transaction commits.  The
+        worst instant for the coalesced-write path from the batching PR:
+        every row of the flush is staged but nothing is durable, so a
+        restore must see the whole flush roll back (crash-atomicity)
+        rather than a torn prefix.  Scripted via `chaos_kill_gcs_flush_at`
+        with the same incarnation gating as kill_gcs.
+        """
+        cfg = GLOBAL_CONFIG
+        at = int(cfg.chaos_kill_gcs_flush_at)
+        if at < 0:
+            return False
+        salts = str(cfg.chaos_kill_gcs_salts or "")
+        listed = (salts.strip() == "*"
+                  or (self.salt and self.salt in
+                      [s.strip() for s in salts.split(",")]))
+        with self._lock:
+            n = self._next_index("gcsflush")
+            if (listed and n == at
+                    and not (self.max_faults
+                             and self._faults >= self.max_faults)):
+                self._faults += 1
+                self.schedule.append(("gcsflush", n, "kill"))
+                return True
+        return False
+
+    # -- sustained link partitions ----------------------------------------
+
+    def _parse_link_rules(self, spec: str) -> List[dict]:
+        """Parse 'src>dst@start+duration[;...]' into rule dicts.
+
+        Malformed entries are skipped (chaos config must never crash the
+        runtime it is testing).  Rule state (window start, heal flag)
+        lives on the dict — parsed once per spec string per process.
+        """
+        rules: List[dict] = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            try:
+                src, rest = entry.split(">", 1)
+                dst, rest = rest.split("@", 1)
+                at, dur = rest.split("+", 1)
+                rules.append({
+                    "src": src.strip(), "dst": dst.strip(),
+                    "at": int(at), "dur": float(dur),
+                    "started": None, "healed": False,
+                })
+            except (ValueError, TypeError):
+                continue
+        return rules
+
+    def _src_matches(self, src: str) -> bool:
+        # "driver" names the saltless driver/launcher process so rules
+        # can target it explicitly without "*" catching every daemon.
+        return (src == "*" or src == self.salt
+                or (src == "driver" and not self.salt))
+
+    def link_fault(self, address: str) -> bool:
+        """Verdict for one outbound send from this process to `address`:
+        True = the link is blackholed right now, drop the send.
+
+        Sustained, per-link, directional — unlike the probabilistic
+        per-call drops.  Each rule opens a wall-clock window of
+        `duration` seconds when this process's `start`-th call on that
+        link occurs; the call ordinal only advances on links some rule
+        names, so un-partitioned traffic pays one spec check.  Both the
+        blackhole onset and the heal are flight-recorded on the "link"
+        plane.
+        """
+        spec = str(GLOBAL_CONFIG.chaos_partition_links or "")
+        if not spec:
+            return False
+        with self._lock:
+            if spec != self._link_spec:
+                self._link_spec = spec
+                self._link_rules = self._parse_link_rules(spec)
+            label = "gcs" if (_gcs_address and address == _gcs_address) \
+                else address
+            mine = [r for r in self._link_rules
+                    if self._src_matches(r["src"])
+                    and r["dst"] in ("*", label, address)]
+            if not mine:
+                return False
+            n = self._next_index(f"link|{label}")
+            now = time.monotonic()
+            active = False
+            fired, healed = [], []
+            for r in mine:
+                if (r["started"] is None and n == r["at"]
+                        and not (self.max_faults
+                                 and self._faults >= self.max_faults)):
+                    r["started"] = now
+                    self._faults += 1
+                    self.schedule.append((f"link|{label}", n, "blackhole"))
+                    fired.append(r)
+                if r["started"] is not None and not r["healed"]:
+                    if now - r["started"] < r["dur"]:
+                        active = True
+                    else:
+                        r["healed"] = True
+                        healed.append(r)
+        # Record outside the lock: events.record takes its own locks.
+        from ray_tpu.util import events
+        for r in fired:
+            events.record("link", "blackhole", src=r["src"], dst=label,
+                          ordinal=n, duration_s=r["dur"])
+        for r in healed:
+            events.record("link", "heal", src=r["src"], dst=label,
+                          after_s=r["dur"])
+        return active
 
 
 _chaos: Optional[ChaosController] = None

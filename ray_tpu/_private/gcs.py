@@ -167,6 +167,18 @@ class GcsTableStorage:
                     "ON CONFLICT(tab, k) DO UPDATE SET v=excluded.v", puts)
             if dels:
                 db.executemany("DELETE FROM t WHERE tab=? AND k=?", dels)
+            # Scripted mid-flush kill: every row of this flush is staged
+            # on the connection but the transaction has NOT committed.
+            # Dying here must roll the whole flush back on restore —
+            # the crash-atomicity proof for the coalesced-write path.
+            from ray_tpu._private.fault_injection import get_chaos
+            chaos = get_chaos()
+            if chaos is not None and chaos.kill_gcs_flush():
+                from ray_tpu.util import events
+                events.record("gcs", "chaos_kill_flush",
+                              rows=len(puts) + len(dels))
+                events.dump_crash("chaos_kill_gcs_flush")
+                os._exit(1)
         self.write_ops += len(puts) + len(dels)
 
     def load_all(self) -> dict | None:
@@ -232,6 +244,15 @@ class GcsServer:
         self._change_event = asyncio.Event()
         self._actor_events: dict = {}   # ActorID -> Event (targeted polls)
         self._wake_scheduled = False    # coalesces broadcast wakes per tick
+        # Per-boot nonce, carried on every get_nodes reply: a supervised
+        # respawn binds the same address, so a changed boot_id is how
+        # clients detect "the GCS restarted underneath me" and push their
+        # anti-entropy re-register even though the restored node table
+        # still lists them alive (no reregister nudge from heartbeats).
+        self.boot_id = os.urandom(8).hex()
+        # Restored-alive nodes that still owe that re-register; their
+        # heartbeats answer reregister=True until the snapshot arrives.
+        self._resync_pending: set = set()
 
     def _bump(self, tab: str | None = None, key=None):
         """Record a state change and wake every waiter.  With (tab, key)
@@ -374,9 +395,19 @@ class GcsServer:
             self.next_job = max(self.next_job, unp(meta[b"next_job"]))
         if b"cluster_version" in meta:
             self._cluster_version = unp(meta[b"cluster_version"])
+        # Restored tables are a *hypothesis* about the cluster, not ground
+        # truth: every restored-alive node owes an anti-entropy snapshot
+        # before its heartbeats read as healthy again.
+        self._resync_pending = {nid for nid, info in self.nodes.items()
+                                if info.alive}
         logger.info("restored GCS state: %d actors, %d PGs, %d nodes, "
                     "job=%d", len(self.actors), len(self.placement_groups),
                     len(self.nodes), self.next_job)
+        from ray_tpu.util import events
+        events.record("gcs", "restored", boot=self.boot_id,
+                      actors=len(self.actors),
+                      pgs=len(self.placement_groups),
+                      nodes=len(self.nodes))
         asyncio.ensure_future(self._reconcile_restored())
 
     async def _reconcile_restored(self):
@@ -425,12 +456,79 @@ class GcsServer:
 
     async def register_node(self, req):
         info: NodeInfo = req["info"]
-        self.nodes[info.node_id] = info
-        self.node_heartbeat[info.node_id] = time.monotonic()
-        self._bump("nodes", info.node_id)
-        logger.info("node %s registered at %s (%s)", info.node_id.hex()[:8],
-                    info.address, info.resources_total)
-        return {"ok": True}
+        nid = info.node_id
+        inc = int(getattr(info, "incarnation", 0) or 0)
+        prev = self.nodes.get(nid)
+        if prev is not None and not prev.alive:
+            prev_inc = int(getattr(prev, "incarnation", 0) or 0)
+            if inc <= prev_inc:
+                # Split-brain fence: this node healed after we declared
+                # it dead and failed its actors over.  Its gang is stale
+                # — letting it back in as-is could double-apply updates
+                # against the replacements.  Refuse, grant the next node
+                # incarnation, and let the hostd fence itself (kill its
+                # workers) before re-registering as the fresh incarnation.
+                from ray_tpu.util import events
+                events.record("gcs", "node_fenced", node=nid.hex()[:8],
+                              stale_incarnation=inc,
+                              granted_incarnation=prev_inc + 1)
+                logger.warning(
+                    "node %s re-registered after being declared dead; "
+                    "fencing (stale incarnation %d, granting %d)",
+                    nid.hex()[:8], inc, prev_inc + 1)
+                return {"ok": False, "fenced": True,
+                        "incarnation": prev_inc + 1}
+        info.alive = True
+        self.nodes[nid] = info
+        self.node_heartbeat[nid] = time.monotonic()
+        self._resync_pending.discard(nid)
+        self._bump("nodes", nid)
+        stale = await self._reconcile_node_snapshot(info,
+                                                    req.get("snapshot"))
+        logger.info("node %s registered at %s (%s, incarnation %d)",
+                    nid.hex()[:8], info.address, info.resources_total, inc)
+        return {"ok": True, "incarnation": inc, "stale_actors": stale}
+
+    async def _reconcile_node_snapshot(self, info: NodeInfo, snapshot):
+        """Anti-entropy against a re-registering node's ground truth.
+
+        The snapshot lists what the hostd actually runs (live actor
+        workers and their addresses, lease/worker counts).  Two ways the
+        restored/stale tables can disagree, both fixed here: an actor we
+        think is ALIVE on this node but the node no longer runs →
+        interrupt it through the normal restart path; an actor the node
+        still runs but we have failed over, killed, or never heard of →
+        return it as stale so the hostd reaps that worker (the
+        incarnation living at `address` lost ownership).
+        """
+        if not isinstance(snapshot, dict):
+            return []
+        reported: dict = {}
+        for entry in snapshot.get("actors", ()):
+            try:
+                reported[entry["actor_id"]] = entry.get("address", "")
+            except (TypeError, KeyError):
+                continue
+        stale = []
+        for aid, addr in reported.items():
+            a = self.actors.get(aid)
+            if (a is None or a.state != "ALIVE" or a.node_id != info.node_id
+                    or (addr and a.address != addr)):
+                stale.append(aid)
+        lost = 0
+        for a in list(self.actors.values()):
+            if a.state == "ALIVE" and a.node_id == info.node_id \
+                    and a.actor_id not in reported:
+                lost += 1
+                await self._on_actor_interrupted(
+                    a, "anti-entropy: node re-registered without the actor")
+        if reported or stale or lost:
+            from ray_tpu.util import events
+            events.record("gcs", "node_resync",
+                          node=info.node_id.hex()[:8],
+                          reported=len(reported), stale=len(stale),
+                          lost=lost)
+        return stale
 
     async def heartbeat(self, req):
         """Typed (protocol.pb.HeartbeatRequest) or legacy dict."""
@@ -451,7 +549,7 @@ class GcsServer:
                     "shutdown": shutdown}
 
         info = self.nodes.get(nid)
-        if info is None or not info.alive:
+        if info is None or not info.alive or nid in self._resync_pending:
             return reply(reregister=True)
         self.node_heartbeat[nid] = time.monotonic()
         if info.resources_available != available:
@@ -461,7 +559,8 @@ class GcsServer:
 
     async def get_nodes(self, req):
         return {"nodes": list(self.nodes.values()),
-                "version": self._cluster_version}
+                "version": self._cluster_version,
+                "boot_id": self.boot_id}
 
     async def add_task_events(self, req):
         """Sink for worker task-event buffers (reference: TaskEventBuffer
@@ -1194,9 +1293,37 @@ class GcsServer:
 
     # ---------------- lifecycle ----------------
 
+    def _arm_chaos_kill(self):
+        """Scripted head kill: wrap every registered control-plane handler
+        so this GCS incarnation can os._exit(1) right before serving its
+        `chaos_kill_gcs_at`-th request.  Which operation lands on that
+        ordinal is scenario-determined — a heartbeat, a PG schedule, a KV
+        put — which is the point: the supervised restart must absorb a
+        death at ANY request boundary.  The flight ring is dumped first so
+        `cli analyze` can reconstruct what the head was doing when it
+        died."""
+        from ray_tpu._private.fault_injection import get_chaos
+        if get_chaos() is None:
+            return
+        from ray_tpu.util import events
+
+        def wrap(path, fn):
+            async def wrapped(request):
+                chaos = get_chaos()
+                if chaos is not None and chaos.kill_gcs():
+                    events.record("gcs", "chaos_kill", method=path)
+                    events.dump_crash("chaos_kill_gcs")
+                    os._exit(1)
+                return await fn(request)
+            return wrapped
+
+        for path, fn in list(self.server._methods.items()):
+            self.server._methods[path] = wrap(path, fn)
+
     async def start(self, port: int = 0) -> int:
         self.server.register_service("Kv", self.kv)
         self.server.register_service("Gcs", self)
+        self._arm_chaos_kill()
         self._restore()
         port = await self.server.start(port)
         self._health_task = asyncio.ensure_future(self._health_loop())

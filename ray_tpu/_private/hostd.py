@@ -26,7 +26,7 @@ from ray_tpu._private import gcs as gcs_mod
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu._private.protocol import NodeInfo
-from ray_tpu._private.rpc import ClientPool, RpcClient, RpcServer
+from ray_tpu._private.rpc import ClientPool, GcsClient, RpcClient, RpcServer
 from ray_tpu.util import events
 from ray_tpu.util import spans
 
@@ -295,8 +295,15 @@ class NodeDaemon:
                  host: str = "127.0.0.1", session_dir: str = "/tmp/ray_tpu"):
         self.node_id = NodeID.from_random()
         self.gcs_address = gcs_address
-        self.gcs = RpcClient(gcs_address)
+        self.gcs = GcsClient(gcs_address)
         self.pool = ClientPool()
+        # Node incarnation (split-brain fencing): starts at 0, adopted
+        # from the GCS's fencing verdict when this node re-registers
+        # after having been declared dead — see _register_with_gcs.
+        self.incarnation = 0
+        # Last GCS boot id seen in get_nodes replies; a change means the
+        # head restarted underneath us and owes an anti-entropy resync.
+        self._gcs_boot_id: str | None = None
         self.host = host
         self.is_head = is_head
         self.session_dir = session_dir
@@ -1558,7 +1565,85 @@ class NodeDaemon:
             resources_total=dict(self.resources_total),
             resources_available=dict(self.resources_available),
             is_head=self.is_head,
+            incarnation=self.incarnation,
         )
+
+    def _state_snapshot(self) -> dict:
+        """Ground truth shipped with every (re-)register: what this node
+        actually runs right now.  After a GCS restart the restored tables
+        are a hypothesis; the anti-entropy reconcile trusts this instead
+        (reference: raylet's RegisterNode piggybacks its live worker set
+        on GCS restart via RayletNotifyGCSRestart)."""
+        actors = []
+        leased = 0
+        for h in self.workers.values():
+            if h.proc.poll() is not None:
+                continue
+            if h.state == "actor" and h.actor_id is not None:
+                actors.append({"actor_id": h.actor_id,
+                               "address": h.address})
+            elif h.state == "leased":
+                leased += 1
+        return {"actors": actors, "leases": leased,
+                "workers": len(self.workers),
+                "incarnation": self.incarnation}
+
+    def _fence_self(self, granted_incarnation: int, reason: str):
+        """The GCS declared this node dead and failed its actors over;
+        everything running here is a stale gang.  Kill ALL workers (an
+        op from a fenced incarnation must never double-apply against the
+        failed-over replacements), drop bundle reservations, and adopt
+        the granted incarnation so the follow-up register is accepted."""
+        victims = [h for h in list(self.workers.values())
+                   if h.proc.poll() is None]
+        logger.warning(
+            "fencing node %s: %s (incarnation %d -> %d, killing %d "
+            "stale workers)", self.node_id.hex()[:8], reason,
+            self.incarnation, granted_incarnation, len(victims))
+        events.record("proc", "node_fenced", node=self.node_id.hex()[:8],
+                      incarnation=granted_incarnation,
+                      stale_workers=len(victims), reason=reason)
+        for h in victims:
+            self._kill_worker(h)
+        self.workers.clear()
+        self.bundles.clear()
+        self.resources_available = dict(self.resources_total)
+        self.incarnation = int(granted_incarnation)
+
+    async def _register_with_gcs(self, timeout: float = 10):
+        """Register (or re-register) with the anti-entropy snapshot,
+        honoring a fencing verdict: on "fenced" the node kills its stale
+        gang, adopts the granted incarnation, and registers again as the
+        fresh incarnation.  Stale actors the GCS reports back (workers
+        whose incarnation lost ownership while we were partitioned) are
+        reaped here."""
+        req = {"info": self.node_info(), "snapshot": self._state_snapshot()}
+        reply = await self.gcs.call("Gcs", "register_node", req,
+                                    timeout=timeout)
+        if isinstance(reply, dict) and reply.get("fenced"):
+            self._fence_self(
+                int(reply.get("incarnation", self.incarnation + 1)),
+                "GCS refused registration: node was declared dead")
+            reply = await self.gcs.call(
+                "Gcs", "register_node",
+                {"info": self.node_info(),
+                 "snapshot": self._state_snapshot()},
+                timeout=timeout)
+        stale = (reply.get("stale_actors") or []) \
+            if isinstance(reply, dict) else []
+        if stale:
+            stale_set = set(stale)
+            for h in list(self.workers.values()):
+                if h.actor_id is not None and h.actor_id in stale_set:
+                    logger.warning(
+                        "reaping stale actor worker pid %d: its actor "
+                        "was failed over while this node was away",
+                        h.proc.pid)
+                    events.record("proc", "stale_actor_reaped",
+                                  node=self.node_id.hex()[:8],
+                                  pid=h.proc.pid)
+                    self._kill_worker(h)
+        return reply
 
     async def _heartbeat_loop(self):
         from ray_tpu import protocol
@@ -1592,24 +1677,39 @@ class NodeDaemon:
                     node_id=self.node_id.binary())
                 for k, v in self.resources_available.items():
                     hb.available.amounts[k] = v
+                # outage_retry=False: the heartbeat MEASURES GCS liveness
+                # (the silence window below keys on it), so it must fail
+                # fast per tick instead of riding the outage out inside
+                # the client.
                 reply = await self.gcs.call("Gcs", "heartbeat", hb,
-                                            timeout=5)
+                                            timeout=5, outage_retry=False)
                 last_ok = time.monotonic()
                 if reply.shutdown:
                     self._shutdown.set()
                 if reply.reregister:
-                    await self.gcs.call("Gcs", "register_node",
-                                        {"info": self.node_info()})
+                    await self._register_with_gcs()
             except Exception:
                 # Slow is not dead: a saturated single-core GCS (actor
                 # storm, bulk submissions) can stall past any single RPC
                 # timeout; a hostd suicide then cascades into hundreds of
                 # "connection refused" failures.  Exit only after a
                 # sustained silent window — real GCS death also trips the
-                # driver/launcher watchdogs.
-                if time.monotonic() - last_ok > 90.0:
-                    logger.error("GCS unreachable for 90s; hostd exiting")
-                    self._shutdown.set()
+                # driver/launcher watchdogs.  With a supervised GCS the
+                # window never expires the node: the head is coming back
+                # at the same address, and a suicide here would turn a
+                # restartable head outage into whole-cluster loss.
+                silent = time.monotonic() - last_ok
+                if silent > float(_cfg().gcs_silent_window_s):
+                    if _cfg().gcs_supervise:
+                        logger.warning(
+                            "GCS unreachable for %.0fs; supervised head — "
+                            "riding the outage out", silent)
+                        last_ok = time.monotonic()  # re-arm the window
+                    else:
+                        logger.error(
+                            "GCS unreachable for %.0fs; hostd exiting",
+                            silent)
+                        self._shutdown.set()
             await asyncio.sleep(gcs_mod.HEARTBEAT_INTERVAL_S)
 
     async def _node_watch_loop(self):
@@ -1632,9 +1732,30 @@ class NodeDaemon:
             try:
                 reply = await self.gcs.call("Gcs", "get_nodes", {},
                                             timeout=5)
-            except Exception:
+            except Exception as e:
+                # Not silent: the outage is already metered by the
+                # GcsClient (gcs/unreachable + gcs_unreachable_seconds);
+                # this marks the watch loop itself as degraded so `cli
+                # events` shows WHICH consumer was blind, then keeps
+                # polling — membership deltas resume on reconnect.
+                events.record("gcs", "unreachable", loop="node_watch",
+                              error=str(e)[:120])
                 await asyncio.sleep(gcs_mod.HEARTBEAT_INTERVAL_S)
                 continue
+            boot = reply.get("boot_id")
+            if boot is not None and boot != self._gcs_boot_id:
+                if self._gcs_boot_id is not None:
+                    # The head restarted underneath us.  Its restored
+                    # tables list this node alive, so no heartbeat will
+                    # nudge a reregister — push the anti-entropy snapshot
+                    # proactively so GCS state converges to ground truth.
+                    logger.warning("GCS restarted (boot %s); "
+                                   "re-registering with snapshot", boot)
+                    try:
+                        await self._register_with_gcs()
+                    except Exception:
+                        pass  # the resync-pending heartbeat nudge remains
+                self._gcs_boot_id = boot
             if reply.get("version") != version:
                 version = reply.get("version")
                 nodes = reply["nodes"]
@@ -1827,8 +1948,7 @@ class NodeDaemon:
         except Exception as e:
             logger.warning("native transfer plane unavailable: %s", e)
             self.transfer_server = None
-        await self.gcs.call("Gcs", "register_node", {"info": self.node_info()},
-                            timeout=10)
+        await self._register_with_gcs(timeout=10)
         if _cfg().worker_zygote:
             self._prestart_zygote()  # off-loop; cold imports never block
         self._tasks = [asyncio.ensure_future(self._heartbeat_loop()),

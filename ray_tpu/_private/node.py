@@ -13,6 +13,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import uuid
 
@@ -34,6 +35,7 @@ class ProcessGroup:
 
     def __init__(self):
         self.procs: list[subprocess.Popen] = []
+        self.supervisors: list = []  # GcsSupervisor instances
 
     def wait(self):
         """Block until every tracked daemon exits (CLI --block mode)."""
@@ -41,6 +43,11 @@ class ProcessGroup:
             p.wait()
 
     def reap(self, timeout: float = 5.0):
+        # Supervisors first: a reaped GCS must read as a planned shutdown,
+        # not a crash to respawn from.
+        for s in self.supervisors:
+            s.stop()
+        self.supervisors.clear()
         # Reverse order: hostds before the GCS, so each hostd can still kill
         # its workers and deregister while the control plane is up.
         for p in reversed(self.procs):
@@ -77,23 +84,126 @@ def new_session_dir() -> str:
     return d
 
 
-def start_gcs(session_dir: str, group: ProcessGroup, host="127.0.0.1",
-              port: int = 0, watch_parent: bool = False) -> str:
-    """watch_parent: a driver-embedded cluster (ray_tpu.init) dies with
-    its driver even when the driver is SIGKILLed and atexit never runs —
-    the GCS polls the driver pid and exits when it vanishes; hostds then
-    follow via their GCS-unreachable watchdog.  CLI/launcher-started
-    clusters must OUTLIVE the starting process, so they don't watch."""
+def _spawn_gcs(session_dir: str, host: str, port: int, incarnation: int,
+               persist: str | None, watch_pid: int | None):
+    """Spawn one GCS process; returns (proc, ready_file_path)."""
     ready = os.path.join(session_dir, f"gcs_ready_{uuid.uuid4().hex[:6]}")
     log = open(os.path.join(session_dir, "logs", "gcs.err"), "ab")
     cmd = [sys.executable, "-m", "ray_tpu._private.gcs",
            "--host", host, "--ready-file", ready, "--port", str(port)]
-    if watch_parent:
-        cmd += ["--watch-pid", str(os.getpid())]
-    proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=_daemon_env())
+    if watch_pid:
+        cmd += ["--watch-pid", str(watch_pid)]
+    env = _daemon_env()
+    # GCS chaos identity: 'gcs0' is the first boot, 'gcs1' the first
+    # supervised respawn, ... so a scripted chaos_kill_gcs_at arms per
+    # incarnation (the default salts list names only 'gcs0', which is
+    # what lets a respawn converge instead of re-dying forever).
+    env["RAY_TPU_CHAOS_PROC_SALT"] = f"gcs{incarnation}"
+    if persist:
+        env["RAY_TPU_GCS_PERSIST"] = persist
+    proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=env)
+    return proc, ready
+
+
+class GcsSupervisor:
+    """Respawns a crashed GCS at the SAME address from the same sqlite
+    persistence path (reference: the external supervisor role ray
+    operators play for GCS FT, with Redis as the durable store — here
+    the launcher owns the child, and sqlite is the store).
+
+    Clients never re-resolve anything: the respawn binds the original
+    port, `_restore()` rebuilds the tables, `_reconcile_restored()` and
+    the per-node anti-entropy re-registers converge the state.  A clean
+    exit (rc 0: driver-watch or planned shutdown) is never respawned;
+    `stop()` makes teardown read as planned even when the reap escalates
+    to SIGTERM/SIGKILL."""
+
+    def __init__(self, session_dir: str, group: ProcessGroup, host: str,
+                 port: int, persist: str, proc: subprocess.Popen,
+                 watch_pid: int | None, max_restarts: int):
+        self.session_dir = session_dir
+        self.group = group
+        self.host = host
+        self.port = port          # fixed after the first bind
+        self.persist = persist
+        self.proc = proc
+        self.watch_pid = watch_pid
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="gcs-supervisor")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            proc = self.proc
+            while proc.poll() is None and not self._stop.wait(0.05):
+                pass
+            if self._stop.is_set() or proc.returncode == 0:
+                return
+            if self.restarts >= self.max_restarts:
+                from ray_tpu.util import events
+                events.record("gcs", "supervisor_gave_up",
+                              restarts=self.restarts, rc=proc.returncode)
+                return
+            self.restarts += 1
+            try:
+                newproc, ready = _spawn_gcs(
+                    self.session_dir, self.host, self.port, self.restarts,
+                    self.persist, self.watch_pid)
+                _wait_ready_file(ready, newproc, what="GCS (respawn)")
+            except Exception:
+                # Failed respawn burns one restart from the budget and
+                # the loop immediately observes the dead child and tries
+                # again (or gives up).
+                continue
+            try:
+                idx = self.group.procs.index(proc)
+                self.group.procs[idx] = newproc
+            except ValueError:
+                self.group.procs.append(newproc)
+            self.proc = newproc
+            from ray_tpu.util import events
+            events.record("gcs", "supervisor_respawn",
+                          incarnation=self.restarts, rc=proc.returncode,
+                          address=f"{self.host}:{self.port}")
+
+
+def start_gcs(session_dir: str, group: ProcessGroup, host="127.0.0.1",
+              port: int = 0, watch_parent: bool = False,
+              supervise: bool | None = None) -> str:
+    """watch_parent: a driver-embedded cluster (ray_tpu.init) dies with
+    its driver even when the driver is SIGKILLed and atexit never runs —
+    the GCS polls the driver pid and exits when it vanishes; hostds then
+    follow via their GCS-unreachable watchdog.  CLI/launcher-started
+    clusters must OUTLIVE the starting process, so they don't watch.
+
+    supervise (default: the `gcs_supervise` config flag): keep a
+    supervisor thread that respawns a crashed GCS at the same address
+    from its sqlite persistence — the head stops being a single point
+    of failure.  Supervision implies persistence: when
+    RAY_TPU_GCS_PERSIST is unset, a gcs.sqlite under the session dir is
+    used."""
+    if supervise is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        supervise = bool(GLOBAL_CONFIG.gcs_supervise)
+    persist = os.environ.get("RAY_TPU_GCS_PERSIST") or None
+    if supervise and not persist:
+        persist = os.path.join(session_dir, "gcs.sqlite")
+    watch_pid = os.getpid() if watch_parent else None
+    proc, ready = _spawn_gcs(session_dir, host, port, 0, persist, watch_pid)
     group.procs.append(proc)
-    port = _wait_ready_file(ready, proc, what="GCS").strip()
-    return f"{host}:{port}"
+    bound = int(_wait_ready_file(ready, proc, what="GCS").strip())
+    if supervise:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        group.supervisors.append(GcsSupervisor(
+            session_dir, group, host, bound, persist, proc, watch_pid,
+            int(GLOBAL_CONFIG.gcs_supervisor_restarts)))
+    return f"{host}:{bound}"
 
 
 _hostd_spawn_seq = 0

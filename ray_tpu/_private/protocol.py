@@ -195,6 +195,13 @@ class NodeInfo:
     resources_available: dict = field(default_factory=dict)
     alive: bool = True
     is_head: bool = False
+    # Node incarnation: bumped by the GCS when it fences a node that
+    # re-registers after being declared dead (its actors already failed
+    # over).  The actor-path incarnation guards key on addresses; this is
+    # the node-level analogue, so a healed-but-stale gang can never
+    # double-apply an update.  getattr-defensive readers tolerate 0 on
+    # records restored from pre-incarnation sqlite tables.
+    incarnation: int = 0
 
 
 def option_defaults(for_actor: bool = False) -> dict:

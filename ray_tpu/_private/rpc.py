@@ -202,6 +202,17 @@ class RpcClient:
         while True:
             chaos = get_chaos()
             if chaos is not None:
+                if chaos.link_fault(self.address):
+                    # Sustained scripted partition: this link is
+                    # blackholed right now.  Surface the same way a real
+                    # partition does — transport failure, backoff, retry
+                    # — so callers exercise their genuine outage paths.
+                    err = ChaosInjectedError(
+                        f"chaos: link blackhole {self.address}{path}")
+                    if not await self._backoff(attempt, deadline, cfg):
+                        raise err
+                    attempt += 1
+                    continue
                 fault = chaos.rpc_fault()
                 if fault is not None:
                     kind, delay = fault
@@ -258,6 +269,125 @@ class RpcClient:
     async def close(self):
         if self._channel is not None:
             await self._channel.close()
+
+
+_gcs_ft_metrics_cache = None
+
+
+def _gcs_ft_metrics():
+    global _gcs_ft_metrics_cache
+    if _gcs_ft_metrics_cache is None:
+        from ray_tpu.util import metrics as mt
+        _gcs_ft_metrics_cache = {
+            "gcs_unreachable_seconds": mt.Counter(
+                "gcs_unreachable_seconds",
+                "cumulative seconds this process could not reach the GCS"),
+            "gcs_outages": mt.Counter(
+                "gcs_outages",
+                "distinct GCS outage windows observed by this process"),
+        }
+    return _gcs_ft_metrics_cache
+
+
+class GcsClient(RpcClient):
+    """RpcClient to the GCS head with outage ride-through.
+
+    The GCS is restartable (supervised respawn at the same address from
+    its sqlite tables), so a transport failure against it usually means
+    "down for seconds", not "gone".  Control-plane calls therefore
+    buffer-and-retry across the base client's retry budget, redialing
+    until ``gcs_outage_deadline_s``, instead of surfacing every blip to
+    scheduling/actor paths.  Only transport-level failures ride through;
+    remote handler errors (RpcError) surface immediately.  The data
+    plane is peer-to-peer and never routes through this class, so tasks,
+    serve streams and train steps keep flowing during the outage.
+
+    Callers that *measure* GCS liveness (the hostd heartbeat loop, whose
+    silence window is the node-death input) pass ``outage_retry=False``
+    to keep their fail-fast semantics; they still get outage accounting.
+
+    Every outage window is flight-recorded (``gcs/unreachable`` on
+    onset, ``gcs/reconnected`` with the duration on recovery) and
+    accumulated into the ``gcs_unreachable_seconds`` counter so head
+    outages show up in `cli events` / `cli analyze` instead of passing
+    silently.
+    """
+
+    def __init__(self, address: str):
+        super().__init__(address)
+        from . import fault_injection
+        fault_injection.set_gcs_address(address)
+        self._outage_started: float | None = None
+        self._outage_acct = 0.0
+        self._outage_lock = threading.Lock()
+
+    @staticmethod
+    def _transport_failure(e: BaseException) -> bool:
+        # TimeoutError here is OUR deadline raise from RpcClient.call —
+        # it fires only after retryable transport failures consumed the
+        # window, never after a successful attempt.  grpc's own
+        # DEADLINE_EXCEEDED (server reached, handler slow) is NOT listed:
+        # the request may have committed.
+        if isinstance(e, (ConnectionError, TimeoutError)):
+            return True
+        if isinstance(e, grpc.aio.AioRpcError):
+            return e.code() == grpc.StatusCode.UNAVAILABLE
+        return False
+
+    def _note_unreachable(self):
+        now = time.monotonic()
+        first = False
+        with self._outage_lock:
+            if self._outage_started is None:
+                self._outage_started = now
+                self._outage_acct = now
+                first = True
+            else:
+                _gcs_ft_metrics()["gcs_unreachable_seconds"].inc(
+                    now - self._outage_acct)
+                self._outage_acct = now
+        if first:
+            _gcs_ft_metrics()["gcs_outages"].inc()
+            from ray_tpu.util import events
+            events.record("gcs", "unreachable", address=self.address)
+
+    def _note_reachable(self):
+        with self._outage_lock:
+            if self._outage_started is None:
+                return
+            now = time.monotonic()
+            outage = now - self._outage_started
+            _gcs_ft_metrics()["gcs_unreachable_seconds"].inc(
+                now - self._outage_acct)
+            self._outage_started = None
+        from ray_tpu.util import events
+        events.record("gcs", "reconnected", address=self.address,
+                      outage_s=round(outage, 3))
+
+    async def call(self, service: str, method: str, request: Any = None,
+                   timeout: float | None = None,
+                   outage_retry: bool = True) -> Any:
+        deadline = (time.monotonic()
+                    + float(GLOBAL_CONFIG.gcs_outage_deadline_s))
+        while True:
+            try:
+                result = await super().call(service, method, request,
+                                            timeout=timeout)
+            except BaseException as e:  # noqa: BLE001 - classified below
+                if not self._transport_failure(e):
+                    raise
+                self._note_unreachable()
+                if not outage_retry or time.monotonic() >= deadline:
+                    raise
+                # Redial on a short poll: a supervised restart comes back
+                # in about a second, and the respawn binds the same
+                # address, so a fresh channel is all recovery takes.
+                self._reset_channel()
+                await asyncio.sleep(
+                    min(0.25, max(0.0, deadline - time.monotonic())))
+                continue
+            self._note_reachable()
+            return result
 
 
 class ClientPool:

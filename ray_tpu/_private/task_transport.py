@@ -222,9 +222,11 @@ class NativeSubmitter:
         deferred; this preserves that contract)."""
         from ray_tpu._private.fault_injection import get_chaos
         chaos = get_chaos()
-        if chaos is not None and chaos.native_drop():
-            # Injected drop: surface as a transport failure so the
-            # caller's worker-death/retry path handles it.
+        if chaos is not None and (chaos.native_drop()
+                                  or chaos.link_fault(addr)):
+            # Injected drop / scripted link blackhole: surface as a
+            # transport failure so the caller's worker-death/retry path
+            # handles it.
             self._loop.call_soon(cb, TPT_ECONN, b"")
             return
         try:
@@ -267,7 +269,7 @@ class NativeSubmitter:
         if chaos is not None:
             kept = []
             for it in items:
-                if chaos.native_drop():
+                if chaos.native_drop() or chaos.link_fault(addr):
                     try:
                         self._loop.call_soon_threadsafe(it[2], TPT_ECONN,
                                                         b"")

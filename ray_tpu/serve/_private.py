@@ -933,9 +933,31 @@ class DeploymentHandle:
         if fresh:
             self._ensure_poller()
             return
-        controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
-        routing = ray_tpu.get(
-            controller.get_routing.remote(self._name), timeout=30)
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME, SERVE_NAMESPACE)
+            routing = ray_tpu.get(
+                controller.get_routing.remote(self._name), timeout=30)
+        except Exception:
+            # Control-plane outage (GCS restarting, controller lookup
+            # timed out).  The replicas themselves are peer-to-peer and
+            # very likely still serving — keep routing on the stale
+            # table instead of failing the request; the long-poller
+            # refreshes the moment the control plane is back.  Only an
+            # empty cache (cold start) still surfaces the error.
+            with st.lock:
+                stale_ok = bool(st.replicas)
+                if stale_ok:
+                    # Re-arm the freshness window so the next 2s of
+                    # requests route on the stale table immediately
+                    # instead of each re-paying the failed lookup.
+                    st.fetched_at = time.monotonic()
+            if not stale_ok:
+                raise
+            from ray_tpu.util import events
+            events.record("serve", "stale_routing", deployment=self._name,
+                          replicas=len(st.replicas))
+            self._ensure_poller()
+            return
         if routing is None:
             raise ValueError(f"deployment {self._name!r} not found")
         self._apply_routing(routing)

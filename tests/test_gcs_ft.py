@@ -1,0 +1,518 @@
+"""GCS crash/restart + network-partition chaos gates (reference:
+python/ray/tests/test_gcs_fault_tolerance.py — head death, restart,
+and the raylet-side resubscribe/reconnect paths; here driven by the
+deterministic chaos plane instead of external process managers).
+
+Covers the "survive the head" acceptance gates:
+
+1. serve traffic rides through a SCRIPTED GCS kill + supervised restart
+   with zero failed requests (the data plane never routes through the
+   head; control-plane calls buffer-and-retry across the outage)
+2. a training run rides through the same kill loss-exact — no recovery
+   burned, final weights bit-identical to the unfaulted closed form
+3. a partition-then-heal cycle fences the stale node: the healed hostd
+   discovers its own death on re-register, kills its stale workers, and
+   rejoins as the next node incarnation (split-brain containment)
+
+plus unit tests for the sustained per-link blackhole plane and the
+GcsClient outage ride-through.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection as fi
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.rpc import EventLoopThread, GcsClient, RpcServer
+
+pytestmark = pytest.mark.chaos
+
+
+def _metric(name, labels=None):
+    from ray_tpu.util import metrics
+    return metrics.read(name, labels) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# Unit: sustained per-link blackholes (chaos_partition_links)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _link_env():
+    """Config + gcs-address label sandbox for link_fault unit tests."""
+    saved_gcs = fi._gcs_address
+    try:
+        yield
+    finally:
+        fi._gcs_address = saved_gcs
+        GLOBAL_CONFIG._overrides.clear()
+        GLOBAL_CONFIG.invalidate_cache()
+
+
+def test_link_fault_window_opens_at_ordinal_and_heals(_link_env):
+    """A rule 'src>dst@at+dur' blackholes that link starting at exactly
+    the src process's `at`-th call on the link, for `dur` wall-clock
+    seconds, then heals — and never re-fires."""
+    GLOBAL_CONFIG.apply_system_config(
+        {"chaos_partition_links": "h2>10.0.0.1:5@2+0.15"})
+    c = fi.ChaosController(1, salt="h2")
+    # Ordinals 0 and 1 pass; ordinal 2 opens the window.
+    assert c.link_fault("10.0.0.1:5") is False
+    assert c.link_fault("10.0.0.1:5") is False
+    assert c.link_fault("10.0.0.1:5") is True
+    assert c.link_fault("10.0.0.1:5") is True   # still inside the window
+    time.sleep(0.2)
+    assert c.link_fault("10.0.0.1:5") is False  # healed
+    assert c.link_fault("10.0.0.1:5") is False  # and stays healed
+    assert c.faults_injected == 1  # the whole window costs one fault
+
+
+def test_link_fault_is_directional(_link_env):
+    """'h2>addr' cuts only h2's OUTBOUND sends: the reverse direction
+    (any other process to the same address) is untouched — asymmetric
+    partitions are expressible."""
+    GLOBAL_CONFIG.apply_system_config(
+        {"chaos_partition_links": "h2>10.0.0.1:5@0+30.0"})
+    victim = fi.ChaosController(1, salt="h2")
+    driver = fi.ChaosController(1, salt="")
+    other = fi.ChaosController(1, salt="h3")
+    assert victim.link_fault("10.0.0.1:5") is True
+    for _ in range(5):
+        assert driver.link_fault("10.0.0.1:5") is False
+        assert other.link_fault("10.0.0.1:5") is False
+    # Unnamed links never advance the named link's ordinal either.
+    assert victim.link_fault("10.9.9.9:1") is False
+
+
+def test_link_fault_gcs_label_and_driver_src(_link_env):
+    """Rules name the head symbolically ('gcs') — whatever ephemeral
+    port it bound — and 'driver' names the saltless launcher process."""
+    fi.set_gcs_address("127.0.0.1:45678")
+    GLOBAL_CONFIG.apply_system_config(
+        {"chaos_partition_links": "driver>gcs@1+30.0"})
+    driver = fi.ChaosController(7, salt="")
+    hostd = fi.ChaosController(7, salt="h1")
+    assert driver.link_fault("127.0.0.1:45678") is False  # ordinal 0
+    assert driver.link_fault("127.0.0.1:45678") is True   # ordinal 1
+    assert hostd.link_fault("127.0.0.1:45678") is False   # wrong src
+
+
+def test_link_fault_malformed_rules_never_crash(_link_env):
+    GLOBAL_CONFIG.apply_system_config(
+        {"chaos_partition_links": "garbage;;h2>@+;h2>a:1@0+0.05"})
+    c = fi.ChaosController(1, salt="h2")
+    # Only the one well-formed rule parses and fires.
+    assert c.link_fault("a:1") is True
+
+
+# ---------------------------------------------------------------------------
+# Unit: GcsClient outage ride-through
+# ---------------------------------------------------------------------------
+
+def test_gcs_client_rides_through_server_restart():
+    """A control-plane call issued while the GCS is DOWN succeeds once a
+    respawn binds the same port — buffered and retried inside the
+    client, no error surfaced (tentpole piece 2)."""
+    io = EventLoopThread("test-gcs-ride")
+    server = RpcServer()
+    served = []
+
+    async def echo(req):
+        served.append(req)
+        return {"echo": req["x"]}
+
+    server.register("Gcs", "Echo", echo)
+    port = io.run(server.start(0))
+    client = GcsClient(f"127.0.0.1:{port}")
+    assert io.run(client.call("Gcs", "Echo", {"x": 1})) == {"echo": 1}
+    io.run(server.stop())
+
+    # "Supervised restart": the same port comes back after ~0.6s.
+    server2 = RpcServer()
+    server2.register("Gcs", "Echo", echo)
+
+    def respawn():
+        time.sleep(0.6)
+        io.run(server2.start(port))
+
+    t = threading.Thread(target=respawn, daemon=True)
+    t.start()
+    outages_before = _metric("gcs_outages")
+    try:
+        reply = io.run(client.call("Gcs", "Echo", {"x": 2}, timeout=5))
+        assert reply == {"echo": 2}
+        assert served[-1] == {"x": 2}
+        # The outage was metered, not silent.
+        assert _metric("gcs_outages") >= outages_before
+    finally:
+        t.join()
+        io.run(client.close())
+        io.run(server2.stop())
+        io.stop()
+
+
+def test_gcs_client_fail_fast_when_outage_retry_disabled():
+    """outage_retry=False keeps fail-fast semantics for callers that
+    MEASURE liveness (the hostd heartbeat loop): a dead head raises
+    within the base retry budget instead of riding the deadline out."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    io = EventLoopThread("test-gcs-failfast")
+    client = GcsClient(f"127.0.0.1:{port}")
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(Exception):
+            io.run(client.call("Gcs", "heartbeat", {}, timeout=1.0,
+                               outage_retry=False))
+        # Way below gcs_outage_deadline_s (30s): it failed fast.
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        io.run(client.close())
+        io.stop()
+
+
+# ---------------------------------------------------------------------------
+# Gate 1: serve traffic through a scripted GCS kill + supervised restart
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def serve_gcs_chaos_cluster(request):
+    cfg = dict(getattr(request, "param", {}))
+    info = ray_tpu.init(num_cpus=4, object_store_memory=64 << 20,
+                        _system_config=cfg)
+    from ray_tpu import serve
+    serve.start()
+    try:
+        yield info
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        from ray_tpu.serve import _private as sp
+        with sp._router_states_lock:
+            sp._router_states.clear()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+def _pump_gcs_ordinals(n, errors, stop=None, tail=40):
+    """Drive the head's request ordinal toward the scripted kill point
+    with cheap KV probes; each probe rides the driver's GcsClient, so
+    the outage itself is absorbed here too.  With `stop`, pumping ends
+    `tail` probes after it first returns True (the kill fired; the tail
+    proves the restored head keeps serving control calls) — keeps the
+    gates' wall time adaptive instead of always burning all n probes."""
+    from ray_tpu import api as _api
+    w = _api._worker
+    extra = None
+    for _ in range(n):
+        try:
+            w.io.run(w.gcs.call("Kv", "kv_exists",
+                                {"ns": "chaos", "key": "pump"}))
+        except Exception as e:  # noqa: BLE001 - the gate asserts on this
+            errors.append(e)
+        if stop is not None:
+            if extra is None:
+                if stop():
+                    extra = tail
+            else:
+                extra -= 1
+                if extra <= 0:
+                    return
+
+
+@pytest.mark.parametrize(
+    "serve_gcs_chaos_cluster",
+    [{"gcs_supervise": True,
+      "chaos_enabled": True, "chaos_seed": 16,
+      # Scripted: the first GCS incarnation ('gcs0') os._exit(1)s right
+      # before serving its 500th control-plane request — mid-burst, with
+      # serve traffic in flight.  The supervisor respawns 'gcs1' at the
+      # same address from the sqlite tables; 'gcs1' is not in the default
+      # salts list, so the cluster converges after exactly one kill.
+      "chaos_kill_gcs_at": 500,
+      "chaos_max_faults": 1}],
+    indirect=True)
+def test_serve_rides_through_scripted_gcs_kill(serve_gcs_chaos_cluster):
+    """ISSUE acceptance gate: scripted GCS kill + supervised restart
+    under live serve traffic — ZERO failed requests.  Routing is cached
+    (stale-on-outage), requests flow peer-to-peer, and every control
+    call buffers across the ~1s head outage."""
+    from ray_tpu import api as _api
+    from ray_tpu import serve
+
+    @serve.deployment(name="head_ft", num_replicas=2,
+                      max_concurrent_queries=8)
+    def double(x):
+        time.sleep(0.02)
+        return 2 * x
+
+    handle = serve.run(double.bind())
+    assert handle.remote(1).result(timeout=60) == 2  # warm routing
+
+    results, req_errors, pump_errors = [], [], []
+
+    def one(i):
+        try:
+            results.append((i, handle.remote(i).result(timeout=120)))
+        except Exception as e:  # noqa: BLE001 - the gate asserts on this
+            req_errors.append(e)
+
+    sup = _api._cluster["group"].supervisors[0]
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(30)]
+    for t in threads:
+        t.start()
+        time.sleep(0.01)
+    # Drive the head's ordinal past the scripted kill point while the
+    # burst is in flight.
+    _pump_gcs_ordinals(1000, pump_errors, stop=lambda: sup.restarts >= 1)
+    for t in threads:
+        t.join(180)
+
+    deadline = time.monotonic() + 30
+    while sup.restarts < 1 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert sup.restarts == 1, "the scripted GCS kill never fired"
+    assert not req_errors, f"requests failed across the outage: {req_errors!r}"
+    assert not pump_errors, f"control calls failed: {pump_errors!r}"
+    assert sorted(results) == [(i, 2 * i) for i in range(30)]
+    # The restored head serves NEW control-plane work (fresh actor).
+    assert handle.remote(21).result(timeout=60) == 42
+
+
+# ---------------------------------------------------------------------------
+# Gate 2: training rides through the same kill loss-exact
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def gcs_chaos_cluster(request):
+    cfg = dict(getattr(request, "param", {}))
+    info = ray_tpu.init(num_cpus=4, object_store_memory=64 << 20,
+                        _system_config=cfg)
+    try:
+        yield info
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+@pytest.mark.parametrize(
+    "gcs_chaos_cluster",
+    [{"gcs_supervise": True,
+      "chaos_enabled": True, "chaos_seed": 16,
+      "chaos_kill_gcs_at": 400,
+      "chaos_max_faults": 1}],
+    indirect=True)
+def test_train_rides_through_scripted_gcs_kill_loss_exact(
+        gcs_chaos_cluster):
+    """ISSUE acceptance gate: the same scripted head kill under a
+    training run — the gang never notices (steps flow worker-side, the
+    driver's control calls buffer), NO recovery is burned, and the final
+    weights are bit-exact with the unfaulted closed form."""
+    import numpy as np
+
+    from ray_tpu import api as _api
+    from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train import DataParallelTrainer
+
+    N = 8
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu.train import session
+
+        w = np.zeros(4)
+        for step in range(N):
+            w = w + (step + 1)
+            time.sleep(0.3)
+            session.report({"step": step, "w": w.tolist()})
+
+    recoveries_before = _metric("train_recoveries", {"reason": "failure"})
+    sup = _api._cluster["group"].supervisors[0]
+    pump_errors = []
+
+    def pump_late():
+        time.sleep(1.5)  # let the gang form first
+        _pump_gcs_ordinals(800, pump_errors, stop=lambda: sup.restarts >= 1)
+
+    pt = threading.Thread(target=pump_late, daemon=True)
+    pt.start()
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=0)))
+    result = trainer.fit()
+    pt.join(120)
+
+    deadline = time.monotonic() + 30
+    while sup.restarts < 1 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert sup.restarts == 1, "the scripted GCS kill never fired"
+    assert not pump_errors, f"control calls failed: {pump_errors!r}"
+    # Loss-exact: max_failures=0 means any hiccup would have failed the
+    # run; the history is complete and the weights match the closed form.
+    assert result.error is None
+    assert result.metrics["step"] == N - 1
+    assert {m["step"] for m in result.metrics_history} == set(range(N))
+    clean = np.zeros(4)
+    for s in range(N):
+        clean = clean + (s + 1)
+    np.testing.assert_array_equal(np.asarray(result.metrics["w"]), clean)
+    # No recovery was burned riding out the head outage.
+    assert _metric("train_recoveries",
+                   {"reason": "failure"}) == recoveries_before
+
+
+# ---------------------------------------------------------------------------
+# Gate 3: partition-then-heal fences the stale node
+# ---------------------------------------------------------------------------
+
+def test_partition_then_heal_fences_stale_node():
+    """ISSUE acceptance gate: a sustained hostd->GCS blackhole gets the
+    node declared dead and its actor failed over; when the link heals,
+    the node's re-register is REFUSED (stale incarnation), it fences
+    itself — killing the stale worker — and rejoins as incarnation 1,
+    where the pending failover lands as a FRESH worker.  The op counts
+    prove no double-apply: the replacement starts from clean state and
+    the stale incarnation never serves again."""
+    from ray_tpu._private import node as node_mod
+    from ray_tpu.cluster_utils import Cluster
+
+    base = node_mod._hostd_spawn_seq
+    env = {
+        # Fast liveness so the partition converts to node death quickly;
+        # gcs.py reads these at import in the daemon processes.
+        "RAY_TPU_HEARTBEAT_INTERVAL_S": "0.25",
+        "RAY_TPU_NODE_DEATH_TIMEOUT_S": "2.0",
+        "RAY_TPU_CHAOS_ENABLED": "1",
+        "RAY_TPU_CHAOS_SEED": "16",
+        # Scripted asymmetric partition: the SECOND hostd's outbound GCS
+        # link blackholes at its 40th call (~5s in at 8 calls/s:
+        # heartbeat + node-watch every 0.25s — well past actor setup)
+        # for 4 seconds — double the 2s death timeout.  GCS->node and
+        # worker links stay up: the stale worker keeps running, which is
+        # the split-brain.
+        "RAY_TPU_CHAOS_PARTITION_LINKS": f"h{base + 2}>gcs@40+4.0",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    GLOBAL_CONFIG.invalidate_cache()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    node2 = cluster.add_node(num_cpus=2, resources={"pin2": 1})
+    try:
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.gcs_address)
+        from ray_tpu import api as _api
+        w = _api._worker
+
+        @ray_tpu.remote(max_restarts=2, max_task_retries=-1,
+                        resources={"pin2": 0.5})
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return (os.getpid(), self.n)
+
+        c = Counter.remote()
+        pid1, v = ray_tpu.get(c.inc.remote(), timeout=60)
+        assert v == 1
+        for expect in (2, 3):
+            p, v = ray_tpu.get(c.inc.remote(), timeout=30)
+            assert (p, v) == (pid1, expect)
+
+        def node2_info():
+            reply = w.io.run(w.gcs.call("Gcs", "get_nodes", {}, timeout=10))
+            for n in reply["nodes"]:
+                if n.node_id.hex() == node2["node_id"]:
+                    return n
+            return None
+
+        # Phase 1: the partition opens and the head declares node2 dead.
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            info = node2_info()
+            if info is not None and not info.alive:
+                break
+            time.sleep(0.25)
+        assert info is not None and not info.alive, \
+            "partition never got node2 declared dead"
+        # Split-brain window: the stale worker is still running (the
+        # partition only cut the hostd's control link).
+        try:
+            os.kill(pid1, 0)
+        except OSError:
+            pytest.fail("stale worker died before fencing — no split brain")
+
+        # Phase 2: the link heals, the node fences itself and rejoins as
+        # the next incarnation.
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            info = node2_info()
+            if info is not None and info.alive and \
+                    int(getattr(info, "incarnation", 0)) >= 1:
+                break
+            time.sleep(0.25)
+        assert info is not None and info.alive, "node2 never rejoined"
+        assert int(getattr(info, "incarnation", 0)) == 1, \
+            "rejoin did not bump the node incarnation"
+
+        # Phase 3: the failover lands back on the healed node as a FRESH
+        # worker; the stale incarnation is dead and its state is gone.
+        deadline = time.monotonic() + 60
+        pid2 = None
+        while time.monotonic() < deadline:
+            try:
+                pid2, v = ray_tpu.get(c.inc.remote(), timeout=10)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert pid2 is not None, "actor never came back after the heal"
+        assert pid2 != pid1, "failover reused the fenced worker"
+        # Fresh state (the __init__ re-ran): counting restarts at 1, and
+        # subsequent ops apply exactly once, in order.
+        assert v == 1
+        for expect in (2, 3):
+            p, v = ray_tpu.get(c.inc.remote(), timeout=30)
+            assert (p, v) == (pid2, expect)
+        # The stale worker was killed by the fence, not left running.
+        fence_deadline = time.monotonic() + 20
+        while time.monotonic() < fence_deadline:
+            try:
+                os.kill(pid1, 0)
+                time.sleep(0.25)
+            except OSError:
+                break
+        with pytest.raises(OSError):
+            os.kill(pid1, 0)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cluster.shutdown()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            GLOBAL_CONFIG.invalidate_cache()
+            fi.reset()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-v", "-x"]))

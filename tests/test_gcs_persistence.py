@@ -3,6 +3,8 @@ GCS fault tolerance with Redis-backed tables; here sqlite rows per record)."""
 
 import asyncio
 import os
+import subprocess
+import sys
 import tempfile
 
 
@@ -100,3 +102,186 @@ def test_gcs_persistence_writes_are_o_delta():
         g2.storage.close()
 
     asyncio.run(check())
+
+
+# ---------------------------------------------------------------------------
+# Post-restart reconciliation (_reconcile_restored)
+# ---------------------------------------------------------------------------
+
+def _fresh_gcs():
+    from ray_tpu._private.gcs import GcsServer, GcsTableStorage
+    return GcsServer(storage=GcsTableStorage(None))
+
+
+def test_reconcile_restored_pings_alive_actors():
+    """A restored-ALIVE actor is pinged at its recorded address: a
+    reachable one is left untouched, an unreachable one goes through the
+    normal interruption/restart path WITHOUT the GCS pretending its
+    worker survived (reference: RayletNotifyGCSRestart)."""
+    from ray_tpu._private.ids import ActorID, JobID
+    from ray_tpu._private.protocol import ActorInfo
+    from ray_tpu._private.rpc import RpcServer
+
+    async def run():
+        g = _fresh_gcs()
+        scheduled = []
+
+        async def fake_schedule(actor):
+            scheduled.append(actor.actor_id)
+
+        g._schedule_actor = fake_schedule
+
+        # A live "worker" answering CoreWorker.Ping.
+        server = RpcServer()
+
+        async def ping(req):
+            return {"ok": True}
+
+        server.register("CoreWorker", "Ping", ping)
+        port = await server.start(0)
+
+        jid = JobID(b"\x01\x00\x00\x00")
+        alive_ok = ActorInfo(actor_id=ActorID.of(jid), state="ALIVE",
+                             address=f"127.0.0.1:{port}", max_restarts=3)
+        alive_gone = ActorInfo(actor_id=ActorID.of(jid), state="ALIVE",
+                               address="127.0.0.1:1", max_restarts=3)
+        g.actors[alive_ok.actor_id] = alive_ok
+        g.actors[alive_gone.actor_id] = alive_gone
+        await g._reconcile_restored()
+        await asyncio.sleep(0.05)  # drain the ensure_future'd schedule
+
+        # Reachable: untouched — no restart burned, still ALIVE there.
+        assert alive_ok.state == "ALIVE"
+        assert alive_ok.num_restarts == 0
+        assert alive_ok.actor_id not in scheduled
+        # Unreachable: interrupted through the restart path.
+        assert alive_gone.state == "RESTARTING"
+        assert alive_gone.num_restarts == 1
+        assert alive_gone.actor_id in scheduled
+        await server.stop()
+        g.storage.close()
+
+    asyncio.run(run())
+
+
+def test_reconcile_restored_resumes_pending_without_burning_restart():
+    """PENDING/RESTARTING actors restored from the tables never FAILED —
+    they resume scheduling with the restart budget untouched."""
+    from ray_tpu._private.ids import ActorID, JobID
+    from ray_tpu._private.protocol import ActorInfo
+
+    async def run():
+        g = _fresh_gcs()
+        scheduled = []
+
+        async def fake_schedule(actor):
+            scheduled.append(actor.actor_id)
+
+        g._schedule_actor = fake_schedule
+        jid = JobID(b"\x01\x00\x00\x00")
+        pending = ActorInfo(actor_id=ActorID.of(jid), state="PENDING",
+                            max_restarts=2)
+        restarting = ActorInfo(actor_id=ActorID.of(jid), state="RESTARTING",
+                               max_restarts=2, num_restarts=1)
+        dead = ActorInfo(actor_id=ActorID.of(jid), state="DEAD")
+        for a in (pending, restarting, dead):
+            g.actors[a.actor_id] = a
+        await g._reconcile_restored()
+        await asyncio.sleep(0.05)
+
+        assert pending.actor_id in scheduled
+        assert restarting.actor_id in scheduled
+        assert dead.actor_id not in scheduled
+        # The budget is untouched: resuming is not a failure.
+        assert pending.num_restarts == 0
+        assert restarting.num_restarts == 1
+        g.storage.close()
+
+    asyncio.run(run())
+
+
+def test_reconcile_restored_reschedules_pg_bundles():
+    """Restored PGs lose their bundle placements (nodes re-register with
+    fresh state after a head restart) and go back through scheduling."""
+    from ray_tpu._private.ids import PlacementGroupID
+    from ray_tpu._private.protocol import PlacementGroupInfo
+
+    async def run():
+        g = _fresh_gcs()
+        rescheduled = []
+
+        async def fake_schedule_pg(info):
+            rescheduled.append(info.pg_id)
+
+        g._schedule_pg = fake_schedule_pg
+        pg = PlacementGroupInfo(
+            pg_id=PlacementGroupID.from_random(),
+            bundles=[{"CPU": 1}, {"CPU": 1}], state="CREATED",
+            bundle_nodes=["stale-node", "stale-node"],
+            bundle_addresses=["127.0.0.1:9", "127.0.0.1:9"])
+        removed = PlacementGroupInfo(
+            pg_id=PlacementGroupID.from_random(),
+            bundles=[{"CPU": 1}], state="REMOVED")
+        g.placement_groups[pg.pg_id] = pg
+        g.placement_groups[removed.pg_id] = removed
+        await g._reconcile_restored()
+        await asyncio.sleep(0.05)
+
+        assert pg.pg_id in rescheduled
+        assert pg.state == "PENDING"
+        assert pg.bundle_nodes == [None, None]
+        assert pg.bundle_addresses == ["", ""]
+        assert removed.pg_id not in rescheduled
+        g.storage.close()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Crash-atomicity of the coalesced flush (scripted mid-flush kill)
+# ---------------------------------------------------------------------------
+
+_FLUSH_CRASH_CHILD = r"""
+import os, sys
+from ray_tpu._private.gcs import GcsTableStorage
+
+path = sys.argv[1]
+st = GcsTableStorage(path)
+# Flush 0: committed baseline (the chaos ordinal for flush 0 passes).
+st.write_rows([("t1", b"k0", b"v0")], [])
+# Flush 1: multi-row coalesced write; the scripted kill fires after the
+# executemany staged every row but BEFORE the transaction commits.
+st.write_rows([("t1", b"k%d" % i, b"v%d" % i) for i in range(1, 9)], [])
+print("survived", flush=True)  # must never be reached
+"""
+
+
+def test_mid_flush_kill_rolls_back_whole_flush(tmp_path):
+    """Killing the GCS inside a persistence flush (after executemany,
+    before COMMIT) must roll back the ENTIRE flush on restore — a torn
+    prefix of the coalesced write would resurrect half a state
+    transition.  Proves crash-atomicity of the batched-write path."""
+    from ray_tpu._private.gcs import GcsTableStorage
+
+    path = str(tmp_path / "gcs.sqlite")
+    env = dict(os.environ)
+    env.update({
+        "RAY_TPU_CHAOS_ENABLED": "1",
+        "RAY_TPU_CHAOS_SEED": "1",
+        "RAY_TPU_CHAOS_KILL_GCS_FLUSH_AT": "1",
+        # The child is "incarnation 0" of the head for salt purposes.
+        "RAY_TPU_CHAOS_PROC_SALT": "gcs0",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _FLUSH_CRASH_CHILD, path],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1, proc.stderr
+    assert "survived" not in proc.stdout
+
+    st = GcsTableStorage(path)
+    state = st.load_all()
+    st.close()
+    assert state is not None
+    # Flush 0 is durable; NO row of flush 1 leaked through the crash.
+    assert set(state.get("t1", {})) == {b"k0"}

@@ -302,7 +302,11 @@ def test_llama_7b_param_count():
     assert 6.5e9 < n < 7.0e9, n
 
 
+@pytest.mark.slow
 def test_resnet_memorizes():
+    """60 adam steps of resnet18 — a learning gate, so it carries `slow`
+    like the other learning gates (~90s, a tenth of the fast-suite
+    budget, and it is one of the documented jax-on-CPU seed failures)."""
     from ray_tpu.models import resnet
     cfg = resnet.CONFIGS["resnet18-cifar"]
     init_state, train_step = resnet.make_train_step(cfg, optax.adam(3e-3))

@@ -133,3 +133,30 @@ def test_pp_span_kinds_present():
     }
     missing = (required_spans | required_instants) - sites
     assert not missing, f"pp plane kinds vanished: {missing}"
+
+
+def test_gcs_ft_event_kinds_present():
+    """The head-survival plane (PR 16) is observable only through these
+    instants: the availability bench and the chaos gates key on the
+    kill/restore/fence records, and `cli events` surfaces outages via
+    unreachable/reconnected.  Pin them so refactors cannot silently
+    blind the recovery tooling."""
+    sites = {(pl, k) for _, _, pl, k in _call_sites()}
+    required = {
+        ("gcs", "restored"),            # gcs: tables rebuilt from sqlite
+        ("gcs", "node_fenced"),         # gcs: stale re-register refused
+        ("gcs", "node_resync"),         # gcs: anti-entropy snapshot applied
+        ("gcs", "chaos_kill"),          # gcs: scripted pre-request kill
+        ("gcs", "chaos_kill_flush"),    # gcs: scripted mid-flush kill
+        ("gcs", "supervisor_respawn"),  # launcher: head respawned in place
+        ("gcs", "supervisor_gave_up"),  # launcher: restart budget spent
+        ("gcs", "unreachable"),         # client/hostd: outage onset
+        ("gcs", "reconnected"),         # client: outage over, duration
+        ("link", "blackhole"),          # chaos: partition window opened
+        ("link", "heal"),               # chaos: partition window closed
+        ("proc", "node_fenced"),        # hostd: killed own stale workers
+        ("proc", "stale_actor_reaped"), # hostd: one failed-over actor gone
+        ("serve", "stale_routing"),     # router: served on cache in outage
+    }
+    missing = required - sites
+    assert not missing, f"gcs-ft event kinds vanished: {missing}"
