@@ -111,7 +111,8 @@ def _run_mpmd(args):
     tr = PipelineTrainer(
         jax_stage_fns(stage_fn, loss_fn), params, lr=0.05,
         n_microbatches=args.n_micro, schedule="1f1b",
-        queue_depth=args.queue_depth)
+        queue_depth=args.queue_depth, interleave=args.interleave,
+        prefetch=bool(args.prefetch))
     loss = tr.forward_only(xs, ts)               # warm workers + parity
     t0 = time.perf_counter()
     for _ in range(args.reps):
@@ -125,7 +126,8 @@ def _run_mpmd(args):
     tr.shutdown()
     ray_tpu.shutdown()
     return {"loss": loss, "fwd_tokens_per_s": rows / wall, "wall_s": wall,
-            "bubble_fraction": bubble, "train_step_s": step_s}
+            "bubble_fraction": bubble, "train_step_s": step_s,
+            "gangs": N_STAGES // args.interleave}
 
 
 def main():
@@ -135,6 +137,10 @@ def main():
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--train-steps", type=int, default=5)
     ap.add_argument("--queue-depth", type=int, default=2)
+    ap.add_argument("--interleave", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--prefetch", type=int, default=0,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--mode", choices=["dryrun", "mpmd"], default=None,
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -146,13 +152,15 @@ def main():
         print(json.dumps(_run_mpmd(args)))
         return
 
-    def run(mode):
+    def run(mode, interleave=1, prefetch=0):
         cmd = [sys.executable, os.path.abspath(__file__), "--mode", mode,
                "--n-micro", str(args.n_micro),
                "--micro-batch", str(args.micro_batch),
                "--reps", str(args.reps),
                "--train-steps", str(args.train_steps),
-               "--queue-depth", str(args.queue_depth)]
+               "--queue-depth", str(args.queue_depth),
+               "--interleave", str(interleave),
+               "--prefetch", str(prefetch)]
         p = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=600)
         if p.returncode != 0:
@@ -160,28 +168,46 @@ def main():
         return json.loads(p.stdout.strip().splitlines()[-1])
 
     dryrun = run("dryrun")
-    mpmd = run("mpmd")
+    # Round-15 baseline row first, then the two overlap levers: pre-push
+    # alone (same 4 gangs), then interleave v=2 + pre-push (2 gangs each
+    # owning 2 non-adjacent chunks).
+    mpmd_modes = [
+        ("baseline_1f1b", 1, 0),
+        ("prepush", 1, 1),
+        ("interleaved_prepush", 2, 1),
+    ]
+    modes = {}
+    for name, v, pf in mpmd_modes:
+        r = run("mpmd", interleave=v, prefetch=pf)
+        # The loss-exactness gate, per mode: same params, same math.
+        drift = abs(r["loss"] - dryrun["loss"])
+        tol = 1e-5 * max(1.0, abs(dryrun["loss"]))
+        if drift > tol:
+            raise SystemExit(
+                f"{name}: MPMD loss {r['loss']} != dryrun loss "
+                f"{dryrun['loss']} (drift {drift:.3e} > tol {tol:.3e})")
+        modes[name] = {
+            "fwd_tokens_per_s": round(r["fwd_tokens_per_s"], 1),
+            "bubble_fraction": round(r["bubble_fraction"], 4),
+            "train_step_s": round(r["train_step_s"], 4),
+            "gangs": r["gangs"],
+            "loss_drift": drift,
+        }
 
-    # The loss-exactness gate: same params, same schedule, same math.
-    drift = abs(mpmd["loss"] - dryrun["loss"])
-    tol = 1e-5 * max(1.0, abs(dryrun["loss"]))
-    if drift > tol:
-        raise SystemExit(
-            f"MPMD loss {mpmd['loss']} != dryrun loss {dryrun['loss']} "
-            f"(drift {drift:.3e} > tol {tol:.3e})")
-
+    mpmd = modes["interleaved_prepush"]
     print(json.dumps({
         "metric": "pp_mpmd_fwd_tokens_per_s",
-        "value": round(mpmd["fwd_tokens_per_s"], 1),
+        "value": modes["prepush"]["fwd_tokens_per_s"],
         "unit": "rows_per_s",
-        "vs_baseline": round(mpmd["fwd_tokens_per_s"]
+        "vs_baseline": round(modes["prepush"]["fwd_tokens_per_s"]
                              / max(dryrun["fwd_tokens_per_s"], 1e-9), 4),
         "dryrun_fwd_tokens_per_s": round(dryrun["fwd_tokens_per_s"], 1),
-        "bubble_fraction": round(mpmd["bubble_fraction"], 4),
-        "train_step_s": round(mpmd["train_step_s"], 4),
-        "loss_mpmd": mpmd["loss"],
+        "bubble_fraction": mpmd["bubble_fraction"],
+        "bubble_fraction_baseline": modes["baseline_1f1b"][
+            "bubble_fraction"],
+        "train_step_s": mpmd["train_step_s"],
+        "modes": modes,
         "loss_dryrun": dryrun["loss"],
-        "loss_drift": drift,
         "stages": N_STAGES,
         "n_micro": args.n_micro,
         "micro_batch": args.micro_batch,

@@ -8,9 +8,12 @@ from ray_tpu.parallel.mesh import (  # noqa: F401
     MeshConfig,
     create_mesh,
     create_two_level_mesh,
+    dcn_cut_edges,
     mesh_axis_size,
+    pipeline_placement_resources,
     single_device_mesh,
     slice_index_of,
+    stage_slice_plan,
 )
 from ray_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,
@@ -23,6 +26,7 @@ from ray_tpu.parallel.sharding import (  # noqa: F401
     with_logical_constraint,
 )
 from ray_tpu.parallel.pipeline import (  # noqa: F401
+    chunk_assignment,
     pipeline_apply,
     pipeline_loss_dryrun,
     stack_stage_params,

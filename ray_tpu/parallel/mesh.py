@@ -144,6 +144,59 @@ def slice_index_of(mesh: Mesh, n_slices: int) -> np.ndarray:
     return np.vectorize(lambda d: d.id // per_slice)(devs)
 
 
+def stage_slice_plan(n_gangs: int, n_slices: int) -> list:
+    """Gang -> slice assignment for topology-aware pipeline placement.
+
+    Gangs (pipeline stage-actor groups, `train.pipeline_trainer`) are
+    packed into contiguous blocks per slice, so chunk hand-offs between
+    gangs inside one block ride ICI and only block boundaries cross DCN
+    — the multislice discipline `create_two_level_mesh` encodes for
+    GSPMD programs, applied to the MPMD actor pipeline.  With the
+    interleaved schedule (gang g owns chunks ``g, g+n_gangs, ...``)
+    adjacent chunks are owned by adjacent gangs (mod n_gangs), so a
+    contiguous gang block keeps adjacent chunks ICI-near by
+    construction.
+
+    Returns a list of length `n_gangs`: plan[g] = slice id.
+    """
+    if n_slices <= 0:
+        raise ValueError(f"n_slices must be positive, got {n_slices}")
+    if n_gangs % n_slices:
+        raise ValueError(
+            f"{n_gangs} gangs not divisible into {n_slices} slices — "
+            f"unequal blocks would leave one slice's ICI underused")
+    per = n_gangs // n_slices
+    return [g // per for g in range(n_gangs)]
+
+
+def dcn_cut_edges(plan: Sequence[int], n_chunks: int) -> list:
+    """Chunk boundaries (c, c+1) whose hand-off crosses a DCN (slice)
+    boundary under a gang->slice `plan` with round-robin chunk
+    ownership (chunk c is owned by gang ``c % len(plan)``).
+
+    This is the placement quality oracle: the pipeline should be cut at
+    as few DCN edges as the slice count forces — ``len(plan)`` gangs in
+    ``s`` slices force at least ``s - 1`` cuts per forward pass (plus
+    interleave wraparounds), and a contiguous-block plan achieves that
+    minimum for v=1."""
+    n_gangs = len(plan)
+    cuts = []
+    for c in range(n_chunks - 1):
+        if plan[c % n_gangs] != plan[(c + 1) % n_gangs]:
+            cuts.append((c, c + 1))
+    return cuts
+
+
+def pipeline_placement_resources(plan: Sequence[int],
+                                 prefix: str = "pp_slice_") -> list:
+    """Per-gang custom-resource dicts realizing a `stage_slice_plan`:
+    gang g's placement-group bundles demand ``{prefix}{plan[g]}: 1`` so
+    its actors can only land on nodes advertising that slice resource
+    (nodes declare e.g. ``resources={"pp_slice_0": 4}`` at start).
+    Feed the result to ``PipelineTrainer(placement_plan=...)``."""
+    return [{f"{prefix}{s}": 1} for s in plan]
+
+
 def single_device_mesh() -> Mesh:
     """A 1-chip mesh with all axes size 1 — lets one jitted program serve
     both single-chip and pod runs without branching."""

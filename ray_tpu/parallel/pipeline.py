@@ -102,6 +102,22 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     return fn(stage_params, microbatches)
 
 
+def chunk_assignment(n_chunks: int, n_gangs: int) -> list:
+    """Round-robin chunk ownership for the interleaved (looping) MPMD
+    schedule: gang g owns chunks ``g, g+n_gangs, ...`` — non-adjacent by
+    construction, so every gang has work during warmup/drain and the
+    pipeline bubble shrinks ~1/v for ``v = n_chunks // n_gangs`` chunks
+    per gang.  Shared between the MPMD trainer and tests so the dryrun
+    parity checks assert against the exact ownership the trainer uses.
+
+    Returns a list of length `n_gangs`: assignment[g] = sorted chunk ids.
+    """
+    if n_gangs <= 0 or n_chunks % n_gangs:
+        raise ValueError(
+            f"{n_chunks} chunks not divisible across {n_gangs} gangs")
+    return [list(range(g, n_chunks, n_gangs)) for g in range(n_gangs)]
+
+
 def stack_stage_params(per_stage_params: list) -> Any:
     """Stack a list of per-stage param pytrees along a new leading dim."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -1,32 +1,55 @@
 """MPMD pipeline-parallel stage runtime: per-stage actor gangs.
 
-Each pipeline stage is its own gang of actors under its own placement
-group (an atomic slice reservation), running its own program — the MPMD
-shape of arxiv 2412.14374, where the runtime (not XLA) owns the
-inter-stage hop.  Activations and gradients cross stages as objects over
-the native shm-to-shm transfer plane: a stage's ``forward`` returns the
-activation as a second return value whose ObjectRef the driver hands to
-the next stage *wrapped in a tuple*, so the bytes move store-to-store and
-the receiving stage resolves them inside a ``pp/xfer`` span (top-level
-args would be resolved by the task layer before the method body runs,
-hiding the transfer from attribution).
+Each pipeline *gang* is a group of actors under its own placement group
+(an atomic slice reservation), running its own program — the MPMD shape
+of arxiv 2412.14374, where the runtime (not XLA) owns the inter-stage
+hop.  A gang owns one or more **stage-chunks** (the interleaved/looping
+schedule: gang g owns chunks ``g, g+n_gangs, ...`` — non-adjacent, so
+every gang computes during warmup/drain and the pipeline bubble shrinks
+by ~1/v for v chunks per gang).  Activations and gradients cross chunks
+as objects over the native shm-to-shm transfer plane: a chunk's
+``forward`` returns the activation as a second return value whose
+ObjectRef the driver hands to the next chunk *wrapped in a tuple*, so
+the bytes move store-to-store and the receiving gang resolves them
+inside a ``pp/xfer`` span (top-level args would be resolved by the task
+layer before the method body runs, hiding the transfer from
+attribution).
+
+**Pre-pushed activations** take the transfer off the critical path: the
+driver ships a sealed activation ref to the consumer's ``prefetch``
+method the moment the producer's forward completes, while the consumer
+is still computing an earlier microbatch.  ``prefetch`` rides the
+actor's spare concurrency threads, resolves the ref inside a
+``pp/xfer_overlap`` span, and parks the bytes in a bounded
+**double-buffered receive window**; the consumer's ``forward`` then
+takes the resident copy for free, waits briefly inside ``pp/recv_wait``
+if the prefetch is still in flight, or falls back to the blocking
+``pp/xfer`` fetch if nothing was pushed — so transfer time is either
+hidden under compute or visibly attributed, never silently both.
 
 Robustness contract (the reason MPMD beats the single-program dryrun in
-`parallel/pipeline.py`): a stage gang dying must not tear down the
-pipeline.  All state a stage holds falls into three recovery classes:
+`parallel/pipeline.py`): a gang dying must not tear down the pipeline.
+All state a gang holds falls into three recovery classes:
 
-- **params / optimizer version** — recovered from the stage's own
-  sharded checkpoint (`checkpoint/` subsystem, COMMITTED steps only);
-- **vjp residuals + per-microbatch grad contributions** — process-local
-  and unrecoverable, so the driver replays exactly the current step's
-  microbatches through the re-formed gang, re-feeding the upstream
-  stage's still-sealed outputs (lineage through the object plane);
-- **activations already shipped downstream** — sealed in the node store,
-  which survives worker death, so downstream stages never recompute.
+- **params / optimizer version** — recovered from the gang's own
+  sharded checkpoint (`checkpoint/` subsystem, COMMITTED steps only;
+  one tree holding every owned chunk's params);
+- **vjp residuals + per-microbatch grad contributions + the receive
+  window** — process-local and unrecoverable, so the driver replays
+  exactly the current step's microbatches through the re-formed gang,
+  re-feeding (and re-pushing) the upstream chunks' still-sealed outputs
+  (lineage through the object plane).  Prefetched-but-unconsumed
+  activations are *replayable state*: the stage fns are deterministic,
+  so a replayed producer reseals bit-identical bytes and a consumer
+  holding the pre-kill copy cannot diverge;
+- **activations already shipped downstream** — sealed in the node
+  store, which survives worker death, so downstream chunks never
+  recompute.
 
-Grad contributions are kept **per microbatch** and summed in sorted
-microbatch order at update time, so a replayed schedule folds to
-bit-identical gradients regardless of completion order.
+Grad contributions are kept **per chunk, per microbatch** and summed in
+sorted microbatch order at update time, so a replayed (or interleaved)
+schedule folds to bit-identical gradients regardless of completion
+order.
 
 The stage fns are framework-agnostic plain callables (cloudpickled to
 the gang), so a numpy-only model keeps stage workers jax-free:
@@ -42,8 +65,9 @@ the gang), so a numpy-only model keeps stage workers jax-free:
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -88,26 +112,36 @@ def tree_add(a, b):
 
 @ray_tpu.remote
 class PipelineStageActor:
-    """One member of one stage's gang.
+    """One member of one gang.
 
     Methods that compute (`forward`/`backward`/`partial_grads`/
     `apply_update`) are dispatched at most one-at-a-time per member by
-    the driver; `beacon`/`stats` ride the actor's spare concurrency
-    threads so liveness probes answer mid-compute (the PR 6 watchdog
-    pattern)."""
+    the driver; `beacon`/`stats`/`prefetch` ride the actor's spare
+    concurrency threads so liveness probes answer — and pre-pushed
+    activations resolve — mid-compute (the PR 6 watchdog pattern,
+    reused as the comm/compute overlap mechanism)."""
 
     def setup(self, spec: dict) -> bool:
-        self.stage = int(spec["stage"])
-        self.n_stages = int(spec["n_stages"])
+        self.stage = int(spec["stage"])          # gang index
+        self.n_stages = int(spec["n_stages"])    # total chunks end-to-end
         self.member = int(spec["member"])
         self.gang = int(spec["gang"])
         self.incarnation = int(spec.get("incarnation", 0))
+        chunks = spec.get("chunks")
+        if chunks is None:
+            # Single-chunk legacy spec: the gang index IS the chunk.
+            self.chunks = [self.stage]
+            params = {self.stage: spec["params"]}
+        else:
+            self.chunks = sorted(int(c) for c in chunks)
+            params = {int(c): t for c, t in spec["params"].items()}
         self._fwd = spec["stage_fwd"]
         self._bwd = spec["stage_bwd"]
         self._loss_fwd = spec.get("loss_fwd")
         self._loss_bwd = spec.get("loss_bwd")
         self.lr = float(spec["lr"])
-        self.params = tree_map(np.asarray, spec["params"])
+        self.params = {c: tree_map(np.asarray, params[c])
+                       for c in self.chunks}
         self.version = 0
         self._ckpt_mgr = None
         root = spec.get("ckpt_root") or ""
@@ -116,11 +150,29 @@ class PipelineStageActor:
             self._ckpt_mgr = CheckpointManager(
                 root, keep_last_k=int(spec.get("keep_last_k", 8)),
                 save_id=f"s{self.stage}m{self.member}i{self.incarnation}")
-        # Per-step state: vjp caches + per-microbatch grad contributions.
-        self._caches: Dict[int, Any] = {}
-        self._grads: Dict[int, Any] = {}
+        # Per-step state: vjp caches keyed (chunk, mb) + per-chunk
+        # per-microbatch grad contributions.
+        self._caches: Dict[Tuple[int, int], Any] = {}
+        self._grads: Dict[int, Dict[int, Any]] = {c: {} for c in self.chunks}
         self._losses: Dict[int, float] = {}
         self._partial_cache = None
+        # Double-buffered receive window: pre-pushed activations keyed
+        # (step, chunk, mb).  prefetch() threads produce, forward()
+        # consumes; the condition serializes the hand-off.  Consumed
+        # keys are remembered so a late prefetch (forward already fell
+        # back to the blocking fetch) is discarded, not leaked.
+        self._recv_cv = threading.Condition()
+        self._recv: Dict[Tuple[int, int, int], Any] = {}
+        self._recv_pending: set = set()
+        self._recv_err: Dict[Tuple[int, int, int], BaseException] = {}
+        self._recv_consumed: set = set()
+        self._recv_peak = 0
+        self._recv_hits = 0
+        self._recv_waits = 0
+        self._recv_misses = 0
+        self._prefetch_discards = 0
+        self._recv_wait_timeout_s = float(
+            spec.get("recv_wait_timeout_s", 30.0))
         # Bubble/stall accounting: gaps between ops inside one step.
         self._last_op_end = time.monotonic()
         self._busy_s = 0.0
@@ -144,7 +196,13 @@ class PipelineStageActor:
     def stats(self) -> dict:
         return {"stage": self.stage, "member": self.member,
                 "busy_s": self._busy_s, "idle_s": self._idle_s,
-                "ops": self._ops, "version": self.version}
+                "ops": self._ops, "version": self.version,
+                "chunks": list(self.chunks),
+                "recv_peak": self._recv_peak,
+                "recv_hits": self._recv_hits,
+                "recv_waits": self._recv_waits,
+                "recv_misses": self._recv_misses,
+                "prefetch_discards": self._prefetch_discards}
 
     # ---------------- op bookkeeping ----------------
 
@@ -164,71 +222,171 @@ class PipelineStageActor:
         self._last_op_end = now
         self._ops += 1
 
-    def _fetch(self, wrapped, what: str):
+    def _fetch(self, wrapped, what: str, chunk: Optional[int] = None):
         """Resolve a tuple-wrapped ObjectRef (or pass a raw value
-        through) inside a pp/xfer span — the inter-stage hop."""
+        through) inside a pp/xfer span — the *blocking* inter-stage hop
+        (the prefetch path resolves inside pp/xfer_overlap instead)."""
         if wrapped is None:
             return None
         (ref,) = wrapped
         if not isinstance(ref, ray_tpu.ObjectRef):
             return ref
         from ray_tpu.util import spans
-        with spans.span("pp", "xfer", stage=self.stage, what=what):
+        with spans.span("pp", "xfer", stage=self.stage, what=what,
+                        chunk=chunk):
             return ray_tpu.get(ref)
+
+    # ---------------- pre-pushed receive window ----------------
+
+    def prefetch(self, step: int, chunk: int, mb: int, xw) -> dict:
+        """Resolve a pre-pushed activation ref into the receive window.
+
+        Runs on a spare concurrency thread while forward/backward
+        compute on another, so `pp/xfer_overlap` elapses concurrently
+        with compute instead of on the step's critical path.  Errors
+        (e.g. the object died with a node) are parked for the consuming
+        forward to re-raise — the driver's recovery then runs exactly as
+        it would for a blocking-fetch failure."""
+        from ray_tpu.util import spans
+        key = (int(step), int(chunk), int(mb))
+        with self._recv_cv:
+            if (key in self._recv_consumed or key in self._recv
+                    or key in self._recv_pending):
+                # Late push after the consumer fell back to a blocking
+                # fetch, or a replay re-push of a still-resident entry:
+                # drop it (the sealed bytes are identical either way).
+                self._prefetch_discards += 1
+                return {"stored": False}
+            self._recv_pending.add(key)
+        val = err = None
+        try:
+            (ref,) = xw
+            if isinstance(ref, ray_tpu.ObjectRef):
+                with spans.span("pp", "xfer_overlap", stage=self.stage,
+                                chunk=chunk, mb=mb):
+                    val = ray_tpu.get(ref)
+            else:
+                val = ref
+        except BaseException as e:       # parked, re-raised by forward
+            err = e
+        with self._recv_cv:
+            self._recv_pending.discard(key)
+            if key in self._recv_consumed:
+                self._prefetch_discards += 1
+            elif err is not None:
+                self._recv_err[key] = err
+            else:
+                self._recv[key] = val
+                # Peak residency per CHUNK — the observable the
+                # backpressure bound governs (<= recv_window, +1 while
+                # a consuming forward is mid-execution).
+                depth = sum(1 for k in self._recv if k[1] == key[1])
+                self._recv_peak = max(self._recv_peak, depth)
+            self._recv_cv.notify_all()
+        return {"stored": err is None}
+
+    def _take_recv(self, step: int, chunk: int, mb: int, wrapped,
+                   what: str):
+        """Consume a pre-pushed activation if one is resident (or in
+        flight, waiting inside pp/recv_wait); otherwise fall back to the
+        blocking pp/xfer fetch of `wrapped`."""
+        from ray_tpu.util import spans
+        key = (step, chunk, mb)
+        with self._recv_cv:
+            if key not in self._recv and key not in self._recv_err \
+                    and key in self._recv_pending:
+                # Prefetch raced us: the bytes are mid-resolve on
+                # another thread.  Wait bounded — a wedged prefetch
+                # (never an expected state) degrades to the blocking
+                # fetch instead of deadlocking the compute thread.
+                self._recv_waits += 1
+                tok = spans.begin("pp", "recv_wait", stage=self.stage,
+                                  chunk=chunk, mb=mb)
+                deadline = time.monotonic() + self._recv_wait_timeout_s
+                while key in self._recv_pending \
+                        and time.monotonic() < deadline:
+                    self._recv_cv.wait(timeout=0.25)
+                spans.end(tok)
+            if key in self._recv:
+                self._recv_hits += 1
+                self._recv_consumed.add(key)
+                return self._recv.pop(key)
+            if key in self._recv_err:
+                self._recv_consumed.add(key)
+                raise self._recv_err.pop(key)
+            self._recv_consumed.add(key)
+            self._recv_misses += 1
+        return self._fetch(wrapped, what, chunk=chunk)
+
+    def _clear_recv(self):
+        with self._recv_cv:
+            self._recv.clear()
+            self._recv_err.clear()
+            self._recv_consumed.clear()
+            # In-flight prefetches re-park after this clear; they are
+            # keyed by (step, chunk, mb), so a stale entry can never be
+            # consumed by a later step and the next clear drops it.
 
     # ---------------- compute ----------------
 
-    def forward(self, step: int, mb: int, xw, tw=None):
-        """One microbatch through this stage.  Returns (meta, activation);
-        the last stage computes the loss chain instead and carries the
-        scalar in meta (its second return is None)."""
+    def forward(self, step: int, chunk: int, mb: int, xw, tw=None):
+        """One microbatch through one owned chunk.  Returns
+        (meta, activation); the last chunk computes the loss chain
+        instead and carries the scalar in meta (its second return is
+        None)."""
         from ray_tpu.util import spans
+        chunk = int(chunk)
         t0 = self._op_begin()
-        x = self._fetch(xw, "act")
-        last = self.stage == self.n_stages - 1
-        with spans.span("pp", "stage_fwd", stage=self.stage, mb=mb,
-                        step=step):
-            y, cache = self._fwd(self.params, x)
+        x = self._take_recv(step, chunk, mb, xw, "act")
+        last = chunk == self.n_stages - 1
+        with spans.span("pp", "stage_fwd", stage=self.stage, chunk=chunk,
+                        mb=mb, step=step):
+            y, cache = self._fwd(self.params[chunk], x)
             if last:
-                target = self._fetch(tw, "target")
+                target = self._fetch(tw, "target", chunk=chunk)
                 loss, lcache = self._loss_fwd(y, target)
-                self._caches[mb] = (cache, lcache)
+                self._caches[(chunk, mb)] = (cache, lcache)
                 self._losses[mb] = float(loss)
                 self._op_end(t0)
-                return ({"mb": mb, "step": step, "loss": float(loss),
-                         "version": self.version}, None)
-        self._caches[mb] = cache
+                return ({"mb": mb, "step": step, "chunk": chunk,
+                         "loss": float(loss), "version": self.version},
+                        None)
+        self._caches[(chunk, mb)] = cache
         self._op_end(t0)
-        return ({"mb": mb, "step": step, "version": self.version},
-                np.asarray(y))
+        return ({"mb": mb, "step": step, "chunk": chunk,
+                 "version": self.version}, np.asarray(y))
 
-    def backward(self, step: int, mb: int, gyw=None):
-        """Backward for one microbatch: consumes the forward's cache,
-        banks this microbatch's param-grad contribution, and returns
-        (meta, gx) — gx is the grad this stage sends upstream."""
+    def backward(self, step: int, chunk: int, mb: int, gyw=None):
+        """Backward for one microbatch through one owned chunk: consumes
+        the forward's cache, banks this (chunk, microbatch) param-grad
+        contribution, and returns (meta, gx) — gx is the grad this chunk
+        sends upstream."""
         from ray_tpu.util import spans
+        chunk = int(chunk)
         t0 = self._op_begin()
-        if mb not in self._caches:
+        if (chunk, mb) not in self._caches:
             raise RuntimeError(
-                f"stage {self.stage} has no forward cache for microbatch "
-                f"{mb} (step {step}) — forward must replay first")
-        with spans.span("pp", "stage_bwd", stage=self.stage, mb=mb,
-                        step=step):
-            if self.stage == self.n_stages - 1:
-                cache, lcache = self._caches.pop(mb)
+                f"gang {self.stage} has no forward cache for chunk "
+                f"{chunk} microbatch {mb} (step {step}) — forward must "
+                f"replay first")
+        with spans.span("pp", "stage_bwd", stage=self.stage, chunk=chunk,
+                        mb=mb, step=step):
+            if chunk == self.n_stages - 1:
+                cache, lcache = self._caches.pop((chunk, mb))
                 gy = self._loss_bwd(lcache)
             else:
-                cache = self._caches.pop(mb)
-                gy = self._fetch(gyw, "grad")
-            gx, gparams = self._bwd(self.params, cache, gy)
-        self._grads[mb] = tree_map(np.asarray, gparams)
+                cache = self._caches.pop((chunk, mb))
+                gy = self._fetch(gyw, "grad", chunk=chunk)
+            gx, gparams = self._bwd(self.params[chunk], cache, gy)
+        self._grads[chunk][mb] = tree_map(np.asarray, gparams)
         self._op_end(t0)
-        return ({"mb": mb, "step": step, "version": self.version},
-                np.asarray(gx))
+        return ({"mb": mb, "step": step, "chunk": chunk,
+                 "version": self.version}, np.asarray(gx))
 
     def partial_grads(self, step: int):
-        """This member's summed grad contribution, in sorted microbatch
-        order (replay-order independent).  Returns (meta, grad_tree).
+        """This member's summed grad contribution per owned chunk, each
+        in sorted microbatch order (replay- and interleave-order
+        independent).  Returns (meta, {chunk: grad_tree}).
 
         The sum is cached per step and survives apply_update: if the
         update boundary dies partway (some members applied, grads
@@ -236,45 +394,55 @@ class PipelineStageActor:
         member, so params never diverge across the gang."""
         if self._partial_cache is not None \
                 and self._partial_cache[0] == step:
-            total = self._partial_cache[1]
+            totals = self._partial_cache[1]
             return ({"stage": self.stage, "member": self.member,
-                     "step": step, "cached": True}, total)
+                     "step": step, "cached": True}, totals)
         t0 = self._op_begin()
-        if not self._grads:
-            raise RuntimeError(
-                f"stage {self.stage} member {self.member} has no grad "
-                f"contributions for step {step}")
-        order = sorted(self._grads)
-        total = self._grads[order[0]]
-        for j in order[1:]:
-            total = tree_add(total, self._grads[j])
-        self._partial_cache = (step, total)
+        totals: Dict[int, Any] = {}
+        for c in self.chunks:
+            got = self._grads[c]
+            if not got:
+                raise RuntimeError(
+                    f"gang {self.stage} member {self.member} has no grad "
+                    f"contributions for chunk {c} at step {step}")
+            order = sorted(got)
+            total = got[order[0]]
+            for j in order[1:]:
+                total = tree_add(total, got[j])
+            totals[c] = total
+        self._partial_cache = (step, totals)
         self._op_end(t0)
+        n = sum(len(self._grads[c]) for c in self.chunks)
         return ({"stage": self.stage, "member": self.member, "step": step,
-                 "n_micro": len(order)}, total)
+                 "n_micro": n}, totals)
 
     def apply_update(self, step: int, grad_refs, n_micro: int) -> dict:
         """Fold the gang's partial grads (in member order — every member
-        computes the identical sum, so params stay replicated) and take
-        one SGD step.  Version-guarded: a retry after this member already
-        applied is a no-op, so recovery can never double-apply."""
+        computes the identical per-chunk sum, so params stay replicated)
+        and take one SGD step per owned chunk.  Version-guarded: a retry
+        after this member already applied is a no-op, so recovery can
+        never double-apply."""
         from ray_tpu.util import spans
         if self.version >= step + 1:
             return {"stage": self.stage, "member": self.member,
                     "version": self.version, "applied": False}
         t0 = self._op_begin()
         with spans.span("pp", "apply", stage=self.stage, step=step):
-            total = None
+            totals = None
             for ref in grad_refs:
                 g = self._fetch((ref,), "partial_grads")
-                total = g if total is None else tree_add(total, g)
+                totals = g if totals is None else \
+                    {c: tree_add(totals[c], g[c]) for c in totals}
             scale = 1.0 / float(n_micro)
-            self.params = tree_map(
-                lambda p, g: p - self.lr * (g * scale), self.params, total)
+            for c in self.chunks:
+                self.params[c] = tree_map(
+                    lambda p, g: p - self.lr * (g * scale),
+                    self.params[c], totals[c])
         self.version = step + 1
         self._caches.clear()
-        self._grads.clear()
+        self._grads = {c: {} for c in self.chunks}
         self._losses.clear()
+        self._clear_recv()
         _metrics()["stall"].observe(self._idle_s,
                                     tags={"stage": str(self.stage)})
         self._op_end(t0)
@@ -290,9 +458,10 @@ class PipelineStageActor:
     def reset_step(self, step: int) -> bool:
         """Drop per-step state (rollback support: the step will replay)."""
         self._caches.clear()
-        self._grads.clear()
+        self._grads = {c: {} for c in self.chunks}
         self._losses.clear()
         self._partial_cache = None
+        self._clear_recv()
         return True
 
     def reset_stats(self) -> dict:
@@ -305,15 +474,18 @@ class PipelineStageActor:
     # ---------------- checkpoint ----------------
 
     def save_ckpt(self, step: int) -> bool:
-        """Commit this stage's params+version as `step` (leader member
-        only; params are replicated across the gang).  Waits for the
-        COMMIT marker so the driver's boundary is durable."""
+        """Commit this gang's params+version as `step` (leader member
+        only; params are replicated across the gang; one tree carries
+        every owned chunk).  Waits for the COMMIT marker so the driver's
+        boundary is durable."""
         if self._ckpt_mgr is None:
             return False
         from ray_tpu.util import spans
         with spans.span("pp", "ckpt", stage=self.stage, step=step):
             h = self._ckpt_mgr.save(
-                step, {"params": self.params, "version": self.version})
+                step, {"params": {str(c): self.params[c]
+                                  for c in self.chunks},
+                       "version": self.version})
             h.wait(60)
         return True
 
@@ -327,12 +499,18 @@ class PipelineStageActor:
         if target is None or target not in self._ckpt_mgr.steps():
             return None
         tree = self._ckpt_mgr.restore(target)
-        self.params = tree_map(np.asarray, tree["params"])
+        p = tree["params"]
+        if isinstance(p, dict) and set(p) == {str(c) for c in self.chunks}:
+            self.params = {c: tree_map(np.asarray, p[str(c)])
+                           for c in self.chunks}
+        else:                            # single-chunk legacy tree
+            self.params = {self.chunks[0]: tree_map(np.asarray, p)}
         self.version = int(tree["version"])
         self._caches.clear()
-        self._grads.clear()
+        self._grads = {c: {} for c in self.chunks}
         self._losses.clear()
         self._partial_cache = None
+        self._clear_recv()
         return self.version
 
     def committed_steps(self) -> List[int]:
@@ -342,7 +520,7 @@ class PipelineStageActor:
 
 
 class StageGroup:
-    """One pipeline stage's actor gang under one placement group.
+    """One gang's actors under one placement group.
 
     Mirrors `WorkerGroup` (PG reserve -> actor construction -> identity
     resolution, with the same partial-failure cleanup: a half-built gang
@@ -351,7 +529,13 @@ class StageGroup:
     and the group knows how to re-form in place: `reform()` builds a
     fresh gang (new PG, new actors via the zygote spawn path), bumps the
     incarnation so checkpoint save_ids never alias a dead gang's torn
-    markers, and restores from the stage's latest COMMITTED checkpoint."""
+    markers, and restores from the gang's latest COMMITTED checkpoint.
+
+    Topology-aware placement rides `resources_per_worker`: the trainer
+    merges a per-gang slice resource (e.g. ``{"pp_slice_0": 1}``, from
+    `parallel.mesh.pipeline_placement_resources`) into the bundle specs,
+    so a gang lands inside its assigned ICI slice and pipeline cuts fall
+    only on DCN boundaries."""
 
     def __init__(self, stage: int, spec: dict, gang: int,
                  resources_per_worker: dict,
@@ -383,9 +567,11 @@ class StageGroup:
             res = dict(self.resources)
             cpu = res.pop("CPU", 0)
             tpu = res.pop("TPU", None)
+            # max_concurrency covers 1 compute op + the double-buffered
+            # prefetch resolves per owned chunk + beacon probes.
             cls = PipelineStageActor.options(
                 num_cpus=cpu, num_tpus=tpu, resources=res or None,
-                max_concurrency=4)
+                max_concurrency=8)
             for m in range(self.gang):
                 members.append(cls.options(
                     placement_group=pg,
@@ -419,8 +605,8 @@ class StageGroup:
         self.members = members
 
     def reform(self) -> Optional[int]:
-        """Tear down and rebuild this stage's gang in place; restore from
-        the stage's latest COMMITTED checkpoint.  Returns the restored
+        """Tear down and rebuild this gang in place; restore from the
+        gang's latest COMMITTED checkpoint.  Returns the restored
         version (None = nothing committed; members hold initial params)."""
         self.shutdown()
         self.incarnation += 1

@@ -1,37 +1,70 @@
 """MPMD pipeline-parallel trainer: the driver-side schedule pump.
 
-`PipelineTrainer` maps each pipeline stage to its own `StageGroup` (an
-actor gang under its own placement group — see
-`train/pipeline_stage.py`), then runs 1F1B or GPipe microbatch schedules
-by pumping at most one compute op per gang member and letting activation
-and gradient ObjectRefs flow stage-to-stage over the native object
-plane.  The driver only ever fetches the small `meta` half of each
-`num_returns=2` stage call; the payload ref is handed to the next stage
-wrapped in a tuple so the bytes move shm-to-shm.
+`PipelineTrainer` splits the model into `n_chunks = len(stage_params)`
+stage-chunks and maps them round-robin onto `n_chunks // interleave`
+actor gangs (each a `StageGroup` under its own placement group — see
+`train/pipeline_stage.py`), then runs 1F1B or GPipe microbatch
+schedules by pumping at most one compute op per gang member and letting
+activation and gradient ObjectRefs flow chunk-to-chunk over the native
+object plane.  The driver only ever fetches the small `meta` half of
+each `num_returns=2` stage call; the payload ref is handed to the next
+chunk wrapped in a tuple so the bytes move shm-to-shm.
 
-Backpressure: a stage may run at most `queue_depth` microbatches ahead
-of its downstream consumer, and 1F1B additionally caps stage *i* at
-``n_stages - i`` forwards not yet backward-ed (the classic warmup
-depth), so queue growth is bounded and a stalled stage stalls its
-upstream instead of ballooning the store.
+Three levers take transfer and bubble off the critical path:
+
+- **Interleaved (looping) schedule** — with ``interleave=v > 1`` each
+  gang owns v *non-adjacent* chunks (gang g owns ``g, g+n_gangs, ...``),
+  so during warmup/drain every gang has some chunk with work and the
+  classic bubble shrinks by ~1/v.  Per-(chunk, microbatch) grads fold
+  in sorted order at the boundary, so the SGD trajectory is
+  bit-identical to the v=1 1F1B/GPipe runs.
+- **Pre-pushed activations** (``prefetch=True``) — the moment chunk c's
+  forward for microbatch m completes (activation sealed in the node
+  store), the driver ships the ref to chunk c+1's owner via
+  ``prefetch``, which resolves it on a spare actor thread concurrently
+  with that gang's compute (`pp/xfer_overlap`), parking the bytes in a
+  double-buffered receive window (`recv_window`).  The consuming
+  forward takes the resident copy for free instead of blocking inside
+  `pp/xfer`.
+- **Topology-aware placement** (``placement_plan``) — a per-gang extra
+  resource dict (see `parallel.mesh.stage_slice_plan` /
+  `pipeline_placement_resources`, built on the same slice discipline as
+  `create_two_level_mesh`/`slice_index_of`) pins each gang inside one
+  ICI slice so adjacent chunks transfer ICI-near and the pipeline is
+  cut only at DCN boundaries; gang members themselves form the
+  intra-stage DP mesh (microbatch j lands on member j % gang), giving
+  DP x (per-worker TP) x PP.
+
+Backpressure: chunk *c* may complete at most `queue_depth` forwards
+ahead of chunk *c+1*, and in-flight pre-pushed activations count
+against the consumer's memory on top of that — the dispatcher blocks a
+forward when ``(sealed-unconsumed) + (resident prefetched) >=
+queue_depth + recv_window``, so double-buffering can never grow a
+stage's memory unbounded.  1F1B additionally caps chunk *c* at
+``n_chunks - c`` forwards not yet backward-ed (the classic warmup
+depth).
 
 Failure semantics (the headline):
 
-- a dead gang member marks its whole stage dead (params are replicated
-  but grad contributions are member-local); the stage re-forms in place
+- a dead gang member marks its whole gang dead (params are replicated
+  but grad contributions are member-local); the gang re-forms in place
   via `StageGroup.reform()` — fresh PG, fresh actors through the zygote
-  spawn path, params from the stage's latest COMMITTED checkpoint;
+  spawn path, params (every owned chunk) from the gang's latest
+  COMMITTED checkpoint;
 - if the restored version equals the in-flight step, recovery is
-  *surgical*: only the dead stage's microbatches replay, re-fed from the
-  upstream stage's sealed activations and the downstream stage's sealed
-  grads (the node store outlives workers, so those refs stay readable);
-  surviving stages never restart and never recompute;
-- if the re-formed stage restored a *newer* version (it died after
+  *surgical*: only the dead gang's chunks replay their microbatches,
+  re-fed (and re-pushed) from upstream chunks' sealed activations and
+  downstream chunks' sealed grads (the node store outlives workers, so
+  those refs stay readable); surviving gangs never restart and never
+  recompute.  Prefetched-but-unconsumed activations are replayable
+  state: replayed producers reseal bit-identical bytes, so a consumer
+  holding a pre-kill pushed copy cannot diverge;
+- if the re-formed gang restored a *newer* version (it died after
   applying + committing the step), it is marked applied and skips the
   boundary;
-- anything else — or a recovery that finds no dead stage (e.g. objects
-  lost with a hostd) — falls back to a global rollback: every stage
-  loads the newest checkpoint step committed by *all* stages (survivors
+- anything else — or a recovery that finds no dead gang (e.g. objects
+  lost with a hostd) — falls back to a global rollback: every gang
+  loads the newest checkpoint step committed by *all* gangs (survivors
   load in place, without restarting), and `fit` resumes from there.
 
 All recoveries count against `max_failures`.
@@ -67,6 +100,9 @@ def _metrics():
                 tag_keys=("kind",)),
             "step": mt.Histogram(
                 "pp_step_seconds", "pipeline train-step wall clock"),
+            "prepush": mt.Counter(
+                "pp_prepush_total",
+                "activations pre-pushed into downstream receive windows"),
         }
     return _M
 
@@ -102,16 +138,16 @@ def jax_stage_fns(stage_fn: Callable, loss_fn: Callable):
 
 
 class _StageFailure(Exception):
-    """Internal: a stage op failed; recovery should run."""
+    """Internal: a gang op failed; recovery should run."""
 
-    def __init__(self, stage: int, reason: str):
-        super().__init__(f"stage {stage}: {reason}")
-        self.stage = stage
+    def __init__(self, gang: int, reason: str):
+        super().__init__(f"gang {gang}: {reason}")
+        self.stage = gang
         self.reason = reason
 
 
 class _Rollback(Exception):
-    """Internal: global rollback to `step` (all stages reloaded)."""
+    """Internal: global rollback to `step` (all gangs reloaded)."""
 
     def __init__(self, step: int):
         super().__init__(f"rollback to step {step}")
@@ -119,10 +155,11 @@ class _Rollback(Exception):
 
 
 class _Op:
-    __slots__ = ("stage", "member", "kind", "mb", "t")
+    __slots__ = ("gang", "chunk", "member", "kind", "mb", "t")
 
-    def __init__(self, stage, member, kind, mb):
-        self.stage = stage
+    def __init__(self, gang, chunk, member, kind, mb):
+        self.gang = gang
+        self.chunk = chunk
         self.member = member
         self.kind = kind
         self.mb = mb
@@ -130,41 +167,65 @@ class _Op:
 
 
 class _StepState:
-    """Driver-side bookkeeping for one train step's schedule pump."""
+    """Driver-side bookkeeping for one train step's schedule pump.
+    Schedule progress is per CHUNK; busy/applied are per GANG (a member
+    runs one op at a time across all its owned chunks)."""
 
-    def __init__(self, n_stages: int, n_micro: int):
-        self.n_stages = n_stages
+    def __init__(self, n_chunks: int, n_gangs: int, n_micro: int):
+        self.n_chunks = n_chunks
+        self.n_gangs = n_gangs
         self.n_micro = n_micro
-        self.fwd_disp = [set() for _ in range(n_stages)]
-        self.fwd_done = [set() for _ in range(n_stages)]
-        self.bwd_disp = [set() for _ in range(n_stages)]
-        self.bwd_done = [set() for _ in range(n_stages)]
-        self.busy: List[Dict[int, Any]] = [dict() for _ in range(n_stages)]
-        self.act: List[Dict[int, Any]] = [dict() for _ in range(n_stages)]
-        self.gout: List[Dict[int, Any]] = [dict() for _ in range(n_stages)]
+        self.owner = [c % n_gangs for c in range(n_chunks)]
+        self.fwd_disp = [set() for _ in range(n_chunks)]
+        self.fwd_done = [set() for _ in range(n_chunks)]
+        self.bwd_disp = [set() for _ in range(n_chunks)]
+        self.bwd_done = [set() for _ in range(n_chunks)]
+        # Microbatches whose activation ref was pre-pushed into chunk
+        # c's receive window this step (the send queue's memory bound).
+        self.prepushed = [set() for _ in range(n_chunks)]
+        self.busy: List[Dict[int, Any]] = [dict() for _ in range(n_gangs)]
+        self.act: List[Dict[int, Any]] = [dict() for _ in range(n_chunks)]
+        self.gout: List[Dict[int, Any]] = [dict() for _ in range(n_chunks)]
         self.losses: Dict[int, float] = {}
         self.pending: Dict[Any, _Op] = {}
-        self.applied = [False] * n_stages
+        self.applied = [False] * n_gangs
 
-    def reset_stage(self, i: int):
-        """Forget stage i's schedule progress (its gang re-formed with
-        empty caches): every microbatch replays through stage i, nothing
-        else changes.  Refs the stage produced earlier stay in act/gout
-        maps until the replay overwrites them — consumers that already
-        fetched them are unaffected (sealed objects are immutable)."""
-        self.fwd_disp[i] = set()
-        self.fwd_done[i] = set()
-        self.bwd_disp[i] = set()
-        self.bwd_done[i] = set()
-        self.busy[i] = {}
-        self.applied[i] = False
+    def reset_gang(self, g: int):
+        """Forget gang g's schedule progress (it re-formed with empty
+        caches and an empty receive window): every microbatch replays
+        through every chunk g owns, nothing else changes.  Refs its
+        chunks produced earlier stay in act/gout maps until the replay
+        overwrites them — consumers that already fetched them are
+        unaffected (sealed objects are immutable, and the stage fns are
+        deterministic so replayed bytes are identical)."""
+        for c in range(self.n_chunks):
+            if self.owner[c] != g:
+                continue
+            self.fwd_disp[c] = set()
+            self.fwd_done[c] = set()
+            self.bwd_disp[c] = set()
+            self.bwd_done[c] = set()
+            self.prepushed[c] = set()    # fresh actors, empty windows
+        self.busy[g] = {}
+        self.applied[g] = False
         self.pending = {r: op for r, op in self.pending.items()
-                        if op.stage != i}
+                        if op.gang != g}
+
+    def mark_gang_applied(self, g: int):
+        full = set(range(self.n_micro))
+        for c in range(self.n_chunks):
+            if self.owner[c] != g:
+                continue
+            self.fwd_disp[c] = set(full)
+            self.fwd_done[c] = set(full)
+            self.bwd_disp[c] = set(full)
+            self.bwd_done[c] = set(full)
+        self.applied[g] = True
 
     def compute_done(self) -> bool:
-        return all(self.applied[i]
-                   or len(self.bwd_done[i]) == self.n_micro
-                   for i in range(self.n_stages))
+        return all(self.applied[self.owner[c]]
+                   or len(self.bwd_done[c]) == self.n_micro
+                   for c in range(self.n_chunks))
 
 
 class PipelineTrainer:
@@ -174,19 +235,29 @@ class PipelineTrainer:
       stage_fns: (stage_fwd, stage_bwd, loss_fwd, loss_bwd) — see
         `pipeline_stage` module docs, or build from jax via
         `jax_stage_fns`.
-      stage_params: list of per-stage param pytrees (numpy leaves);
-        one entry per pipeline stage.
+      stage_params: list of per-chunk param pytrees (numpy leaves); one
+        entry per pipeline stage-chunk.
       n_microbatches: microbatches per global step.
       schedule: "1f1b" (bwd-first, bounded warmup) or "gpipe"
         (all-fwd-then-bwd).
-      queue_depth: max microbatches a stage may run ahead of its
+      queue_depth: max microbatches a chunk may run ahead of its
         downstream consumer (the inter-stage queue bound).
-      workers_per_stage: gang size per stage (data parallel within a
-        stage; microbatch j lands on member j % gang at every stage).
-      storage_path: checkpoint root; per-stage trees commit under
+      workers_per_stage: gang size (data parallel within a gang;
+        microbatch j lands on member j % gang at every chunk).
+      interleave: chunks per gang (v).  `len(stage_params)` must divide
+        evenly; gang g owns chunks ``g, g+n_gangs, ...`` (non-adjacent).
+      prefetch: pre-push sealed activations into downstream receive
+        windows so `pp/xfer` resolves concurrently with compute.
+      recv_window: max pre-pushed activations resident per chunk in a
+        consumer's receive window (2 = double-buffered).
+      placement_plan: optional per-gang extra resource dicts (length
+        n_gangs) merged into each gang's bundle specs — the
+        topology-aware placement hook (see
+        `parallel.mesh.pipeline_placement_resources`).
+      storage_path: checkpoint root; per-gang trees commit under
         `<root>/stage_XX`.  None disables checkpointing (and therefore
         restart recovery — only surgical replay works).
-      ckpt_every: commit per-stage checkpoints every k steps.
+      ckpt_every: commit per-gang checkpoints every k steps.
       max_failures: recoveries allowed across the fit before giving up.
       stage_timeout_s: op-completion watchdog; an op outstanding this
         long triggers a gang beacon probe.
@@ -197,17 +268,29 @@ class PipelineTrainer:
                  stage_params: List[Any], *, lr: float = 0.05,
                  n_microbatches: int = 8, schedule: str = "1f1b",
                  queue_depth: int = 2, workers_per_stage: int = 1,
+                 interleave: int = 1, prefetch: bool = False,
+                 recv_window: int = 2,
                  resources_per_worker: Optional[dict] = None,
+                 placement_plan: Optional[List[dict]] = None,
                  storage_path: Optional[str] = None, ckpt_every: int = 1,
                  max_failures: int = 2, stage_timeout_s: float = 30.0,
                  placement_strategy: str = "PACK",
                  pg_timeout_s: float = 60.0):
         if schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"unknown schedule {schedule!r}")
-        self.n_stages = len(stage_params)
+        self.n_chunks = len(stage_params)
+        self.v = max(1, int(interleave))
+        if self.n_chunks % self.v:
+            raise ValueError(
+                f"interleave={self.v} must divide the {self.n_chunks} "
+                f"stage-chunks evenly")
+        self.n_gangs = self.n_chunks // self.v
+        self.n_stages = self.n_chunks           # end-to-end chunk count
         self.n_micro = int(n_microbatches)
         self.schedule = schedule
         self.queue_depth = max(1, int(queue_depth))
+        self.prefetch = bool(prefetch)
+        self.recv_window = max(1, int(recv_window))
         self.gang = max(1, int(workers_per_stage))
         self.max_failures = int(max_failures)
         self.stage_timeout_s = float(stage_timeout_s)
@@ -215,23 +298,44 @@ class PipelineTrainer:
         self.storage_path = storage_path
         self._recoveries = 0
         self.history: List[dict] = []
+        if placement_plan is not None and len(placement_plan) != \
+                self.n_gangs:
+            raise ValueError(
+                f"placement_plan has {len(placement_plan)} entries for "
+                f"{self.n_gangs} gangs")
         fwd, bwd, loss_fwd, loss_bwd = stage_fns
+        # Round-robin ownership — must match parallel.pipeline.
+        # chunk_assignment (tests pin the equivalence); not imported
+        # here so numpy-only pipelines never pay the jax import.
+        self._assignment = [list(range(g, self.n_chunks, self.n_gangs))
+                            for g in range(self.n_gangs)]
         self.groups: List[StageGroup] = []
         try:
-            for i, params in enumerate(stage_params):
+            for g in range(self.n_gangs):
+                chunks = self._assignment[g]
                 root = ""
                 if storage_path:
                     import os
-                    root = os.path.join(storage_path, f"stage_{i:02d}")
-                spec = {"stage": i, "n_stages": self.n_stages,
+                    root = os.path.join(storage_path, f"stage_{g:02d}")
+                spec = {"stage": g, "n_stages": self.n_chunks,
+                        "chunks": chunks,
                         "stage_fwd": fwd, "stage_bwd": bwd,
                         "loss_fwd": loss_fwd, "loss_bwd": loss_bwd,
-                        "params": params, "lr": lr, "ckpt_root": root}
+                        "params": {c: stage_params[c] for c in chunks},
+                        "lr": lr, "ckpt_root": root}
+                res = dict(resources_per_worker or {"CPU": 1})
+                if placement_plan is not None:
+                    res.update(placement_plan[g])
                 self.groups.append(StageGroup(
-                    i, spec, self.gang,
-                    resources_per_worker or {"CPU": 1},
+                    g, spec, self.gang, res,
                     placement_strategy=placement_strategy,
                     pg_timeout_s=pg_timeout_s))
+            if placement_plan is not None:
+                from ray_tpu.util import events
+                events.record(
+                    "pp", "placement", gangs=self.n_gangs,
+                    interleave=self.v,
+                    plan=[sorted(p) for p in placement_plan])
         except BaseException:
             self.shutdown()
             raise
@@ -243,21 +347,27 @@ class PipelineTrainer:
     def _member(self, mb: int) -> int:
         return mb % self.gang
 
-    def _fwd_ready(self, st: _StepState, i: int, mb: int) -> bool:
+    def _owner(self, c: int) -> int:
+        return c % self.n_gangs
+
+    def _chunks_of(self, g: int) -> List[int]:
+        return list(range(g, self.n_chunks, self.n_gangs))
+
+    def _fwd_ready(self, st: _StepState, c: int, mb: int) -> bool:
         # Gate on the producer op having COMPLETED (activation sealed in
         # the node store), not on the ref existing: a dispatch-time ref
         # whose producer died unexecuted would feed the consumer a
         # poisoned object.
-        if i == 0:
+        if c == 0:
             return True
-        return mb in st.fwd_done[i - 1]
+        return mb in st.fwd_done[c - 1]
 
-    def _bwd_ready(self, st: _StepState, i: int, mb: int) -> bool:
-        if mb not in st.fwd_done[i]:
+    def _bwd_ready(self, st: _StepState, c: int, mb: int) -> bool:
+        if mb not in st.fwd_done[c]:
             return False
-        if i == self.n_stages - 1:
+        if c == self.n_chunks - 1:
             return True
-        return mb in st.bwd_done[i + 1]
+        return mb in st.bwd_done[c + 1]
 
     def _next_mb(self, disp: set, member: int) -> Optional[int]:
         for j in range(self.n_micro):
@@ -265,51 +375,110 @@ class PipelineTrainer:
                 return j
         return None
 
-    def _fwd_window_ok(self, st: _StepState, i: int) -> bool:
+    def _fwd_window_ok(self, st: _StepState, c: int) -> bool:
         if self.schedule == "1f1b":
-            warmup = max(1, self.n_stages - i)
-            if len(st.fwd_disp[i]) - len(st.bwd_done[i]) >= warmup:
+            warmup = max(1, self.n_chunks - c)
+            if len(st.fwd_disp[c]) - len(st.bwd_done[c]) >= warmup:
                 return False
-        if i + 1 < self.n_stages:
+        if c + 1 < self.n_chunks:
             # Bounded inter-stage queue: don't outrun the consumer.
-            ahead = len(st.fwd_done[i]) - len(st.fwd_done[i + 1])
+            # Sealed-but-unconsumed activations count against
+            # queue_depth; activations pre-pushed into the consumer's
+            # receive window but not yet consumed occupy a SECOND copy
+            # of the bytes (store + window), so the combined bound is
+            # queue_depth + recv_window — double-buffering can't grow
+            # the consumer's memory without stalling the producer.
+            ahead = len(st.fwd_done[c]) - len(st.fwd_done[c + 1])
             if ahead >= self.queue_depth:
+                return False
+            resident = len(st.prepushed[c + 1] - st.fwd_disp[c + 1])
+            if ahead + resident >= self.queue_depth + self.recv_window:
                 return False
         return True
 
+    def _pump_prefetch(self, step: int, st: _StepState, mbs):
+        """Ship sealed activation refs into downstream receive windows,
+        bounded per chunk by recv_window (resident = pushed but not yet
+        consumed by a dispatched forward)."""
+        from ray_tpu.util import events
+        # Chunk 0 is fed from driver-local puts — nothing to hide there,
+        # so pre-push only real inter-stage activations (c >= 1).
+        for c in range(1, self.n_chunks):
+            g = self._owner(c)
+            if st.applied[g]:
+                continue
+            resident = len(st.prepushed[c] - st.fwd_disp[c])
+            if resident >= self.recv_window:
+                continue
+            ready = sorted(st.fwd_done[c - 1])
+            for mb in ready:
+                if mb in st.prepushed[c] or mb in st.fwd_disp[c]:
+                    continue
+                src = st.act[c - 1][mb]
+                actor = self.groups[g].members[self._member(mb)]
+                # Fire-and-forget: a failed prefetch surfaces through
+                # the consuming forward (parked error) or the watchdog.
+                actor.prefetch.remote(step, c, mb, (src,))
+                events.record("pp", "prepush", step=step, chunk=c, mb=mb)
+                _metrics()["prepush"].inc()
+                st.prepushed[c].add(mb)
+                resident += 1
+                if resident >= self.recv_window:
+                    break
+
+    def _pick_bwd(self, st: _StepState, g: int, m: int):
+        # Deepest owned chunk first: drains the pipeline and frees the
+        # 1F1B warmup window of shallower chunks soonest.
+        for c in reversed(self._chunks_of(g)):
+            jb = self._next_mb(st.bwd_disp[c], m)
+            if jb is not None and self._bwd_ready(st, c, jb):
+                return c, jb
+        return None
+
+    def _pick_fwd(self, st: _StepState, g: int, m: int):
+        # Shallowest owned chunk first: keeps feeding the pipeline so
+        # downstream gangs exit warmup as early as possible.
+        for c in self._chunks_of(g):
+            jf = self._next_mb(st.fwd_disp[c], m)
+            if jf is not None and self._fwd_ready(st, c, jf) \
+                    and self._fwd_window_ok(st, c):
+                return c, jf
+        return None
+
     def _dispatch(self, step: int, st: _StepState, mbs, tgts):
-        last = self.n_stages - 1
-        for i, grp in enumerate(self.groups):
-            if st.applied[i]:
+        if self.prefetch:
+            self._pump_prefetch(step, st, mbs)
+        last = self.n_chunks - 1
+        for g, grp in enumerate(self.groups):
+            if st.applied[g]:
                 continue
             for m, actor in enumerate(grp.members):
-                if m in st.busy[i]:
+                if m in st.busy[g]:
                     continue
-                jb = self._next_mb(st.bwd_disp[i], m)
-                jf = self._next_mb(st.fwd_disp[i], m)
-                do_bwd = (jb is not None and self._bwd_ready(st, i, jb))
-                do_fwd = (jf is not None and self._fwd_ready(st, i, jf)
-                          and self._fwd_window_ok(st, i))
-                if self.schedule == "gpipe" and do_fwd:
-                    do_bwd = False      # all forwards drain first
-                if do_bwd:
-                    gyw = None if i == last else ((st.gout[i + 1][jb],))
+                pb = self._pick_bwd(st, g, m)
+                pf = self._pick_fwd(st, g, m)
+                if self.schedule == "gpipe" and pf is not None:
+                    pb = None           # all forwards drain first
+                if pb is not None:
+                    c, jb = pb
+                    gyw = None if c == last else ((st.gout[c + 1][jb],))
                     meta, gx = actor.backward.options(
-                        num_returns=2).remote(step, jb, gyw)
-                    st.gout[i][jb] = gx
-                    st.bwd_disp[i].add(jb)
-                    st.busy[i][m] = meta
-                    st.pending[meta] = _Op(i, m, "bwd", jb)
-                elif do_fwd:
-                    xw = (mbs[jf],) if i == 0 else ((st.act[i - 1][jf],))
-                    tw = (tgts[jf],) if i == last else None
+                        num_returns=2).remote(step, c, jb, gyw)
+                    st.gout[c][jb] = gx
+                    st.bwd_disp[c].add(jb)
+                    st.busy[g][m] = meta
+                    st.pending[meta] = _Op(g, c, m, "bwd", jb)
+                elif pf is not None:
+                    c, jf = pf
+                    xw = (mbs[jf],) if c == 0 else ((st.act[c - 1][jf],))
+                    tw = (tgts[jf],) if c == last else None
                     meta, y = actor.forward.options(
-                        num_returns=2).remote(step, jf, xw, tw)
-                    if i != last:
-                        st.act[i][jf] = y
-                    st.fwd_disp[i].add(jf)
-                    st.busy[i][m] = meta
-                    st.pending[meta] = _Op(i, m, "fwd", jf)
+                        num_returns=2).remote(step, c, jf, xw, tw)
+                    if c != last:
+                        st.act[c][jf] = y
+                    st.fwd_disp[c].add(jf)
+                    st.busy[g][m] = meta
+                    st.pending[meta] = _Op(g, c, m, "fwd", jf)
 
     def _poll(self, st: _StepState):
         """Consume completed op metas; raise _StageFailure on death or
@@ -321,7 +490,7 @@ class PipelineTrainer:
                                 timeout=0.2)
         for r in ready:
             op = st.pending.pop(r)
-            st.busy[op.stage].pop(op.member, None)
+            st.busy[op.gang].pop(op.member, None)
             try:
                 meta = ray_tpu.get(r)
             except (exceptions.ActorError, exceptions.WorkerCrashedError,
@@ -332,21 +501,21 @@ class PipelineTrainer:
                 # rollback path, not a user bug (a genuine user error
                 # re-raises once recoveries exhaust max_failures, with
                 # this exception chained as the cause).
-                raise _StageFailure(op.stage, type(e).__name__) from e
+                raise _StageFailure(op.gang, type(e).__name__) from e
             if op.kind == "fwd":
-                st.fwd_done[op.stage].add(op.mb)
-                if op.stage == self.n_stages - 1:
+                st.fwd_done[op.chunk].add(op.mb)
+                if op.chunk == self.n_chunks - 1:
                     st.losses[op.mb] = meta["loss"]
             else:
-                st.bwd_done[op.stage].add(op.mb)
+                st.bwd_done[op.chunk].add(op.mb)
         if not ready and st.pending:
             now = time.monotonic()
             stale = [op for op in st.pending.values()
                      if now - op.t > self.stage_timeout_s]
             for op in stale:
-                beacons = self.groups[op.stage].beacons(timeout=5.0)
+                beacons = self.groups[op.gang].beacons(timeout=5.0)
                 if any(b is None for b in beacons):
-                    raise _StageFailure(op.stage, "beacon_lost")
+                    raise _StageFailure(op.gang, "beacon_lost")
                 op.t = now      # alive but slow: re-arm the watchdog
 
     # ------------------------------------------------------------------
@@ -355,15 +524,15 @@ class PipelineTrainer:
 
     def _probe_dead_stages(self) -> List[int]:
         dead = []
-        for i, grp in enumerate(self.groups):
+        for g, grp in enumerate(self.groups):
             if any(b is None for b in grp.beacons(timeout=5.0)):
-                dead.append(i)
+                dead.append(g)
         return dead
 
     def _recover(self, step: int, st: _StepState, failure: _StageFailure):
         """Re-form dead gangs and pick the cheapest sound recovery.
 
-        Raises _Rollback when per-stage surgical replay is not provably
+        Raises _Rollback when per-gang surgical replay is not provably
         sufficient."""
         from ray_tpu.util import events, spans
         self._recoveries += 1
@@ -386,37 +555,32 @@ class PipelineTrainer:
                 # fall back to the checkpoint intersection.
                 _metrics()["recoveries"].inc(tags={"kind": "rollback"})
                 self._rollback(step)
-            for i in dead:
-                version = self.groups[i].reform()
+            for g in dead:
+                version = self.groups[g].reform()
                 restored = version if version is not None else 0
                 if restored == step:
                     # Pre-apply params for the in-flight step: replay
-                    # only this stage's microbatches (surgical).
-                    events.record("pp", "replay", step=step, stage=i,
+                    # only this gang's chunks (surgical).
+                    events.record("pp", "replay", step=step, stage=g,
                                   n_micro=self.n_micro)
                     _metrics()["recoveries"].inc(tags={"kind": "replay"})
-                    st.reset_stage(i)
+                    st.reset_gang(g)
                 elif restored == step + 1:
                     # Died after apply+commit: nothing to replay and the
                     # boundary must not re-apply.  Done-sets read full so
                     # neighbours (which, having reached the boundary,
-                    # already consumed this stage's sealed outputs) never
+                    # already consumed this gang's sealed outputs) never
                     # wait on it.
                     _metrics()["recoveries"].inc(
                         tags={"kind": "already_applied"})
-                    st.reset_stage(i)
-                    full = set(range(self.n_micro))
-                    st.fwd_disp[i] = set(full)
-                    st.fwd_done[i] = set(full)
-                    st.bwd_disp[i] = set(full)
-                    st.bwd_done[i] = set(full)
-                    st.applied[i] = True
+                    st.reset_gang(g)
+                    st.mark_gang_applied(g)
                 else:
                     _metrics()["recoveries"].inc(tags={"kind": "rollback"})
                     self._rollback(step)
 
     def _rollback(self, step: int):
-        """Load the newest step committed by ALL stages everywhere (no
+        """Load the newest step committed by ALL gangs everywhere (no
         gang restarts — survivors load in place), then unwind to `fit`."""
         from ray_tpu.util import events
         per_stage = []
@@ -450,35 +614,35 @@ class PipelineTrainer:
     # ------------------------------------------------------------------
 
     def _boundary(self, step: int, st: _StepState):
-        """Grad fold + SGD apply + per-stage checkpoint commit, all
+        """Grad fold + SGD apply + per-gang checkpoint commit, all
         version-guarded so a mid-boundary death retries cleanly."""
         partials: Dict[int, list] = {}
         metas = {}
-        for i, grp in enumerate(self.groups):
-            if st.applied[i]:
+        for g, grp in enumerate(self.groups):
+            if st.applied[g]:
                 continue
-            partials[i] = []
+            partials[g] = []
             for a in grp.members:
                 meta, grads = a.partial_grads.options(
                     num_returns=2).remote(step)
-                partials[i].append(grads)
-                metas[meta] = i
-        for meta, i in metas.items():
+                partials[g].append(grads)
+                metas[meta] = g
+        for meta, g in metas.items():
             try:
                 ray_tpu.get(meta, timeout=self.stage_timeout_s)
             except (exceptions.ActorError, exceptions.WorkerCrashedError,
                     exceptions.ObjectLostError, exceptions.TaskError,
                     exceptions.RayTpuTimeoutError) as e:
                 raise _StageFailure(
-                    i, f"partial_grads:{type(e).__name__}") from e
+                    g, f"partial_grads:{type(e).__name__}") from e
         apply_refs: Dict[int, list] = {}
-        for i, grp in enumerate(self.groups):
-            if st.applied[i]:
+        for g, grp in enumerate(self.groups):
+            if st.applied[g]:
                 continue
-            apply_refs[i] = [a.apply_update.remote(
-                step, partials[i], self.n_micro) for a in grp.members]
+            apply_refs[g] = [a.apply_update.remote(
+                step, partials[g], self.n_micro) for a in grp.members]
         busy = idle = 0.0
-        for i, refs in apply_refs.items():
+        for g, refs in apply_refs.items():
             try:
                 for out in ray_tpu.get(refs, timeout=self.stage_timeout_s):
                     busy += out.get("busy_s", 0.0)
@@ -487,14 +651,14 @@ class PipelineTrainer:
                     exceptions.ObjectLostError, exceptions.TaskError,
                     exceptions.RayTpuTimeoutError) as e:
                 raise _StageFailure(
-                    i, f"apply_update:{type(e).__name__}") from e
-            # This stage's gang fully applied: a boundary retry after a
-            # later stage's death must not re-enter it.
-            st.applied[i] = True
+                    g, f"apply_update:{type(e).__name__}") from e
+            # This gang fully applied: a boundary retry after a later
+            # gang's death must not re-enter it.
+            st.applied[g] = True
         if self.storage_path and (step + 1) % self.ckpt_every == 0:
-            saves = {grp.members[0].save_ckpt.remote(step + 1): i
-                     for i, grp in enumerate(self.groups)}
-            for ref, i in saves.items():
+            saves = {grp.members[0].save_ckpt.remote(step + 1): g
+                     for g, grp in enumerate(self.groups)}
+            for ref, g in saves.items():
                 try:
                     ray_tpu.get(ref, timeout=90)
                 except (exceptions.ActorError,
@@ -502,15 +666,15 @@ class PipelineTrainer:
                         exceptions.TaskError,
                         exceptions.RayTpuTimeoutError) as e:
                     raise _StageFailure(
-                        i, f"save_ckpt:{type(e).__name__}") from e
+                        g, f"save_ckpt:{type(e).__name__}") from e
         return busy, idle
 
     def _train_step(self, step: int, mbs, tgts) -> dict:
         from ray_tpu.util import spans
-        st = _StepState(self.n_stages, self.n_micro)
+        st = _StepState(self.n_chunks, self.n_gangs, self.n_micro)
         t0 = time.monotonic()
-        with spans.span("pp", "step", step=step,
-                        n_micro=self.n_micro):
+        with spans.span("pp", "step", step=step, n_micro=self.n_micro,
+                        interleave=self.v):
             while True:
                 try:
                     while not st.compute_done():
@@ -521,7 +685,7 @@ class PipelineTrainer:
                 except _StageFailure as f:
                     self._recover(step, st, f)
         wall = time.monotonic() - t0
-        members = self.n_stages * self.gang
+        members = self.n_gangs * self.gang
         bubble = max(0.0, 1.0 - busy / (members * wall)) if wall > 0 \
             else 0.0
         _metrics()["bubble"].observe(bubble)
@@ -562,15 +726,15 @@ class PipelineTrainer:
     def forward_only(self, xs: list, ts: list) -> float:
         """One fwd-only pass over the schedule; returns the mean loss.
         No recovery (parity/bench probe).  Leaves no per-step state."""
-        st = _StepState(self.n_stages, self.n_micro)
+        st = _StepState(self.n_chunks, self.n_gangs, self.n_micro)
         mbs = [ray_tpu.put(np.asarray(x)) for x in xs]
         tgts = [ray_tpu.put(np.asarray(t)) for t in ts]
         # Forward-only wants no bwd dispatch: mark bwd complete up front.
-        for i in range(self.n_stages):
-            st.bwd_disp[i] = set(range(self.n_micro))
-            st.bwd_done[i] = set(range(self.n_micro))
-        while not all(len(st.fwd_done[i]) == self.n_micro
-                      for i in range(self.n_stages)):
+        for c in range(self.n_chunks):
+            st.bwd_disp[c] = set(range(self.n_micro))
+            st.bwd_done[c] = set(range(self.n_micro))
+        while not all(len(st.fwd_done[c]) == self.n_micro
+                      for c in range(self.n_chunks)):
             self._dispatch(0, st, mbs, tgts)
             self._poll(st)
         ray_tpu.get([a.reset_step.remote(0)
@@ -579,6 +743,12 @@ class PipelineTrainer:
 
     def stage_idents(self) -> List[List[dict]]:
         return [list(grp.idents) for grp in self.groups]
+
+    def stage_stats(self) -> List[List[dict]]:
+        """Per-gang, per-member runtime stats (ops, busy/idle, receive-
+        window peaks/hits) — the backpressure and overlap observables."""
+        return [ray_tpu.get([a.stats.remote() for a in grp.members],
+                            timeout=30) for grp in self.groups]
 
     def shutdown(self):
         for grp in self.groups:

@@ -57,13 +57,18 @@ ACTOR_PHASE_PRIORITY = ("zygote_fork", "exec", "arg_fetch", "result_seal",
 ACTOR_RELABEL = {"exec": "first_ping", "boot": "worker_main_boot"}
 
 # Pipeline phases, innermost first: a slice where any stage computes is
-# charged to compute; xfer only soaks the inter-stage fetch time no
-# compute covers.  The wrapping pp/step span is deliberately absent —
-# it covers the whole step, so including it would relabel the bubble as
-# driver time; instead whatever no inner pp span covers inside the fit
-# window IS the bubble (schedule gaps + driver pump + stage stall).
-PP_PHASE_PRIORITY = ("stage_fwd", "stage_bwd", "xfer", "apply", "ckpt",
-                     "recover")
+# charged to compute; xfer / recv_wait only soak the inter-stage fetch
+# time no compute covers (both are BLOCKING: the compute thread stalls
+# inside them).  xfer_overlap is deliberately LAST — it elapses on a
+# prefetch thread concurrently with compute, so any slice compute also
+# covers is charged to compute and xfer_overlap keeps only its EXPOSED
+# remainder; hidden transfer = its raw union length minus that share.
+# The wrapping pp/step span is deliberately absent — it covers the
+# whole step, so including it would relabel the bubble as driver time;
+# instead whatever no inner pp span covers inside the fit window IS the
+# bubble (schedule gaps + driver pump + stage stall).
+PP_PHASE_PRIORITY = ("stage_fwd", "stage_bwd", "xfer", "recv_wait",
+                     "apply", "ckpt", "recover", "xfer_overlap")
 PP_RELABEL = {}
 
 
@@ -233,15 +238,21 @@ def _pp_loss_bwd(cache):
 
 
 def run_pipeline(steps: int = 6, stages: int = 4, n_micro: int = 8,
-                 micro_batch: int = 64, width: int = 256):
+                 micro_batch: int = 64, width: int = 256,
+                 interleave: int = 2, prefetch: bool = True):
     """Attribute an MPMD pipeline fit's wall clock to pp phases.
 
-    Stage workers record pp/stage_fwd, pp/stage_bwd, pp/xfer and the
+    Stage workers record pp/stage_fwd, pp/stage_bwd, pp/xfer (blocking)
+    and pp/xfer_overlap + pp/recv_wait (the pre-push path) plus the
     update-boundary spans without a trace context, so (like actor_storm)
     the whole cluster event stream for the fit window is scraped and
     union-swept.  The leftover inside the window is the bubble the
     schedule could not fill (plus driver pump overhead not under any
     span), reported next to the metrics-side per-step bubble fraction.
+    Transfer is split honestly: blocking xfer + recv_wait sit on the
+    critical path; xfer_overlap's hidden share (raw elapsed minus its
+    compute-uncovered remainder) is transfer the prefetch window
+    actually took OFF the critical path, not just relabelled.
     """
     import numpy as np
 
@@ -255,7 +266,8 @@ def run_pipeline(steps: int = 6, stages: int = 4, n_micro: int = 8,
                "b": np.zeros(width)} for _ in range(stages)]
     tr = PipelineTrainer(
         (_pp_stage_fwd, _pp_stage_bwd, _pp_loss_fwd, _pp_loss_bwd),
-        params, lr=0.05, n_microbatches=n_micro, schedule="1f1b")
+        params, lr=0.05, n_microbatches=n_micro, schedule="1f1b",
+        interleave=interleave, prefetch=prefetch)
 
     def data(step):
         r = np.random.default_rng(100 + step)
@@ -279,6 +291,21 @@ def run_pipeline(steps: int = 6, stages: int = 4, n_micro: int = 8,
     phases = {PP_RELABEL.get(k, k): v for k, v in phases.items()}
     bubble = float(np.mean([h["bubble_fraction"] for h in hist]))
     coverage = 1.0 - unattributed / total_s
+    # Hidden vs exposed transfer: xfer_overlap's raw union length is
+    # the transfer time that ELAPSED on prefetch threads; the union
+    # sweep charged compute first, so phases["xfer_overlap"] is only
+    # the slice nothing computed under (still exposed).  The difference
+    # is transfer genuinely hidden under compute.
+    ov_raw = _len(_union([(max(r["start"], t0), min(r["end"], t1))
+                          for r in flat
+                          if r["kind"] == "xfer_overlap"
+                          and r["start"] is not None
+                          and r["end"] is not None
+                          and min(r["end"], t1) > max(r["start"], t0)]))
+    ov_exposed = phases.get("xfer_overlap", 0.0)
+    xfer_blocking = phases.get("xfer", 0.0) + phases.get("recv_wait", 0.0)
+    hidden = max(0.0, ov_raw - ov_exposed)
+    hidden_frac = hidden / ov_raw if ov_raw > 0 else 0.0
     ranked = sorted(((k, v) for k, v in phases.items() if v > 0),
                     key=lambda kv: -kv[1])
     doc = {
@@ -286,11 +313,17 @@ def run_pipeline(steps: int = 6, stages: int = 4, n_micro: int = 8,
         "stages": stages,
         "n_micro": n_micro,
         "steps": steps,
+        "interleave": interleave,
+        "prefetch": prefetch,
         "wall_clock_s": round(total_s, 3),
         "spans_observed": len(flat),
         "phases_s": {k: round(v, 3) for k, v in ranked},
         "phases_frac": {k: round(v / total_s, 4) for k, v in ranked},
         "top_phases": [k for k, _ in ranked[:3]],
+        "xfer_blocking_s": round(xfer_blocking, 3),
+        "xfer_overlap_total_s": round(ov_raw, 3),
+        "xfer_hidden_s": round(hidden, 3),
+        "xfer_hidden_frac": round(hidden_frac, 4),
         "bubble_s": round(unattributed, 3),
         "bubble_frac_of_wall": round(1.0 - coverage, 4),
         "bubble_fraction_metric": round(bubble, 4),
@@ -299,7 +332,13 @@ def run_pipeline(steps: int = 6, stages: int = 4, n_micro: int = 8,
     _report(ranked, total_s, unattributed, coverage)
     print(f"  (unattributed here = pipeline bubble + driver pump)")
     print(f"  per-step bubble fraction (pp_bubble_fraction): {bubble:.1%}")
-    _write({"pp": doc})
+    print(f"  transfer: blocking {xfer_blocking:.3f}s on critical path; "
+          f"{hidden:.3f}s of {ov_raw:.3f}s overlapped transfer hidden "
+          f"under compute ({hidden_frac:.1%})")
+    # Overlapped runs land on the canonical "pp" key; a blocking
+    # (prefetch off) run lands beside it so the hidden-transfer claim
+    # stays comparable against its own baseline.
+    _write({"pp" if prefetch else "pp_blocking": doc})
     tr.shutdown()
     ray_tpu.shutdown()
     # The pipeline phases MUST be visible — that is this mode's point.
@@ -359,6 +398,10 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "actor_storm":
         run_actor_storm(int(sys.argv[2]) if len(sys.argv) > 2 else 200)
     elif len(sys.argv) > 1 and sys.argv[1] == "pp":
-        run_pipeline(int(sys.argv[2]) if len(sys.argv) > 2 else 6)
+        # pp [steps] [interleave] [prefetch:0|1]
+        run_pipeline(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 6,
+            interleave=int(sys.argv[3]) if len(sys.argv) > 3 else 2,
+            prefetch=bool(int(sys.argv[4])) if len(sys.argv) > 4 else True)
     else:
         main()

@@ -134,6 +134,10 @@ def test_collective_ops_across_actor_fleet(cluster):
         ray_tpu.kill(m)
 
 
+# `slow`: ~43s = 5% of the tier-1 budget spent memcpying 100MB x 8 ranks
+# on one host; the ring path + refs-only-coordinator invariant stay
+# tier-1-covered by the >=64KB reducescatter/allgather tests below.
+@pytest.mark.slow
 def test_ring_allreduce_100mb_world8(cluster):
     """Bulk collectives are ring-based over direct store-to-store object
     transfers; the coordinator relays only refs (VERDICT r2 item 4: 100MB

@@ -289,6 +289,10 @@ def serve_chaos_cluster(request):
         fi.reset()
 
 
+# `slow`: ~25s for the events-plane half of the replica-kill stitching
+# scenario; the spans-plane twin (test_spans.py, which additionally
+# gates critical_path reconstruction) keeps the kill in tier-1.
+@pytest.mark.slow
 @pytest.mark.chaos
 @pytest.mark.parametrize(
     "serve_chaos_cluster",

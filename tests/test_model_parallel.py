@@ -474,3 +474,42 @@ def test_two_level_mesh_numerics_match_flat():
         ici=MeshConfig(data=1, fsdp=2, tensor=2), dcn=MeshConfig(data=2),
         n_slices=2, devices=jax.devices()[:8]))
     assert abs(flat - two) < 1e-4
+
+
+def test_stage_slice_plan_contiguous_blocks():
+    """Gangs pack into contiguous per-slice blocks, so pipeline cuts
+    fall on DCN boundaries only where the slice count forces them."""
+    from ray_tpu.parallel import (
+        dcn_cut_edges, pipeline_placement_resources, stage_slice_plan)
+
+    plan = stage_slice_plan(4, 2)
+    assert plan == [0, 0, 1, 1]
+    # v=1 (4 chunks on 4 gangs): exactly one DCN cut, at the block edge.
+    assert dcn_cut_edges(plan, 4) == [(1, 2)]
+    # v=2 (8 chunks looping over the same 4 gangs): the looping schedule
+    # wraps gang 3 -> gang 0 once, adding the wraparound cut.
+    assert dcn_cut_edges(plan, 8) == [(1, 2), (3, 4), (5, 6)]
+    res = pipeline_placement_resources(plan)
+    assert res == [{"pp_slice_0": 1}, {"pp_slice_0": 1},
+                   {"pp_slice_1": 1}, {"pp_slice_1": 1}]
+    # Degenerate single-slice plan: no cuts anywhere.
+    assert dcn_cut_edges(stage_slice_plan(4, 1), 8) == []
+    with pytest.raises(ValueError, match="not divisible"):
+        stage_slice_plan(4, 3)
+
+
+def test_chunk_assignment_round_robin():
+    """Interleaved chunk ownership is round-robin (non-adjacent), and
+    adjacent chunks always land on adjacent gangs — the property
+    stage_slice_plan's contiguous blocks rely on for ICI locality."""
+    from ray_tpu.parallel import chunk_assignment
+
+    assert chunk_assignment(4, 4) == [[0], [1], [2], [3]]
+    assert chunk_assignment(4, 2) == [[0, 2], [1, 3]]
+    assert chunk_assignment(8, 2) == [[0, 2, 4, 6], [1, 3, 5, 7]]
+    owner = {c: g for g, cs in enumerate(chunk_assignment(8, 4))
+             for c in cs}
+    for c in range(7):
+        assert (owner[c + 1] - owner[c]) % 4 == 1
+    with pytest.raises(ValueError, match="not divisible"):
+        chunk_assignment(6, 4)

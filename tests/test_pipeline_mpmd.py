@@ -283,6 +283,10 @@ def test_stage_kill_surgical_replay_loss_exact(tmp_path):
         fi.reset()
 
 
+# `slow`: ~28s = 3% of the tier-1 budget, and the interleaved+pre-push
+# hostd-kill gate below exercises a strict superset of this rollback
+# path; the stage-kill surgical-replay gate stays in tier-1.
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_hostd_kill_pipeline_resumes_from_committed(tmp_path):
     """Deterministic pipeline-under-node-loss gate: a scripted
@@ -365,3 +369,290 @@ def test_hostd_kill_pipeline_resumes_from_committed(tmp_path):
         ray_tpu.shutdown()
     assert chaos_losses == clean_losses, \
         f"loss diverged after node loss: {chaos_losses} vs {clean_losses}"
+
+
+# ---------------------------------------------------------------------------
+# interleaved schedule + pre-pushed activations (PR 18)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_interleaved_prepush_bit_exact(pp_cluster):
+    """The interleaved (looping) schedule and the pre-push receive
+    window change only WHEN work runs and HOW bytes move — per-chunk
+    grads still fold in sorted microbatch order, so every (schedule,
+    interleave, prefetch, backpressure) combination must produce the
+    bit-identical SGD trajectory."""
+    from ray_tpu.parallel import chunk_assignment
+    from ray_tpu.train import PipelineTrainer
+
+    losses = {}
+    stats = {}
+    for key, kw in (
+            ("base", dict(schedule="1f1b")),
+            ("v2_1f1b", dict(schedule="1f1b", interleave=2,
+                             prefetch=True)),
+            ("v2_gpipe", dict(schedule="gpipe", interleave=2,
+                              prefetch=True)),
+            ("v1_prepush", dict(schedule="1f1b", prefetch=True)),
+            ("v2_tight", dict(schedule="1f1b", interleave=2,
+                              prefetch=True, queue_depth=1,
+                              recv_window=1)),
+    ):
+        tr = PipelineTrainer(NP_FNS, mk_params(), lr=0.1,
+                             n_microbatches=N_MICRO, **kw)
+        try:
+            if kw.get("interleave"):
+                assert tr._assignment == chunk_assignment(
+                    N_STAGES, N_STAGES // kw["interleave"])
+            losses[key] = [h["loss"] for h in tr.fit(mk_data, 3)]
+            stats[key] = [m for gang in tr.stage_stats() for m in gang]
+        finally:
+            tr.shutdown()
+    for key in losses:
+        assert losses[key] == losses["base"], \
+            f"{key} diverged: {losses[key]} vs {losses['base']}"
+    assert losses["base"][-1] < losses["base"][0]
+    # The overlap actually happened (prefetched activations were
+    # consumed from the window), and the backpressure bound held: at
+    # most recv_window resident per chunk, +1 while a consuming forward
+    # is mid-execution.
+    for key, window in (("v2_1f1b", 2), ("v1_prepush", 2),
+                        ("v2_tight", 1)):
+        hits = sum(m["recv_hits"] for m in stats[key])
+        peak = max(m["recv_peak"] for m in stats[key])
+        assert hits > 0, f"{key}: prefetch window never hit"
+        assert peak <= window + 1, \
+            f"{key}: recv_peak {peak} breached window {window}"
+    # No prefetch => the window is never touched.
+    assert all(m["recv_hits"] == 0 and m["recv_peak"] == 0
+               for m in stats["base"])
+
+
+@pytest.mark.slow
+def test_interleaved_parity_with_dryrun(pp_cluster):
+    """The standing dryrun parity gate rerun under interleave=2 +
+    pre-push: chunked gangs and overlapped transfer must not move the
+    forward loss by more than fp tolerance vs the single-program GPipe
+    schedule."""
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel import (MeshConfig, create_mesh,
+                                  pipeline_loss_dryrun, stack_stage_params)
+    from ray_tpu.train import PipelineTrainer, jax_stage_fns
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    params = mk_params()
+    xs, ts = mk_data(0)
+    mesh = create_mesh(MeshConfig(data=2, stage=N_STAGES))
+    stacked = stack_stage_params(
+        [{"w": jnp.asarray(p["w"]), "b": jnp.asarray(p["b"])}
+         for p in params])
+    dry = float(pipeline_loss_dryrun(
+        stage_fn, loss_fn, mesh, stacked,
+        jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ts))))
+
+    tr = PipelineTrainer(jax_stage_fns(stage_fn, loss_fn), params,
+                         n_microbatches=N_MICRO, interleave=2,
+                         prefetch=True)
+    try:
+        mpmd = tr.forward_only(xs, ts)
+    finally:
+        tr.shutdown()
+    assert mpmd == pytest.approx(dry, rel=1e-5), \
+        f"interleaved MPMD loss {mpmd} != dryrun loss {dry}"
+
+
+@pytest.mark.slow
+def test_topology_placement_pins_gangs_to_slices():
+    """Topology-aware placement: a stage_slice_plan turned into
+    placement resources must pin each gang to the node advertising its
+    slice, so chunk hand-offs cross the (simulated) DCN boundary only
+    where dcn_cut_edges says they do."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.parallel import (dcn_cut_edges,
+                                  pipeline_placement_resources,
+                                  stage_slice_plan)
+    from ray_tpu.train import PipelineTrainer
+
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 0})
+        cluster.add_node(num_cpus=2, resources={"pp_slice_0": 4})
+        cluster.add_node(num_cpus=2, resources={"pp_slice_1": 4})
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        plan = stage_slice_plan(2, 2)               # one gang per slice
+        tr = PipelineTrainer(
+            NP_FNS, mk_params(), lr=0.1, n_microbatches=N_MICRO,
+            interleave=2, prefetch=True,
+            placement_plan=pipeline_placement_resources(plan))
+        try:
+            losses = [h["loss"] for h in tr.fit(mk_data, 2)]
+            assert losses[-1] < losses[0]
+            # Map node -> advertised slice resource, then check every
+            # gang member landed inside its assigned slice.
+            slice_of_node = {}
+            for n in ray_tpu.nodes():
+                for s in (0, 1):
+                    if n["Resources"].get(f"pp_slice_{s}"):
+                        slice_of_node[n["NodeID"]] = s
+            for g, idents in enumerate(tr.stage_idents()):
+                for ident in idents:
+                    assert slice_of_node.get(ident["node_id"]) == \
+                        plan[g], (f"gang {g} member on node "
+                                  f"{ident['node_id']} outside slice "
+                                  f"{plan[g]}")
+            # The placement plan cut the 4-chunk loop at every gang
+            # hand-off (2 gangs in 2 slices, interleaved): the oracle
+            # agrees.
+            assert dcn_cut_edges(plan, N_STAGES) == [(0, 1), (1, 2),
+                                                     (2, 3)]
+        finally:
+            tr.shutdown()
+        ray_tpu.shutdown()
+    finally:
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                pass
+
+
+@pytest.mark.slow
+def test_stage_kill_surgical_replay_interleaved_prepush(tmp_path):
+    """The PR-15 surgical-replay gate rerun under interleave=2 +
+    pre-push: a chaos kill takes down one gang (two non-adjacent
+    chunks) mid-schedule; only that gang re-forms and replays, the
+    survivor keeps its pid and exact clean op count, prefetched-but-
+    unconsumed activations are re-pushed, and losses exactly match an
+    uninterrupted interleaved run."""
+    from ray_tpu.train import PipelineTrainer
+
+    ray_tpu.init(num_cpus=8, object_store_memory=256 << 20,
+                 _system_config={
+                     "chaos_enabled": True,
+                     "chaos_seed": 7,
+                     # Two gangs (salts "1", "2"), one member each.  Per
+                     # clean step a gang worker runs 12 fwd + 12 bwd +
+                     # partial + apply + save = 27 compute tasks plus 12
+                     # received prefetch tasks = 39; boot is 3 tasks
+                     # (create/setup/ident).  Ordinal 60 therefore lands
+                     # mid-step-1 compute (step 1 spans ordinals
+                     # 43..81), regardless of how prefetch resolves
+                     # interleave with compute on the victim.
+                     "chaos_kill_worker_salts": "2",
+                     "chaos_kill_worker_at": 60,
+                     "chaos_max_faults": 1,
+                 })
+    try:
+        replays0 = _recoveries("replay")
+        kw = dict(lr=0.1, n_microbatches=N_MICRO, interleave=2,
+                  prefetch=True, ckpt_every=1)
+        tr = PipelineTrainer(NP_FNS, mk_params(), stage_timeout_s=15.0,
+                             storage_path=str(tmp_path / "chaos"), **kw)
+        before = tr.stage_idents()
+        victim = next(g for g, idents in enumerate(before)
+                      if idents[0]["salt"] == "2")
+        chaos_losses = [h["loss"] for h in tr.fit(mk_data, 4)]
+        after = tr.stage_idents()
+        assert tr._recoveries == 1
+        assert _recoveries("replay") == replays0 + 1
+        # Only the killed gang re-formed; the survivor kept its pid and
+        # ran exactly the clean op count (no recomputation): per step
+        # 2 chunks x (6 fwd + 6 bwd) + partial + apply = 26 ops.
+        survivor = 1 - victim
+        assert after[victim][0]["pid"] != before[victim][0]["pid"]
+        assert after[survivor][0]["pid"] == before[survivor][0]["pid"]
+        stats = tr.stage_stats()
+        assert stats[survivor][0]["ops"] == 4 * (2 * 2 * N_MICRO + 2)
+        tr.shutdown()
+
+        # Uninterrupted interleaved reference run in the same cluster
+        # (fresh worker spawn ordinals, so the kill cannot re-fire).
+        tr2 = PipelineTrainer(NP_FNS, mk_params(),
+                              storage_path=str(tmp_path / "clean"), **kw)
+        clean_losses = [h["loss"] for h in tr2.fit(mk_data, 4)]
+        assert tr2._recoveries == 0
+        tr2.shutdown()
+        assert chaos_losses == clean_losses, \
+            f"loss diverged: {chaos_losses} vs {clean_losses}"
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_hostd_kill_interleaved_prepush_rolls_back(tmp_path):
+    """The PR-15 node-loss gate rerun under interleave=2 + pre-push: a
+    scripted hostd kill takes down the node hosting both gangs AND its
+    object store (sealed activations + parked receive windows die with
+    it), forcing the rollback path; the gangs re-form on the spare node
+    and the final losses exactly match a clean interleaved run."""
+    from ray_tpu._private import node as node_mod
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import PipelineTrainer
+
+    base = node_mod._hostd_spawn_seq
+    os.environ["RAY_TPU_CHAOS_ENABLED"] = "1"
+    os.environ["RAY_TPU_CHAOS_KILL_HOSTD_SALTS"] = f"h{base + 2}"
+    os.environ["RAY_TPU_CHAOS_KILL_HOSTD_AT"] = "10"
+    GLOBAL_CONFIG.invalidate_cache()
+    kw = dict(lr=0.1, n_microbatches=N_MICRO, interleave=2,
+              prefetch=True, ckpt_every=1)
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 0})
+        cluster.add_node(num_cpus=2)            # node2: the victim
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        tr = PipelineTrainer(SLOW_FNS, mk_params(),
+                             storage_path=str(tmp_path / "nodeloss"),
+                             stage_timeout_s=20.0, max_failures=4, **kw)
+        before = tr.stage_idents()
+        cluster.add_node(num_cpus=2)            # the failover target
+        cluster.wait_for_nodes()
+
+        chaos_losses = [h["loss"] for h in tr.fit(mk_data, 6)]
+        after = tr.stage_idents()
+        assert tr._recoveries >= 1, "hostd kill never disturbed the run"
+        dead = {idents[0]["node_id"] for idents in before}
+        assert len(dead) == 1                   # both gangs were packed
+        for idents in after:
+            assert idents[0]["node_id"] not in dead
+        tr.shutdown()
+        ray_tpu.shutdown()
+    finally:
+        for k in ("RAY_TPU_CHAOS_ENABLED", "RAY_TPU_CHAOS_KILL_HOSTD_SALTS",
+                  "RAY_TPU_CHAOS_KILL_HOSTD_AT"):
+            os.environ.pop(k, None)
+        GLOBAL_CONFIG.invalidate_cache()
+        fi.reset()
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                pass
+
+    # Clean interleaved reference run (fresh cluster, chaos off).
+    ray_tpu.init(num_cpus=4, object_store_memory=128 << 20)
+    try:
+        tr2 = PipelineTrainer(SLOW_FNS, mk_params(),
+                              storage_path=str(tmp_path / "clean2"), **kw)
+        clean_losses = [h["loss"] for h in tr2.fit(mk_data, 6)]
+        assert tr2._recoveries == 0
+        tr2.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    assert chaos_losses == clean_losses, \
+        f"loss diverged: {chaos_losses} vs {clean_losses}"

@@ -119,7 +119,11 @@ def test_pp_span_kinds_present():
     required_spans = {
         ("pp", "stage_fwd"),    # stage actor: one microbatch forward
         ("pp", "stage_bwd"),    # stage actor: one microbatch backward
-        ("pp", "xfer"),         # stage actor: resolve inter-stage object
+        ("pp", "xfer"),         # stage actor: BLOCKING inter-stage fetch
+        ("pp", "xfer_overlap"),  # stage actor: prefetch-thread fetch,
+                                 # concurrent with compute (PR 18)
+        ("pp", "recv_wait"),    # stage actor: compute waits on an
+                                # in-flight prefetch (exposed overlap)
         ("pp", "apply"),        # stage actor: fold partials + SGD update
         ("pp", "ckpt"),         # stage actor: per-stage sharded save
         ("pp", "step"),         # driver: whole pipeline step
@@ -130,9 +134,29 @@ def test_pp_span_kinds_present():
         ("pp", "stage_dead"),   # driver: a gang was declared dead
         ("pp", "replay"),       # driver: surgical in-place replay chosen
         ("pp", "rollback"),     # driver: global rollback chosen
+        ("pp", "prepush"),      # driver: activation ref shipped into a
+                                # downstream receive window
+        ("pp", "placement"),    # driver: topology placement plan applied
     }
     missing = (required_spans | required_instants) - sites
     assert not missing, f"pp plane kinds vanished: {missing}"
+
+
+def test_pp_compute_spans_are_chunk_tagged():
+    """The interleaved schedule (PR 18) multiplexes several stage-chunks
+    onto one gang; attribution and debugging need the chunk id on every
+    compute/transfer span.  Pin the tag at the call sites so a refactor
+    cannot silently collapse chunks back into an undifferentiated
+    stage."""
+    src = (PKG / "train" / "pipeline_stage.py").read_text()
+    for kind in ("stage_fwd", "stage_bwd", "xfer", "xfer_overlap",
+                 "recv_wait"):
+        m = re.search(
+            r'spans\.(?:span|begin)\(\s*"pp",\s*"%s",([^)]*)\)' % kind,
+            src)
+        assert m, f"pp/{kind} span call site not found"
+        assert "chunk=" in m.group(1), \
+            f"pp/{kind} span lost its chunk= tag"
 
 
 def test_gcs_ft_event_kinds_present():
