@@ -151,6 +151,32 @@ _D("spec_adaptive", True, _bool,
    "adapt each lane's draft length to its measured acceptance: grow on "
    "full acceptance, back off on rejection, so incompressible streams "
    "stop paying rejected verify FLOPs")
+# -- disaggregated serving / KV tier ---------------------------------------
+_D("serve_prefix_routing", False, _bool,
+   "prefix-cache-aware replica routing: the handle scrapes a compact "
+   "prefix-index summary from each LLM replica and routes a request to "
+   "the replica holding its longest cached prefix chain, falling back "
+   "to power-of-two-choices on ties or stale summaries.  Off by "
+   "default: non-LLM deployments have no summary to scrape")
+_D("serve_prefix_scrape_s", 1.0, float,
+   "period of the router's prefix-summary scrape thread")
+_D("serve_prefix_staleness_s", 5.0, float,
+   "summaries older than this never attract traffic (dead or "
+   "redeployed replicas age out of prefix scoring within one bound)")
+_D("serve_prefix_summary_size", 256, int,
+   "max chain hashes a replica exports per prefix summary (newest "
+   "sealed blocks win — bounds scrape payload size)")
+_D("kv_tier", False, _bool,
+   "tiered KV cache: refcount-0 sealed blocks spill to host memory "
+   "and then the object store / disk instead of being destroyed; the "
+   "prefix index keeps a SPILLED state and match/adopt restores "
+   "spilled chains on hit")
+_D("kv_tier_host_blocks", 256, int,
+   "host-memory tier capacity in KV blocks (LRU beyond this "
+   "overflows to the store tier)")
+_D("kv_tier_store_blocks", 1024, int,
+   "object-store/disk tier capacity in KV blocks (LRU beyond this "
+   "is dropped for real); 0 disables the second tier")
 # -- train fault tolerance -------------------------------------------------
 _D("train_hang_timeout_s", 60.0, float,
    "gang declared hung when NO worker makes observable progress (a "

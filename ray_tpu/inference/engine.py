@@ -151,6 +151,10 @@ class _Request:
     # the draft tokens riding the in-flight verify dispatch.
     spec_k: int = 0
     draft: tuple = ()
+    # Disaggregated prefill: run chunked prefill + seal the prompt's
+    # blocks, then finish WITHOUT sampling — the sealed chain is the
+    # product (export_prefix ships it to a decode engine).
+    prefill_only: bool = False
 
     @property
     def prefilling(self) -> bool:
@@ -269,7 +273,8 @@ class InferenceEngine:
                  prefill_chunk: int = 32, seed: int = 0,
                  prefix_cache: bool = True, auto_start: bool = True,
                  spec_k: int = 0, draft_proposer="ngram",
-                 spec_adaptive: bool = True):
+                 spec_adaptive: bool = True,
+                 kv_tier: Optional[bool] = None):
         self.model = _resolve_model(model)
         self.config = (self.model.CONFIGS[config] if isinstance(config, str)
                        else config)
@@ -288,6 +293,14 @@ class InferenceEngine:
             self.model, self.config, num_blocks=num_blocks,
             block_size=block_size, max_lanes=max_lanes,
             max_seq_len=max_seq_len, prefix_cache=prefix_cache)
+        if kv_tier is None:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+            kv_tier = bool(GLOBAL_CONFIG.kv_tier)
+        if kv_tier and prefix_cache:
+            # Runtime import: the tier lives with the serving subsystem
+            # but depends only on util/, so the cycle never closes.
+            from ray_tpu.serve.kv_tier.tier import KVTierCache
+            self.cache.attach_tier(KVTierCache.from_config())
         self.spec_k = int(spec_k)
         self._spec_adaptive = bool(spec_adaptive)
         if self.spec_k > 0:
@@ -314,7 +327,8 @@ class InferenceEngine:
     def submit(self, prompt, max_new_tokens: int = 16, *,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                seed: Optional[int] = None, sample_offset: int = 0,
-               deadline_s: Optional[float] = None) -> GenerationHandle:
+               deadline_s: Optional[float] = None,
+               prefill_only: bool = False) -> GenerationHandle:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -340,7 +354,8 @@ class InferenceEngine:
                                  else time.monotonic() + deadline_s),
                        trace=tracing.current_context(),
                        submitted=time.time(),
-                       spec_k=self.spec_k)
+                       spec_k=self.spec_k,
+                       prefill_only=prefill_only)
         events.record("engine", "submit", trace=req.trace, rid=rid,
                       prompt_len=len(prompt), max_new=max_new_tokens)
         if req.trace is not None:
@@ -368,6 +383,48 @@ class InferenceEngine:
             while self.step():
                 pass
         return h.tokens()
+
+    # -------- disaggregated prefill/decode (serve/kv_tier) --------
+
+    def prefill(self, prompt, *, seed: Optional[int] = None,
+                deadline_s: Optional[float] = None) -> GenerationHandle:
+        """Run chunked prefill for `prompt` and seal its KV blocks into
+        the prefix index WITHOUT sampling a token (finish_reason
+        "prefill").  The handle drains empty; the product is the sealed
+        chain, which `export_prefix` snapshots for a decode engine."""
+        h = self.submit(prompt, 1, seed=seed, deadline_s=deadline_s,
+                        prefill_only=True)
+        if not self._auto:
+            while self.step():
+                pass
+        return h
+
+    def export_prefix(self, tokens) -> Optional[dict]:
+        """Snapshot the longest device-cached chain covering `tokens`
+        (see PagedKVCache.export_prefix) under the engine lock, so the
+        scheduler can't reshuffle blocks mid-gather."""
+        tokens = [int(t) for t in tokens]
+        with self._lock:
+            with spans.span("kv", "export", tokens=len(tokens)):
+                return self.cache.export_prefix(tokens)
+
+    def import_prefix(self, payload: dict) -> int:
+        """Adopt a foreign sealed chain (the prefill→decode handoff)
+        under the engine lock; returns blocks installed.  Idempotent —
+        see PagedKVCache.install_prefix."""
+        with self._lock:
+            with spans.span("kv", "import"):
+                return self.cache.install_prefix(payload)
+
+    def prefix_summary(self, limit: Optional[int] = None) -> dict:
+        """Routing summary of this engine's cached chains (device index
+        + spill tier), bounded by `limit` (config
+        serve_prefix_summary_size when None)."""
+        if limit is None:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+            limit = GLOBAL_CONFIG.serve_prefix_summary_size
+        with self._lock:
+            return self.cache.prefix_summary(limit)
 
     def cancel(self, req: "_Request") -> bool:
         """Abort one request: dequeue it if still waiting, or evict its
@@ -467,6 +524,10 @@ class InferenceEngine:
             "prefix_hit_tokens": cs["hit_tokens"],
             "prefix_miss_tokens": cs["miss_tokens"],
             "blocks_evicted": self.cache.allocator.evictions,
+            "imported_blocks": cs["imported_blocks"],
+            "restored_blocks": cs["restored_blocks"],
+            **(self.cache.tier.counters if self.cache.tier is not None
+               else {}),
             "spec_k": self.spec_k,
             "spec_drafted_tokens": st["drafted"],
             "spec_accepted_tokens": st["accepted"],
@@ -772,6 +833,23 @@ class InferenceEngine:
                 self.cache.seal_full_blocks(lane, req.prompt)
                 if req.prefilling:
                     continue  # more prompt to go; nothing sampled yet
+                if req.prefill_only:
+                    # Disaggregated prefill: the prompt's K/V is sealed
+                    # in the prefix index (it survives the lane free as
+                    # evictable blocks); no token is sampled or
+                    # streamed.  The sampled row is discarded — the
+                    # decode replica draws it with the same fold_in
+                    # keys, so output stays token-exact.
+                    req.finish_reason = "prefill"
+                    req.out.put(_DONE)
+                    self.cache.free_lane(lane)
+                    self._lanes[lane] = None
+                    spans.end(req.span_tok, tokens=0)
+                    req.span_tok = None
+                    events.record("engine", "finish", trace=req.trace,
+                                  rid=req.rid, reason="prefill",
+                                  produced=0)
+                    continue
                 burst = [int(row[0])]
                 accepted = 0
             else:

@@ -38,6 +38,24 @@ import numpy as np
 _ROOT_HASH = 0
 
 
+def chain_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+    """Cumulative chain hash of every block-aligned prefix of `tokens`,
+    in the exact convention the prefix index uses (`hash((parent,
+    block_tokens))`, root 0) and with the same one-token-left cap as
+    match_prefix.  Tuple-of-int hashing is deterministic across
+    processes (PYTHONHASHSEED randomizes str/bytes only), so a router
+    can score replica summaries against a request without shipping
+    tokens."""
+    out: List[int] = []
+    parent = _ROOT_HASH
+    for i in range((len(tokens) - 1) // block_size):
+        parent = hash((parent, tuple(int(t) for t in
+                                     tokens[i * block_size:
+                                            (i + 1) * block_size])))
+        out.append(parent)
+    return out
+
+
 class BlockAllocator:
     """Refcounted free-list over pool block ids.
 
@@ -176,7 +194,18 @@ class PagedKVCache:
         self._lane_sealed = [0] * max_lanes     # sealed block count per lane
         self._lane_parent = [_ROOT_HASH] * max_lanes   # chain hash cursor
         self.stats = {"hit_tokens": 0, "miss_tokens": 0, "hits": 0,
-                      "misses": 0, "sealed_blocks": 0}
+                      "misses": 0, "sealed_blocks": 0, "imported_blocks": 0,
+                      "restored_blocks": 0}
+        # Optional tiered spill cache (serve/kv_tier): evicted sealed
+        # blocks move here instead of being destroyed, and the match /
+        # adopt path restores them on hit (the SPILLED index state).
+        self.tier = None
+
+    def attach_tier(self, tier) -> None:
+        """Attach a spill tier (duck-typed: contains/put/pop/discard/
+        summary_hashes/__len__).  Evictions start spilling immediately;
+        match/adopt start seeing spilled chains."""
+        self.tier = tier
 
     @classmethod
     def for_model(cls, model, config, **kw) -> "PagedKVCache":
@@ -220,57 +249,133 @@ class PagedKVCache:
         """Longest chain of cached sealed blocks covering a block-aligned
         prefix of `tokens`, capped so at least one prompt token is always
         left to prefill (its logits seed the first sampled token).  Pure
-        lookup — takes no references."""
+        lookup — takes no references.  Device blocks only; spilled chain
+        nodes (see `_match_chain`) do not appear here."""
+        if not self.prefix_cache_enabled:
+            return []
+        out: List[int] = []
+        for kind, _key, block in self._match_chain(tokens):
+            if kind != "dev":
+                break
+            out.append(block)
+        return out
+
+    def _match_chain(self, tokens: Sequence[int]) -> List[Tuple]:
+        """Longest cached chain covering a block-aligned prefix of
+        `tokens`, walking THROUGH the spill tier: each entry is
+        ("dev", key, block) for a device-resident sealed block or
+        ("tier", key, None) for a spilled one (restorable on adopt).  A
+        device child behind a spilled parent is reachable again — the
+        chain is content-addressed, so the restored parent revalidates
+        it by construction."""
         if not self.prefix_cache_enabled:
             return []
         bs = self.block_size
-        out: List[int] = []
+        out: List[Tuple] = []
         parent = _ROOT_HASH
         for i in range((len(tokens) - 1) // bs):
             key = (parent, tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
             block = self._index.get(key)
-            if block is None:
+            if block is not None:
+                out.append(("dev", key, block))
+            elif self.tier is not None and self.tier.contains(key):
+                out.append(("tier", key, None))
+            else:
                 break
-            out.append(block)
             parent = hash(key)
         return out
 
     def can_admit_prefix(self, tokens: Sequence[int],
                          headroom_blocks: int = 0) -> bool:
-        """Admission check that accounts for reuse: matched blocks are
-        referenced (not allocated), but matched blocks currently parked
-        evictable stop counting as free capacity once taken."""
-        matched = self.match_prefix(tokens)
-        need = (self.blocks_needed(len(tokens)) - len(matched)
+        """Admission check that accounts for reuse: device-matched blocks
+        are referenced (not allocated), but matched blocks currently
+        parked evictable stop counting as free capacity once taken.
+        Spilled matches still cost an allocation (they restore into
+        fresh blocks), so they stay inside `need`."""
+        dev = [b for kind, _k, b in self._match_chain(tokens)
+               if kind == "dev"]
+        need = (self.blocks_needed(len(tokens)) - len(dev)
                 + headroom_blocks)
         free_after = (self.allocator.num_free
-                      - sum(self.allocator.is_evictable(b) for b in matched))
+                      - sum(self.allocator.is_evictable(b) for b in dev))
         return need <= free_after
 
     def adopt_prefix(self, lane: int, tokens: Sequence[int]) -> int:
         """Sequence start with prefix reuse: take shares of the longest
-        cached prefix chain, allocate fresh blocks for the rest of the
-        prompt, and report how many context tokens came from the cache
-        (the engine skips prefilling them)."""
+        cached prefix chain (restoring any spilled links from the tier),
+        allocate fresh blocks for the rest of the prompt, and report how
+        many context tokens came from the cache (the engine skips
+        prefilling them)."""
         if self._lane_blocks[lane]:
             raise ValueError(f"lane {lane} already allocated")
         if len(tokens) > self.max_seq_len:
             raise ValueError(f"prompt of {len(tokens)} exceeds max_seq_len "
                              f"{self.max_seq_len}")
-        cached = self.match_prefix(tokens)
-        # Take the shares FIRST so the fresh allocation below can never
-        # evict a block this very request is about to reuse.
-        for b in cached:
+        entries = self._match_chain(tokens)
+        # Pop spilled payloads out of the tier FIRST: once held here,
+        # the allocations below can spill other blocks into the tier
+        # without LRU pressure dropping the very chain being restored.
+        # A pop that misses (aged out since the match) truncates the
+        # usable chain at the hole — later links have no K/V under them.
+        restores: List[Tuple] = []      # (chain_pos, key, (k_np, v_np))
+        usable = len(entries)
+        for pos, (kind, key, _b) in enumerate(entries):
+            if kind != "tier":
+                continue
+            payload = self.tier.pop(key)
+            if payload is None:
+                usable = pos
+                break
+            restores.append((pos, key, payload))
+        entries = entries[:usable]
+        restores = [r for r in restores if r[0] < usable]
+        dev_blocks = [b for kind, _k, b in entries if kind == "dev"]
+        # Take the device shares FIRST so the fresh allocation below can
+        # never evict a block this very request is about to reuse.
+        for b in dev_blocks:
             self.allocator.incref(b)
         try:
             fresh = self.allocator.alloc(
-                self.blocks_needed(len(tokens)) - len(cached))
+                self.blocks_needed(len(tokens)) - len(dev_blocks))
         except RuntimeError:
-            for b in cached:
+            for b in dev_blocks:
                 self.allocator.decref(b)
+            for _pos, key, (k_np, v_np) in restores:
+                self.tier.put(key, k_np, v_np)   # undo the pops
             raise
+        # Assemble the lane's block list in chain order: device hits
+        # keep their blocks, spilled hits consume fresh blocks (their
+        # contents scatter in below), the prompt tail takes the rest.
+        fresh_iter = iter(fresh)
+        chain_blocks: List[int] = []
+        restored: List[Tuple] = []      # (block, chain_pos, key)
+        for pos, (kind, key, b) in enumerate(entries):
+            if kind == "dev":
+                chain_blocks.append(b)
+            else:
+                nb = next(fresh_iter)
+                chain_blocks.append(nb)
+                restored.append((nb, pos, key))
+        tail = list(fresh_iter)
+        if restored:
+            idx = jnp.asarray(np.asarray([b for b, _p, _k in restored],
+                                         np.int32))
+            kstack = np.stack([restores[i][2][0]
+                               for i in range(len(restores))], axis=1)
+            vstack = np.stack([restores[i][2][1]
+                               for i in range(len(restores))], axis=1)
+            self.k = self.k.at[:, idx].set(jnp.asarray(kstack))
+            self.v = self.v.at[:, idx].set(jnp.asarray(vstack))
+            for nb, _pos, key in restored:
+                # Restored blocks re-enter the device index (live now,
+                # evictable again once the lane lets go).
+                self._index[key] = nb
+                self._block_key[nb] = key
+                self.allocator.mark_cached(nb)
+                self.stats["restored_blocks"] += 1
+        cached = chain_blocks
         cached_len = len(cached) * self.block_size
-        self._install_lane(lane, cached + fresh, cached_len)
+        self._install_lane(lane, cached + tail, cached_len)
         self._lane_parent[lane] = _ROOT_HASH
         if cached:
             # Rebuild the chain cursor at the sealed boundary so blocks
@@ -314,22 +419,127 @@ class PagedKVCache:
                 self._block_key[block] = key
                 self.allocator.mark_cached(block)
                 self.stats["sealed_blocks"] += 1
+                if self.tier is not None:
+                    # Re-sealed on device: the spilled copy is stale
+                    # freight now (content-addressed, so identical).
+                    self.tier.discard(key)
             self._lane_parent[lane] = hash(key)
             self._lane_sealed[lane] += 1
 
     def _on_evict(self, block: int) -> None:
-        """Allocator reclaimed a cached block: drop its index entry.
-        Children of the evicted chain node stay indexed but unreachable
-        until an identical parent is re-sealed — at which point they are
-        valid again by construction (content-addressed, not
-        block-addressed)."""
+        """Allocator reclaimed a cached block: drop its index entry —
+        spilling the content into the attached tier first, so the chain
+        link survives eviction in SPILLED state.  Children of the
+        evicted chain node stay indexed; with a tier they remain
+        reachable THROUGH the spilled parent, without one they are
+        unreachable until an identical parent is re-sealed — at which
+        point they are valid again by construction (content-addressed,
+        not block-addressed)."""
         key = self._block_key.pop(block, None)
         if key is not None and self._index.get(key) == block:
             del self._index[key]
+            if self.tier is not None:
+                self.tier.put(key, np.asarray(self.k[:, block]),
+                              np.asarray(self.v[:, block]))
 
     @property
     def num_indexed_blocks(self) -> int:
         return len(self._index)
+
+    # ---------------- disaggregated handoff / summaries ----------------
+
+    def export_prefix(self, tokens: Sequence[int]) -> Optional[dict]:
+        """Snapshot the longest DEVICE-cached chain covering a
+        block-aligned prefix of `tokens` as a codec payload: chain
+        token-blocks plus gathered K/V contents, enough for a foreign
+        cache to rebuild the same content-addressed links.  None when
+        nothing is cached."""
+        entries = []
+        for kind, key, block in self._match_chain(tokens):
+            if kind != "dev":
+                break           # spilled links don't ship (restore is local)
+            entries.append((key, block))
+        if not entries:
+            return None
+        idx = jnp.asarray(np.asarray([b for _k, b in entries], np.int32))
+        return {
+            "v": 1,
+            "block_size": self.block_size,
+            "chain": [list(key[1]) for key, _b in entries],
+            "k": np.asarray(self.k[:, idx]),
+            "v_pool": np.asarray(self.v[:, idx]),
+        }
+
+    def install_prefix(self, payload: dict) -> int:
+        """Adopt foreign sealed blocks (the prefill→decode handoff): for
+        each shipped chain node not already present locally, allocate a
+        block, scatter the shipped K/V in, and index it at refcount 0
+        (evictable) — a subsequent adopt_prefix on the same prompt then
+        takes shares exactly as if the blocks had been sealed here.
+        Content-addressed and idempotent: repeating the import after a
+        failover is a no-op for links already present.  Returns how many
+        blocks were installed."""
+        if not self.prefix_cache_enabled or not payload:
+            return 0
+        if payload.get("v") != 1 or payload.get("block_size") != \
+                self.block_size:
+            return 0
+        k_arr, v_arr = payload["k"], payload["v_pool"]
+        if tuple(k_arr.shape[2:]) != tuple(self.k.shape[2:]) or \
+                k_arr.shape[0] != self.k.shape[0]:
+            return 0            # foreign model shape: refuse quietly
+        parent = _ROOT_HASH
+        new = []                # (chain_pos, key, block)
+        for i, blk_tokens in enumerate(payload["chain"]):
+            key = (parent, tuple(int(t) for t in blk_tokens))
+            present = (key in self._index
+                       or (self.tier is not None
+                           and self.tier.contains(key)))
+            if not present:
+                try:
+                    # May evict LRU cached blocks (new prefix beats old)
+                    # but never steals live capacity: alloc raises only
+                    # when everything is referenced, and we stop there.
+                    (b,) = self.allocator.alloc(1)
+                except RuntimeError:
+                    break
+                new.append((i, key, b))
+            parent = hash(key)
+        if not new:
+            return 0
+        idx = jnp.asarray(np.asarray([b for _i, _k, b in new], np.int32))
+        pos = np.asarray([i for i, _k, _b in new])
+        self.k = self.k.at[:, idx].set(jnp.asarray(k_arr[:, pos]))
+        self.v = self.v.at[:, idx].set(jnp.asarray(v_arr[:, pos]))
+        # Index + park evictable only AFTER every alloc: the blocks stay
+        # at refcount 1 through the loop above so a later alloc in the
+        # same import can never reclaim an earlier install.
+        for _i, key, b in new:
+            self._index[key] = b
+            self._block_key[b] = key
+            self.allocator.mark_cached(b)
+            self.allocator.decref(b)
+            self.stats["imported_blocks"] += 1
+        return len(new)
+
+    def prefix_summary(self, limit: int = 256) -> dict:
+        """Compact routing summary: the cumulative chain hashes of every
+        sealed block this cache can serve (device index + spill tier),
+        newest last, capped at `limit`.  A router holding the request's
+        own chain hashes scores this replica by deepest overlap without
+        ever shipping tokens."""
+        hashes = [hash(k) for k in self._block_key.values()]
+        if self.tier is not None:
+            hashes.extend(self.tier.summary_hashes())
+        # Order-preserving dedup; newest sealed blocks win the cap.
+        hashes = list(dict.fromkeys(hashes))[-max(int(limit), 1):]
+        return {
+            "v": 1,
+            "block_size": self.block_size,
+            "hashes": hashes,
+            "indexed_blocks": len(self._index),
+            "tier_blocks": 0 if self.tier is None else len(self.tier),
+        }
 
     # ---------------- lane growth / teardown ----------------
 

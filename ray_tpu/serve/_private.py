@@ -476,6 +476,12 @@ class ServeController:
                 return  # already draining (reconcile/delete race)
             self._draining.append(rec)
             n = len(self._draining)
+            # Defense-in-depth: if any path leaves the victim visible in
+            # a routing snapshot, its state says DRAINING and the router
+            # filters it before scoring candidates.
+            entry = self._deployments.get(name)
+            if entry is not None and key in entry.get("states", {}):
+                entry["states"][key] = REPLICA_DRAINING
         _serve_metrics()["draining"].set(n)
         events.record("serve", "drain_start", deployment=name)
 
@@ -732,6 +738,13 @@ class ServeController:
             if entry is None:
                 return None
             return {"replicas": list(entry["replicas"]),
+                    # Per-replica lifecycle states ride the routing table
+                    # so the client-side router can filter non-RUNNING
+                    # replicas out of its candidate sample (a DRAINING
+                    # victim must never attract new traffic — prefix
+                    # affinity included).
+                    "states": {k: v
+                               for k, v in entry.get("states", {}).items()},
                     "max_concurrent_queries":
                         entry["config"].max_concurrent_queries,
                     "queue_limit": entry["config"].queue_limit,
@@ -817,6 +830,16 @@ class _RouterState:
         self.fetched_at = 0.0
         self.known_version = -1
         self.poller: Optional[threading.Thread] = None
+        # Replica lifecycle states from the routing table (actor id ->
+        # "RUNNING"/"DRAINING"); non-RUNNING replicas are filtered out
+        # of the candidate sample.
+        self.states: Dict[bytes, str] = {}
+        # Prefix-cache-aware routing (serve_prefix_routing): the scrape
+        # thread fills actor id -> {"hashes": set, "block_size", "ts"};
+        # summaries older than serve_prefix_staleness_s never score.
+        self.prefix: Dict[bytes, dict] = {}
+        self.prefix_thread: Optional[threading.Thread] = None
+        self.prefix_disabled = False
 
 
 _router_states: Dict[str, _RouterState] = {}
@@ -871,6 +894,20 @@ def _get_router_state(name: str) -> _RouterState:
 _UNSET = object()
 
 
+def _chain_hashes(tokens, block_size: int):
+    """Cumulative prefix-chain hash per block of `tokens` — MUST stay
+    identical to inference.kv_cache.chain_hashes (pinned by a test);
+    duplicated here so the routing path never imports jax."""
+    out = []
+    parent = 0
+    for i in range((len(tokens) - 1) // block_size):
+        parent = hash((parent, tuple(int(t) for t in
+                                     tokens[i * block_size:
+                                            (i + 1) * block_size])))
+        out.append(parent)
+    return out
+
+
 class DeploymentHandle:
     """Client-side handle with power-of-two-choices routing + in-flight
     cap (reference: handle.py over router.py:224-263).  Picklable:
@@ -919,11 +956,18 @@ class DeploymentHandle:
             st.max_q = routing["max_concurrent_queries"]
             st.queue_limit = routing.get("queue_limit")
             st.known_version = routing.get("version", -1)
+            st.states = dict(routing.get("states") or {})
             st.fetched_at = time.monotonic()
             alive = {r._actor_id.binary() for r in st.replicas}
             for key in list(st.in_flight):
                 if key not in alive:
                     del st.in_flight[key]
+            # A dead/redeployed replica's prefix summary must never
+            # attract traffic: drop it with the replica, not at the
+            # staleness horizon.
+            for key in list(st.prefix):
+                if key not in alive:
+                    del st.prefix[key]
 
     def _refresh(self, force=False):
         st = self._state
@@ -963,6 +1007,99 @@ class DeploymentHandle:
         self._apply_routing(routing)
         self._ensure_poller()
 
+    # ---------------- prefix-cache-aware routing ----------------
+
+    def _ensure_prefix_scraper(self):
+        """One summary-scrape thread per deployment router state (the
+        poller pattern), alive only while serve_prefix_routing is on and
+        the deployment actually exports summaries."""
+        st = self._state
+        with st.lock:
+            if st.prefix_disabled or (st.prefix_thread is not None
+                                      and st.prefix_thread.is_alive()):
+                return
+            st.prefix_thread = threading.Thread(
+                target=self._prefix_scrape_loop, daemon=True,
+                name=f"serve-prefix-scrape-{self._name}")
+            st.prefix_thread.start()
+
+    def _prefix_scrape_loop(self):
+        import ray_tpu.api as _api
+        st = self._state
+        while (_api._worker is not None and not st.prefix_disabled
+               and GLOBAL_CONFIG.serve_prefix_routing):
+            with st.lock:
+                replicas = list(st.replicas)
+            for r in replicas:
+                try:
+                    summ = ray_tpu.get(
+                        r.handle_request.remote("prefix_summary", (), {},
+                                                False, 5.0),
+                        timeout=5.0)
+                    if not isinstance(summ, dict):
+                        raise TypeError("not a summary")
+                    with st.lock:
+                        st.prefix[r._actor_id.binary()] = {
+                            "hashes": set(summ.get("hashes") or ()),
+                            "block_size": int(summ.get("block_size") or 0),
+                            "ts": time.monotonic()}
+                except Exception as e:
+                    # Deployments without prefix_summary (non-LLM) turn
+                    # scraping OFF for this router instead of hammering
+                    # every replica forever; dead replicas just age out
+                    # (the staleness bound stops their summaries from
+                    # scoring long before the table refresh prunes them).
+                    msg = f"{type(e).__name__}: {e}"
+                    if ("AttributeError" in msg
+                            and "prefix_summary" in msg):
+                        st.prefix_disabled = True
+                        return
+            time.sleep(max(GLOBAL_CONFIG.serve_prefix_scrape_s, 0.05))
+
+    def _prefix_order(self, args, kwargs) -> Optional[Dict[bytes, int]]:
+        """Score replicas for this request by deepest cached prefix:
+        actor id -> matched chain depth, or None when prefix routing is
+        off / the request has no token prompt / no fresh summary scores
+        (the caller then falls back to pure power-of-two-choices)."""
+        if not GLOBAL_CONFIG.serve_prefix_routing:
+            return None
+        st = self._state
+        if st.prefix_disabled:
+            return None
+        self._ensure_prefix_scraper()
+        prompt = args[0] if args else (kwargs or {}).get("prompt")
+        if isinstance(prompt, (list, tuple)) and prompt:
+            try:
+                tokens = [int(t) for t in prompt]
+            except (TypeError, ValueError):
+                return None
+        else:
+            return None
+        now = time.monotonic()
+        stale = GLOBAL_CONFIG.serve_prefix_staleness_s
+        with st.lock:
+            fresh = [(rid, info) for rid, info in st.prefix.items()
+                     if now - info["ts"] <= stale]
+        if not fresh:
+            return None
+        scores: Dict[bytes, int] = {}
+        hs_by_bs: Dict[int, list] = {}
+        for rid, info in fresh:
+            bs = info["block_size"]
+            if bs <= 0:
+                continue
+            hs = hs_by_bs.get(bs)
+            if hs is None:
+                hs = hs_by_bs[bs] = _chain_hashes(tokens, bs)
+            depth = 0
+            for h in hs:
+                if h not in info["hashes"]:
+                    break
+                depth += 1
+            if depth:
+                scores[rid] = depth
+        return scores or None
+
     def _ensure_poller(self):
         """Config changes PUSH to the shared router state via ONE
         controller long-poll thread per deployment (reference:
@@ -997,44 +1134,77 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         return self._call(self._method, args, kwargs)
 
-    def _pick_replica(self):
+    def _pick_replica(self, prefer: Optional[Dict[bytes, int]] = None):
         """One routing decision under the in-flight cap: power-of-two-
         choices on in-flight counts (reference: router.py's least-loaded
         two-candidate sampling), ties rotated round-robin so idle
         replicas still share traffic.  If both sampled replicas are
         saturated, scan the rest — admission must succeed whenever ANY
-        replica is under its cap.  Returns (replica, key) or None when
-        every replica is saturated."""
+        replica is under its cap.
+
+        Replicas whose routing-table state is not RUNNING are filtered
+        OUT of the candidate sample up front: a DRAINING victim finishes
+        its in-flight work but never attracts new traffic — prefix
+        affinity included (this is the draining-victim fix: the old
+        sampler only noticed drained replicas at the in-flight probe).
+
+        `prefer` (actor id -> cached-prefix depth, from _prefix_order)
+        stable-sorts the candidate order deepest-prefix-first, so the
+        p2c/round-robin order is exactly the fallback on ties, unknown
+        replicas and stale summaries.  Returns (replica, key) or None
+        when every replica is saturated."""
         st = self._state
         with st.lock:
             n = len(st.replicas)
             if n == 0:
                 return None
             st.rr += 1
-            if n == 1:
-                order = [0]
+            if st.states:
+                elig = [k for k in range(n)
+                        if st.states.get(
+                            st.replicas[k]._actor_id.binary(),
+                            REPLICA_RUNNING) == REPLICA_RUNNING]
+                if not elig:
+                    # Stale/partial states must not brick routing — the
+                    # in-flight probe still backstops a bad pick.
+                    elig = list(range(n))
             else:
-                i = random.randrange(n)
-                j = random.randrange(n - 1)
+                elig = list(range(n))
+            m = len(elig)
+            if m == 1:
+                order = list(elig)
+            else:
+                i = random.randrange(m)
+                j = random.randrange(m - 1)
                 if j >= i:
                     j += 1
-                fi = st.in_flight.get(st.replicas[i]._actor_id.binary(), 0)
-                fj = st.in_flight.get(st.replicas[j]._actor_id.binary(), 0)
+                fi = st.in_flight.get(
+                    st.replicas[elig[i]]._actor_id.binary(), 0)
+                fj = st.in_flight.get(
+                    st.replicas[elig[j]]._actor_id.binary(), 0)
                 if fi == fj:
                     # Tie (the common idle case): deterministic round-
                     # robin, so even a short sequential burst provably
                     # spreads across replicas.
-                    start = st.rr % n
-                    order = [(start + k) % n for k in range(n)]
+                    start = st.rr % m
+                    order = [elig[(start + k) % m] for k in range(m)]
                 else:
                     if fj < fi:
                         i, j = j, i
-                    order = ([i, j]
-                             + [k for k in range(n) if k not in (i, j)])
+                    order = ([elig[i], elig[j]]
+                             + [elig[k] for k in range(m)
+                                if k not in (i, j)])
+            if prefer:
+                order.sort(key=lambda idx: -prefer.get(
+                    st.replicas[idx]._actor_id.binary(), 0))
             for idx in order:
                 key = st.replicas[idx]._actor_id.binary()
                 if st.in_flight.get(key, 0) < st.max_q:
                     st.in_flight[key] = st.in_flight.get(key, 0) + 1
+                    depth = prefer.get(key, 0) if prefer else 0
+                    if depth > 0:
+                        events.record("serve", "prefix_route",
+                                      deployment=self._name, depth=depth)
                     return st.replicas[idx], key
         return None
 
@@ -1075,8 +1245,9 @@ class DeploymentHandle:
         limit = time.monotonic() + GLOBAL_CONFIG.serve_backpressure_timeout_s
         return limit if deadline is None else min(limit, deadline)
 
-    def _acquire_replica(self, deadline: Optional[float]):
-        """Admit one request: pick a replica under its cap, else wait in
+    def _acquire_replica(self, deadline: Optional[float], prefer=None):
+        """Admit one request: pick a replica under its cap (preferring
+        `prefer`'s deepest-cached-prefix order when set), else wait in
         the bounded queue until one frees up, the backpressure window
         closes, or the request deadline passes."""
         t0 = time.perf_counter()
@@ -1084,7 +1255,7 @@ class DeploymentHandle:
         # classic serve bottleneck); untraced ones keep the instant event.
         tok = (spans.begin("serve", "admit", deployment=self._name)
                if tracing.current_context() is not None else None)
-        pick = self._pick_replica()
+        pick = self._pick_replica(prefer)
         if pick is not None:
             self._observe_admit(t0)
             spans.end(tok, queued=False)
@@ -1093,7 +1264,7 @@ class DeploymentHandle:
         try:
             limit = self._wait_deadline(deadline)
             while True:
-                pick = self._pick_replica()
+                pick = self._pick_replica(prefer)
                 if pick is not None:
                     self._observe_admit(t0)
                     spans.end(tok, queued=True)
@@ -1113,12 +1284,13 @@ class DeploymentHandle:
         events.record("serve", "admit", deployment=self._name,
                       wait_s=round(wait, 6))
 
-    async def _acquire_replica_async(self, deadline: Optional[float]):
+    async def _acquire_replica_async(self, deadline: Optional[float],
+                                     prefer=None):
         import asyncio
         t0 = time.perf_counter()
         tok = (spans.begin("serve", "admit", deployment=self._name)
                if tracing.current_context() is not None else None)
-        pick = self._pick_replica()
+        pick = self._pick_replica(prefer)
         if pick is not None:
             self._observe_admit(t0)
             spans.end(tok, queued=False)
@@ -1127,7 +1299,7 @@ class DeploymentHandle:
         try:
             limit = self._wait_deadline(deadline)
             while True:
-                pick = self._pick_replica()
+                pick = self._pick_replica(prefer)
                 if pick is not None:
                     self._observe_admit(t0)
                     spans.end(tok, queued=True)
@@ -1168,7 +1340,8 @@ class DeploymentHandle:
         try:
             self._refresh()
             deadline = self._request_deadline()
-            replica, key = self._acquire_replica(deadline)
+            replica, key = self._acquire_replica(
+                deadline, self._prefix_order(args, kwargs))
             ref = replica.handle_request.remote(
                 method, args, kwargs, False, self._remaining(deadline))
         except BaseException:
@@ -1228,7 +1401,8 @@ class DeploymentHandle:
         """One replica-pinned streaming attempt; the first `skip` chunks
         are swallowed (already delivered by a previous attempt)."""
         self._refresh()
-        replica, key = self._acquire_replica(deadline)
+        replica, key = self._acquire_replica(
+            deadline, self._prefix_order(args, kwargs))
         try:
             req_ref = replica.handle_request.remote(
                 self._method, args, kwargs, True, self._remaining(deadline))
@@ -1322,7 +1496,8 @@ class DeploymentHandle:
                     else max(0.1, min(base, deadline - time.monotonic())))
 
         self._refresh()
-        replica, key = await self._acquire_replica_async(deadline)
+        replica, key = await self._acquire_replica_async(
+            deadline, self._prefix_order(args, kwargs))
         try:
             # Per-step timeout: a wedged generator must not hold this
             # coroutine (and the in-flight slot) forever — mirror the
@@ -1375,7 +1550,8 @@ class DeploymentHandle:
         if req_deadline is not None:
             deadline = min(deadline, req_deadline)
         self._refresh()
-        replica, key = await self._acquire_replica_async(deadline)
+        replica, key = await self._acquire_replica_async(
+            deadline, self._prefix_order(args, kwargs))
         ref = replica.handle_request.remote(
             method, args, kwargs, False, deadline - time.monotonic())
         released = False

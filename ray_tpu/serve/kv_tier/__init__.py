@@ -1,0 +1,25 @@
+"""Disaggregated cache-aware serving: the cluster-wide KV tier.
+
+Three coupled planes over the serving engines (see README
+"Disaggregated serving & tiered KV cache"):
+
+- ``codec.KVBlockCodec`` — wire format for sealed KV blocks + their
+  hash-chain metadata, so a prefill replica's cache contents can be
+  adopted bit-exactly by a decode replica's ``PagedKVCache``.
+- ``tier.KVTierCache`` — host-memory → object-store/disk spill tiers
+  for refcount-0 sealed blocks (the SPILLED prefix-index state), LRU
+  pressure eviction across tiers, ``kv_tier_*`` counters.
+- ``disagg`` — dedicated prefill / decode deployments and the
+  ``DisaggLLMHandle`` front that ships sealed prefixes prefill→decode
+  over the object plane and streams tokens with the existing
+  mid-stream failover policy.
+"""
+
+from ray_tpu.serve.kv_tier.codec import KVBlockCodec, KVCodecError  # noqa: F401
+from ray_tpu.serve.kv_tier.tier import KVTierCache  # noqa: F401
+from ray_tpu.serve.kv_tier.disagg import (  # noqa: F401
+    DisaggLLMHandle,
+    PrefillLLMDeployment,
+    DecodeLLMDeployment,
+    run_disaggregated,
+)

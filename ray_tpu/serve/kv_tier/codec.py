@@ -1,0 +1,87 @@
+"""Wire format for sealed KV blocks + hash-chain metadata.
+
+One encoded payload carries a contiguous chain of sealed blocks — the
+per-block token tuples (enough to rebuild every content-addressed chain
+key from the root) and the gathered K/V pool contents, dtype and all.
+The decode-side ``PagedKVCache.install_prefix`` adopts the blocks as if
+it had sealed them itself, so a prefill→decode handoff is bit-exact by
+construction and idempotent on retry (content-addressed links already
+present are skipped).
+
+The payload is bytes on the wire: beyond the inline-object threshold it
+automatically rides the native shm object plane (``objtransfer.cc`` via
+``object_transfer.py``) like any other big serve argument — the codec
+never needs to know about transports.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Optional
+
+import numpy as np
+
+_MAGIC = b"KVT1"
+
+
+class KVCodecError(ValueError):
+    """Payload is not a KVBlockCodec frame (or an incompatible one)."""
+
+
+class KVBlockCodec:
+    """Encode/decode ``PagedKVCache.export_prefix`` payloads.
+
+    The frame is a 4-byte magic + a pickled dict whose arrays are plain
+    numpy (pickle round-trips them bit-exactly, dtype included).  A
+    version field inside the dict gates forward compatibility; the
+    magic catches whole-payload confusion early (a truncated or foreign
+    blob raises KVCodecError, never a half-installed cache)."""
+
+    @staticmethod
+    def encode(payload: dict) -> bytes:
+        if not payload or payload.get("v") != 1:
+            raise KVCodecError("not an export_prefix v1 payload")
+        buf = io.BytesIO()
+        buf.write(_MAGIC)
+        pickle.dump(
+            {
+                "v": 1,
+                "block_size": int(payload["block_size"]),
+                "chain": [list(map(int, blk)) for blk in payload["chain"]],
+                "k": np.ascontiguousarray(payload["k"]),
+                "v_pool": np.ascontiguousarray(payload["v_pool"]),
+            },
+            buf, protocol=pickle.HIGHEST_PROTOCOL)
+        return buf.getvalue()
+
+    @staticmethod
+    def decode(blob: bytes) -> dict:
+        if not isinstance(blob, (bytes, bytearray, memoryview)):
+            raise KVCodecError(f"expected bytes, got {type(blob).__name__}")
+        blob = bytes(blob)
+        if blob[:4] != _MAGIC:
+            raise KVCodecError("bad magic: not a KV block frame")
+        try:
+            payload = pickle.loads(blob[4:])
+        except Exception as exc:
+            raise KVCodecError(f"corrupt KV block frame: {exc}") from exc
+        if payload.get("v") != 1:
+            raise KVCodecError(f"unknown KV frame version {payload.get('v')}")
+        k, v = payload["k"], payload["v_pool"]
+        n = len(payload["chain"])
+        bs = payload["block_size"]
+        if k.shape != v.shape or k.shape[1] != n or k.shape[2] != bs:
+            raise KVCodecError(
+                f"frame shape mismatch: k{k.shape} v{v.shape} vs "
+                f"{n} chain blocks of size {bs}")
+        return payload
+
+    @staticmethod
+    def try_decode(blob) -> Optional[dict]:
+        """Decode-or-None: the decode path treats a bad handoff as a
+        cache miss (re-prefill), never a failed request."""
+        try:
+            return KVBlockCodec.decode(blob)
+        except KVCodecError:
+            return None

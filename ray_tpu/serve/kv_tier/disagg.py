@@ -1,0 +1,261 @@
+"""Prefill/decode disaggregation over the serve plane.
+
+Two dedicated deployments instead of one monolithic LLM replica set:
+
+- ``PrefillLLMDeployment`` replicas run chunked prefill ONLY (never
+  decode, never speculate).  A ``prefill()`` call seals the prompt's KV
+  blocks into the replica's prefix index and returns them as one
+  ``KVBlockCodec`` frame.
+- ``DecodeLLMDeployment`` replicas stream tokens.  ``generate()``
+  accepts an optional ``kv_handoff`` frame and adopts it into the local
+  ``PagedKVCache`` as sealed prefix blocks before submitting, so decode
+  starts from the shipped prefix instead of re-running prefill.
+  Speculative decoding (when enabled) runs purely on these replicas.
+
+``DisaggLLMHandle`` fronts both: it runs the prefill hop, ships the
+sealed frame decode-ward (bytes over the serve arg path — big frames
+automatically ride the native shm object plane), and streams tokens
+with the existing ``llm_stream_resume`` mid-stream failover.  Every
+failure mode of the handoff degrades to correctness, never an error:
+
+- prefill replica death → ``kv/handoff_lost`` + heal, decode replica
+  re-prefills locally (token-exact by construction — the KV contents
+  are a pure function of the prompt and the shared weights);
+- a corrupt/truncated frame → ``KVBlockCodec.try_decode`` returns None,
+  decode re-prefills;
+- decode replica death mid-stream → ``llm_stream_resume`` resubmits
+  with the produced suffix appended (``kv_handoff`` stays in kwargs:
+  adoption is idempotent, so the healed replica imports the same frame
+  and re-prefills only the produced tail).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import ray_tpu
+from ray_tpu.serve.api import deployment, run as serve_run
+from ray_tpu.serve.kv_tier.codec import KVBlockCodec
+from ray_tpu.serve.llm import llm_stream_resume
+from ray_tpu.util import events, spans
+
+
+@deployment(name="llm-prefill", max_concurrent_queries=64)
+class PrefillLLMDeployment:
+    """Prefill-only replica: seals prompt KV, exports sealed frames.
+
+    Runs no decode steps for callers — ``max_new_tokens`` is pinned to
+    the prefill-only path — so its lanes turn over at prefill latency
+    and a burst of long cold prompts never sits behind decode steps."""
+
+    def __init__(self, model="gpt", config="nano", params=None, *,
+                 max_lanes: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: int = 32, seed: int = 0,
+                 kv_tier: Optional[bool] = None):
+        from ray_tpu.inference import InferenceEngine  # jax: replica-only
+        self._engine = InferenceEngine(
+            model, config, params, max_lanes=max_lanes,
+            block_size=block_size, num_blocks=num_blocks,
+            max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
+            seed=seed, prefix_cache=True, spec_k=0, kv_tier=kv_tier)
+
+    def prefill(self, prompt, seed: Optional[int] = None,
+                _deadline_s: Optional[float] = None) -> Optional[bytes]:
+        """Chunked-prefill `prompt`, seal its blocks, return them as one
+        encoded KV frame (None when the prompt is too short to seal a
+        single full block — the decode side just prefills it all)."""
+        prompt = [int(t) for t in prompt]
+        handle = self._engine.prefill(prompt, seed=seed,
+                                      deadline_s=_deadline_s)
+        handle.tokens(timeout=_deadline_s)   # drain: no tokens, by design
+        payload = self._engine.export_prefix(prompt)
+        if payload is None:
+            return None
+        return KVBlockCodec.encode(payload)
+
+    def prefix_summary(self) -> dict:
+        return self._engine.prefix_summary()
+
+    def stats(self) -> dict:
+        return self._engine.stats()
+
+
+@deployment(name="llm-decode", max_concurrent_queries=64)
+class DecodeLLMDeployment:
+    """Decode replica: adopts shipped prefixes, streams tokens.
+
+    ``generate`` keeps ``LLMDeployment.generate``'s exact signature
+    prefix so ``llm_stream_resume`` works unchanged; ``kv_handoff``
+    rides kwargs through a mid-stream resume and re-imports
+    idempotently on the healed replica."""
+
+    def __init__(self, model="gpt", config="nano", params=None, *,
+                 max_lanes: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: int = 32, seed: int = 0,
+                 speculative: bool = False, spec_k: Optional[int] = None,
+                 draft_proposer="ngram", kv_tier: Optional[bool] = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.inference import InferenceEngine  # jax: replica-only
+        if spec_k is None:
+            spec_k = GLOBAL_CONFIG.spec_k if speculative else 0
+        self._engine = InferenceEngine(
+            model, config, params, max_lanes=max_lanes,
+            block_size=block_size, num_blocks=num_blocks,
+            max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
+            seed=seed, prefix_cache=True, spec_k=int(spec_k),
+            draft_proposer=draft_proposer,
+            spec_adaptive=GLOBAL_CONFIG.spec_adaptive, kv_tier=kv_tier)
+
+    def _adopt(self, kv_handoff) -> None:
+        if kv_handoff is None:
+            return
+        payload = KVBlockCodec.try_decode(kv_handoff)
+        if payload is None:
+            return                       # bad frame == cache miss
+        self._engine.import_prefix(payload)
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: Optional[int] = None, _produced_offset: int = 0,
+                 _deadline_s: Optional[float] = None, kv_handoff=None):
+        self._adopt(kv_handoff)
+        handle = self._engine.submit(prompt, max_new_tokens,
+                                     temperature=temperature,
+                                     eos_id=eos_id, seed=seed,
+                                     sample_offset=_produced_offset,
+                                     deadline_s=_deadline_s)
+        try:
+            for tok in handle:
+                yield int(tok)
+        finally:
+            handle.cancel()
+
+    def __call__(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: Optional[int] = None,
+                 _deadline_s: Optional[float] = None,
+                 kv_handoff=None) -> List[int]:
+        self._adopt(kv_handoff)
+        handle = self._engine.submit(prompt, max_new_tokens,
+                                     temperature=temperature,
+                                     eos_id=eos_id, seed=seed)
+        return handle.tokens(timeout=_deadline_s)
+
+    def prefix_summary(self) -> dict:
+        return self._engine.prefix_summary()
+
+    def stats(self) -> dict:
+        return self._engine.stats()
+
+
+class DisaggLLMHandle:
+    """Front for a prefill deployment + a decode deployment.
+
+    ``stream()`` is the disaggregated analogue of
+    ``handle.options("generate", failover=llm_stream_resume).stream()``:
+    prefill hop, KV frame handoff, then a failover-protected decode
+    stream.  The handoff is best-effort by contract — any prefill-side
+    failure degrades to a decode-side re-prefill.
+
+    ``prefill_retry=False`` turns OFF the prefill hop's replica-death
+    retry so a dying prefill replica exercises the degradation path
+    instead of healing transparently (chaos gates use this)."""
+
+    def __init__(self, prefill_handle, decode_handle, *,
+                 prefill_retry: bool = True,
+                 prefill_timeout_s: float = 60.0):
+        self._prefill = prefill_handle
+        self._decode = decode_handle
+        self._prefill_retry = prefill_retry
+        self._prefill_timeout_s = prefill_timeout_s
+
+    def _prefill_frame(self, prompt, seed) -> Optional[bytes]:
+        tok = spans.begin("kv", "handoff", tokens=len(prompt))
+        try:
+            if self._prefill_retry:
+                frame = self._prefill.prefill.remote(
+                    prompt, seed=seed).result(
+                        timeout=self._prefill_timeout_s)
+            else:
+                tr = self._prefill._call("prefill", (prompt,),
+                                         {"seed": seed})
+                try:
+                    frame = ray_tpu.get(tr.ref,
+                                        timeout=self._prefill_timeout_s)
+                finally:
+                    tr._handle._done(tr._idx)
+        except BaseException as e:
+            # Lost handoff: record it, heal the prefill replica set for
+            # the NEXT request, and let decode re-prefill this one.
+            events.record("kv", "handoff_lost",
+                          error=type(e).__name__, tokens=len(prompt))
+            spans.end(tok, ok=False)
+            try:
+                self._prefill._on_replica_error()
+            except Exception:
+                pass
+            return None
+        spans.end(tok, ok=True, frame_bytes=len(frame) if frame else 0)
+        return frame
+
+    def stream(self, prompt, max_new_tokens: int = 16, *,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               seed: Optional[int] = None):
+        """Yield token ids: prefill→handoff→decode, failover-protected."""
+        prompt = [int(t) for t in prompt]
+        frame = self._prefill_frame(prompt, seed)
+        kwargs = dict(temperature=temperature, eos_id=eos_id, seed=seed)
+        if frame is not None:
+            kwargs["kv_handoff"] = frame
+        stream = self._decode.options(
+            "generate", failover=llm_stream_resume).stream(
+                prompt, max_new_tokens, **kwargs)
+        for tok in stream:
+            yield int(tok)
+
+    def generate(self, prompt, max_new_tokens: int = 16, *,
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 seed: Optional[int] = None) -> List[int]:
+        """Non-streaming convenience: drain stream() into a list."""
+        return list(self.stream(prompt, max_new_tokens,
+                                temperature=temperature, eos_id=eos_id,
+                                seed=seed))
+
+    def stats(self) -> dict:
+        """Merged prefill/decode replica stats (first replica of each)."""
+        out = {}
+        for role, handle in (("prefill", self._prefill),
+                             ("decode", self._decode)):
+            try:
+                out[role] = handle.stats.remote().result(timeout=30)
+            except Exception:
+                out[role] = None
+        return out
+
+
+def run_disaggregated(model="gpt", config="nano", *,
+                      prefill_replicas: int = 1, decode_replicas: int = 1,
+                      name: str = "llm", prefill_retry: bool = True,
+                      **engine_kw) -> DisaggLLMHandle:
+    """Deploy a prefill gang + a decode gang and return the front.
+
+    `engine_kw` flows to both deployments; the speculative knobs
+    (`speculative`, `spec_k`, `draft_proposer`) only reach the decode
+    side — prefill replicas never speculate.  The prefill deployment is
+    deployed first (deterministic worker-spawn ordinals for chaos)."""
+    spec_keys = ("speculative", "spec_k", "draft_proposer")
+    prefill_kw = {k: v for k, v in engine_kw.items() if k not in spec_keys}
+    prefill_h = serve_run(
+        PrefillLLMDeployment.options(
+            name=f"{name}-prefill", num_replicas=prefill_replicas).bind(
+                model=model, config=config, **prefill_kw))
+    decode_h = serve_run(
+        DecodeLLMDeployment.options(
+            name=f"{name}-decode", num_replicas=decode_replicas).bind(
+                model=model, config=config, **engine_kw))
+    return DisaggLLMHandle(prefill_h, decode_h,
+                           prefill_retry=prefill_retry)

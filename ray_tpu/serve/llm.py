@@ -80,7 +80,8 @@ class LLMDeployment:
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: int = 32, seed: int = 0,
                  prefix_cache: bool = True, speculative: bool = False,
-                 spec_k: Optional[int] = None, draft_proposer="ngram"):
+                 spec_k: Optional[int] = None, draft_proposer="ngram",
+                 kv_tier: Optional[bool] = None):
         from ray_tpu._private.config import GLOBAL_CONFIG
         from ray_tpu.inference import InferenceEngine  # jax: replica-only
         # `speculative=True` opts the replica into speculative decoding;
@@ -94,7 +95,8 @@ class LLMDeployment:
             max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
             seed=seed, prefix_cache=prefix_cache,
             spec_k=int(spec_k), draft_proposer=draft_proposer,
-            spec_adaptive=GLOBAL_CONFIG.spec_adaptive)
+            spec_adaptive=GLOBAL_CONFIG.spec_adaptive,
+            kv_tier=kv_tier)
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
@@ -132,6 +134,13 @@ class LLMDeployment:
                                      temperature=temperature,
                                      eos_id=eos_id, seed=seed)
         return handle.tokens(timeout=_deadline_s)
+
+    def prefix_summary(self) -> dict:
+        """Compact prefix-index summary for prefix-cache-aware routing:
+        the router scrapes this periodically and scores this replica by
+        the deepest prompt hash-chain prefix it already holds.  Bounded
+        by ``serve_prefix_summary_size`` — never the full index."""
+        return self._engine.prefix_summary()
 
     def stats(self) -> dict:
         """Engine occupancy + prefix-cache + speculative-acceptance

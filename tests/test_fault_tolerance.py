@@ -599,6 +599,81 @@ def test_overload_sheds_and_recovers(serve_chaos_cluster):
 
 
 # ---------------------------------------------------------------------------
+# Scenario 6b: disaggregated prefill/decode under chaos (serve/kv_tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "serve_chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 61,
+      # Scripted: the PREFILL replica (controller is worker spawn 1,
+      # the prefill gang deploys first = worker 2, decode = worker 3)
+      # dies at its 0th serve event — the prefill dispatch itself, i.e.
+      # mid-KV-handoff.  prefix routing stays OFF so no scrape calls
+      # shift the serve-event ordinals.
+      "chaos_kill_replica_salts": "2",
+      "chaos_kill_replica_at": 0,
+      "chaos_max_faults": 1}],
+    indirect=True)
+def test_prefill_replica_killed_mid_handoff_decode_reprefills(
+        serve_chaos_cluster):
+    """ISSUE acceptance criterion: killing the prefill replica mid-KV-
+    handoff degrades to a decode-side re-prefill — the stream completes
+    token-exact with an unfaulted monolithic run, and the lost handoff
+    is recorded on the kv event plane."""
+    from ray_tpu import serve
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.util import events
+
+    prompt, budget = list(range(1, 21)), 8
+    expected = InferenceEngine("gpt", "nano", seed=0).generate(
+        prompt, budget)
+
+    # prefill_retry=False: the dying prefill replica must exercise the
+    # degradation path (handoff_lost -> decode re-prefill), not a
+    # transparent serve-level retry.
+    handle = serve.run_disaggregated(
+        model="gpt", config="nano", max_lanes=4, seed=0,
+        name="llm_disagg_pchaos", prefill_retry=False)
+    got = list(handle.stream(prompt, budget))
+    assert got == expected
+    lost = events.snapshot(plane="kv", kind="handoff_lost")
+    assert lost, "prefill kill did not surface as kv/handoff_lost"
+
+
+@pytest.mark.parametrize(
+    "serve_chaos_cluster",
+    [{"chaos_enabled": True, "chaos_seed": 62,
+      # Scripted: the DECODE replica (worker spawn 3 — see above) dies
+      # at its 4th serve event: dispatch is event 0 and each token pull
+      # is one event, so the stream breaks after 3 delivered tokens.
+      # The replacement replica has a fresh (unlisted) ordinal.
+      "chaos_kill_replica_salts": "3",
+      "chaos_kill_replica_at": 4,
+      "chaos_max_faults": 1}],
+    indirect=True)
+def test_decode_replica_killed_mid_stream_heals_through_disagg(
+        serve_chaos_cluster):
+    """ISSUE acceptance criterion: killing the decode replica mid-stream
+    heals through the disaggregated path — llm_stream_resume resubmits
+    with the produced suffix (kv_handoff re-imported idempotently on the
+    healed replica) and the total stream is token-exact."""
+    from ray_tpu import serve
+    from ray_tpu.inference import InferenceEngine
+
+    prompt, budget = list(range(1, 21)), 8
+    expected = InferenceEngine("gpt", "nano", seed=0).generate(
+        prompt, budget)
+
+    handle = serve.run_disaggregated(
+        model="gpt", config="nano", max_lanes=4, seed=0,
+        name="llm_disagg_dchaos")
+    before = _metric("serve_stream_failovers")
+    got = list(handle.stream(prompt, budget))
+    assert got == expected
+    assert _metric("serve_stream_failovers") - before >= 1
+
+
+# ---------------------------------------------------------------------------
 # Scenario 7: preemption notice -> grace-window save -> resume loses at most
 # the in-flight step
 # ---------------------------------------------------------------------------

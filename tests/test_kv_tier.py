@@ -1,0 +1,346 @@
+"""Disaggregated serving / tiered KV cache tests (serve/kv_tier).
+
+Covers the three planes of the subsystem without a cluster:
+
+- KVBlockCodec wire format: bit-exact round-trips, garbage rejection.
+- KVTierCache lifecycle: seal -> spill -> restore -> adopt bit-exact,
+  LRU cascade host -> store -> dropped, counters.
+- Allocator conservation under spill pressure (free + evictable + live
+  always partitions the pool).
+- Prefill->decode handoff: export/import token-exactness (greedy and
+  seeded) vs a monolithic engine.
+- Router scoring: the `_chain_hashes` copy pinned against the cache's
+  `chain_hashes`, prefix-summary staleness fallback, and the DRAINING
+  filter in `_pick_replica`.
+
+Cluster-level chaos coverage (prefill/decode replica kills) lives in
+test_fault_tolerance.py; end-to-end perf in bench_disagg.py.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.inference import InferenceEngine
+from ray_tpu.inference.kv_cache import PagedKVCache, chain_hashes
+from ray_tpu.serve.kv_tier import KVBlockCodec, KVCodecError, KVTierCache
+
+
+# ---------------------------------------------------------------------------
+# Codec
+# ---------------------------------------------------------------------------
+
+def _fake_payload(n_blocks=3, block_size=4, layers=2, heads=2, dim=4,
+                  seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (layers, n_blocks, block_size, heads, dim)
+    return {
+        "v": 1,
+        "block_size": block_size,
+        "chain": [[int(t) for t in rng.integers(0, 100, block_size)]
+                  for _ in range(n_blocks)],
+        "k": rng.standard_normal(shape).astype(np.float32),
+        "v_pool": rng.standard_normal(shape).astype(np.float32),
+    }
+
+
+def test_codec_roundtrip_bit_exact():
+    payload = _fake_payload()
+    out = KVBlockCodec.decode(KVBlockCodec.encode(payload))
+    assert out["block_size"] == payload["block_size"]
+    assert out["chain"] == payload["chain"]
+    for key in ("k", "v_pool"):
+        assert out[key].dtype == payload[key].dtype
+        np.testing.assert_array_equal(out[key], payload[key])
+
+
+def test_codec_rejects_garbage():
+    with pytest.raises(KVCodecError, match="v1 payload"):
+        KVBlockCodec.encode({"v": 2})
+    with pytest.raises(KVCodecError, match="bytes"):
+        KVBlockCodec.decode(12345)
+    with pytest.raises(KVCodecError, match="magic"):
+        KVBlockCodec.decode(b"NOPE" + b"x" * 64)
+    blob = KVBlockCodec.encode(_fake_payload())
+    with pytest.raises(KVCodecError, match="corrupt"):
+        KVBlockCodec.decode(blob[:20])
+    bad = _fake_payload()
+    bad["chain"] = bad["chain"][:-1]          # chain/pool disagreement
+    import pickle
+    framed = b"KVT1" + pickle.dumps({**bad})
+    with pytest.raises(KVCodecError, match="shape mismatch"):
+        KVBlockCodec.decode(framed)
+    # try_decode: bad frame degrades to a miss, never an error.
+    assert KVBlockCodec.try_decode(b"garbage") is None
+    assert KVBlockCodec.try_decode(blob)["chain"] == \
+        _fake_payload()["chain"]
+
+
+# ---------------------------------------------------------------------------
+# Tier cache (no cluster: store tier backs onto spill files)
+# ---------------------------------------------------------------------------
+
+def _pair(seed, shape=(1, 4, 2, 2)):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+def test_tier_lru_cascade_and_counters(tmp_path):
+    tier = KVTierCache(host_blocks=2, store_blocks=2,
+                       spill_dir=str(tmp_path))
+    pairs = {i: _pair(i) for i in range(5)}
+    keys = [(0, (i,)) for i in range(5)]
+    for i, key in enumerate(keys):
+        tier.put(key, *pairs[i])
+    # 5 spilled, host holds the 2 newest, store the 2 demoted before
+    # them, and the oldest fell off the end.
+    assert tier.counters["kv_tier_spilled_blocks"] == 5
+    assert tier.counters["kv_tier_dropped_blocks"] == 1
+    assert len(tier) == 4
+    assert not tier.contains(keys[0])
+    # Restores are bit-exact from either tier (and consume the entry).
+    for i in (1, 2):            # store tier (via spill file)
+        k, v = tier.pop(keys[i])
+        np.testing.assert_array_equal(k, pairs[i][0])
+        np.testing.assert_array_equal(v, pairs[i][1])
+    for i in (3, 4):            # host tier
+        k, v = tier.pop(keys[i])
+        np.testing.assert_array_equal(k, pairs[i][0])
+    assert tier.counters["kv_tier_restored_blocks"] == 4
+    assert len(tier) == 0
+    assert tier.pop(keys[0]) is None          # aged out == miss
+
+
+def test_tier_put_dedup_and_discard(tmp_path):
+    tier = KVTierCache(host_blocks=4, store_blocks=4,
+                       spill_dir=str(tmp_path))
+    key = (0, (1, 2))
+    tier.put(key, *_pair(0))
+    tier.put(key, *_pair(0))                  # dedup: one entry, one count
+    assert len(tier) == 1
+    assert tier.counters["kv_tier_spilled_blocks"] == 1
+    assert tier.summary_hashes() == [hash(key)]
+    tier.discard(key)                         # re-sealed on device
+    assert len(tier) == 0
+    assert tier.counters["kv_tier_dropped_blocks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Cache-level spill/restore + conservation
+# ---------------------------------------------------------------------------
+
+def _conserved(cache):
+    a = cache.allocator
+    live = sum(1 for r in a._ref if r > 0)
+    return len(a._free) + len(a._evictable) + live == a.num_blocks
+
+
+def test_seal_spill_restore_adopt_bit_exact(tmp_path):
+    """The full SPILLED lifecycle on one engine: sealed chains evicted
+    under pressure come back from the tier and regenerate the exact
+    same tokens."""
+    eng = InferenceEngine("gpt", "nano", seed=0, auto_start=False,
+                          num_blocks=8, block_size=16)
+    tier = KVTierCache(host_blocks=4, store_blocks=8,
+                       spill_dir=str(tmp_path))
+    eng.cache.attach_tier(tier)
+
+    p1 = list(range(1, 49))
+    out1 = eng.generate(p1, 8)
+    # Two more 3-block prompts force p1's sealed blocks out of the pool.
+    eng.generate(list(range(100, 148)), 8)
+    eng.generate(list(range(200, 248)), 8)
+    assert tier.counters["kv_tier_spilled_blocks"] > 0
+    assert _conserved(eng.cache)
+
+    out1_again = eng.generate(p1, 8)
+    assert out1_again == out1
+    st = eng.stats()
+    assert st["restored_blocks"] > 0
+    assert _conserved(eng.cache)
+
+
+def test_conservation_under_spill_pressure(tmp_path):
+    """free + evictable + live partitions the pool after arbitrary
+    churn with an attached tier — restores and spills never leak or
+    double-count a block."""
+    eng = InferenceEngine("gpt", "nano", seed=0, auto_start=False,
+                          num_blocks=6, block_size=16, max_lanes=2)
+    tier = KVTierCache(host_blocks=2, store_blocks=2,
+                       spill_dir=str(tmp_path))
+    eng.cache.attach_tier(tier)
+    prompts = [list(range(s, s + 33)) for s in (1, 50, 100, 1, 50, 100)]
+    for p in prompts:
+        eng.generate(p, 4)
+        assert _conserved(eng.cache)
+    a = eng.cache.allocator
+    # Every lane is done: nothing may still be live.
+    assert sum(1 for r in a._ref if r > 0) == 0
+    assert len(a._free) + len(a._evictable) == a.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# Prefill -> decode handoff (export / codec / import)
+# ---------------------------------------------------------------------------
+
+def test_export_import_handoff_token_exact():
+    """A prefill engine's sealed chain, shipped through the codec and
+    adopted by a decode engine, yields token-exact greedy AND seeded
+    sampled output vs a monolithic engine (identical seeded weights)."""
+    prefill = InferenceEngine("gpt", "nano", seed=0, auto_start=False)
+    prompt = list(range(1, 49))               # (48-1)//16 = 2 sealed blocks
+
+    h = prefill.prefill(prompt)
+    assert h.tokens() == []                   # prefill_only: no tokens
+    payload = prefill.export_prefix(prompt)
+    assert payload is not None and len(payload["chain"]) == 2
+    blob = KVBlockCodec.encode(payload)
+
+    for temp, seed in ((0.0, None), (0.8, 7)):
+        decode = InferenceEngine("gpt", "nano", seed=0, auto_start=False)
+        mono = InferenceEngine("gpt", "nano", seed=0, auto_start=False)
+        installed = decode.import_prefix(KVBlockCodec.decode(blob))
+        assert installed == 2
+        # Idempotent: a failover re-import is a no-op.
+        assert decode.import_prefix(KVBlockCodec.decode(blob)) == 0
+        got = decode.generate(prompt, 12, temperature=temp, seed=seed)
+        ref = mono.generate(prompt, 12, temperature=temp, seed=seed)
+        assert got == ref
+        assert decode.stats()["imported_blocks"] == 2
+        assert decode.stats()["prefix_hit_tokens"] >= 32
+
+
+def test_install_prefix_refuses_foreign_shape():
+    eng = InferenceEngine("gpt", "nano", seed=0, auto_start=False)
+    bad = _fake_payload(block_size=16)        # nano: wrong heads/dims
+    assert eng.import_prefix(bad) == 0
+    bad2 = _fake_payload()                    # wrong block size too
+    assert eng.import_prefix(bad2) == 0
+    assert eng.stats()["imported_blocks"] == 0
+
+
+def test_prefix_summary_bounded_and_tiered(tmp_path):
+    eng = InferenceEngine("gpt", "nano", seed=0, auto_start=False,
+                          num_blocks=8, block_size=16)
+    tier = KVTierCache(host_blocks=8, store_blocks=8,
+                       spill_dir=str(tmp_path))
+    eng.cache.attach_tier(tier)
+    for s in (1, 50, 100):
+        eng.generate(list(range(s, s + 48)), 8)
+    summ = eng.prefix_summary(limit=4)
+    assert summ["v"] == 1 and summ["block_size"] == 16
+    assert len(summ["hashes"]) <= 4           # bounded, newest last
+    full = eng.prefix_summary(limit=256)
+    # Spilled chains stay visible to the router via the tier.
+    assert len(full["hashes"]) >= summ["indexed_blocks"]
+
+
+# ---------------------------------------------------------------------------
+# Router scoring (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_router_chain_hashes_pinned_to_cache():
+    """serve._private._chain_hashes is a jax-free copy of
+    kv_cache.chain_hashes — the router scores replicas correctly only
+    while the two stay identical."""
+    from ray_tpu.serve._private import _chain_hashes
+    rng = np.random.default_rng(3)
+    for bs in (1, 4, 16):
+        for n in (0, 1, bs, bs + 1, 5 * bs, 5 * bs + 3):
+            tokens = [int(t) for t in rng.integers(0, 512, n)]
+            assert _chain_hashes(tokens, bs) == chain_hashes(tokens, bs)
+            assert len(chain_hashes(tokens, bs)) == max(0, (n - 1) // bs)
+
+
+class _FakeActorId:
+    def __init__(self, b):
+        self._b = b
+
+    def binary(self):
+        return self._b
+
+
+class _FakeReplica:
+    def __init__(self, b):
+        self._actor_id = _FakeActorId(b)
+
+
+def _handle(name, replicas, states=None):
+    from ray_tpu.serve import _private as sp
+    h = sp.DeploymentHandle(name)
+    st = h._state
+    st.replicas = [_FakeReplica(b) for b in replicas]
+    st.max_q = 4
+    st.states = dict(states or {})
+    return h, st
+
+
+@pytest.fixture
+def _clean_router_states():
+    yield
+    from ray_tpu.serve import _private as sp
+    with sp._router_states_lock:
+        sp._router_states.clear()
+
+
+def test_pick_replica_filters_draining(_clean_router_states):
+    """The _pick_replica DRAINING fix: drained replicas never attract
+    new traffic, even when idle (the old sampler only noticed them at
+    the in-flight probe)."""
+    from ray_tpu.serve._private import REPLICA_DRAINING, REPLICA_RUNNING
+    h, st = _handle("kvt_drain", [b"a", b"b"],
+                    {b"a": REPLICA_RUNNING, b"b": REPLICA_DRAINING})
+    for _ in range(20):
+        replica, key = h._pick_replica()
+        assert key == b"a"
+        h._done(key)
+    # All-DRAINING (stale/partial table) must not brick routing.
+    st.states = {b"a": REPLICA_DRAINING, b"b": REPLICA_DRAINING}
+    assert h._pick_replica() is not None
+
+
+def test_pick_replica_prefers_deepest_prefix(_clean_router_states):
+    """`prefer` stable-sorts deepest-cached-prefix first; p2c order is
+    exactly the tie-break."""
+    h, st = _handle("kvt_prefer", [b"a", b"b", b"c"])
+    picks = set()
+    for _ in range(10):
+        replica, key = h._pick_replica({b"b": 3, b"c": 1})
+        picks.add(key)
+        h._done(key)
+    assert picks == {b"b"}
+    # Saturate the preferred replica: the next-best candidate wins.
+    st.in_flight[b"b"] = st.max_q
+    replica, key = h._pick_replica({b"b": 3, b"c": 1})
+    assert key == b"c"
+
+
+def test_prefix_order_staleness_fallback(monkeypatch,
+                                         _clean_router_states):
+    """Summaries older than serve_prefix_staleness_s never score: a
+    dead/redeployed replica's stale summary cannot attract traffic, and
+    with no fresh summaries the router falls back to pure p2c (None)."""
+    import time as _time
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    monkeypatch.setenv("RAY_TPU_SERVE_PREFIX_ROUTING", "1")
+    monkeypatch.setenv("RAY_TPU_SERVE_PREFIX_STALENESS_S", "5.0")
+    GLOBAL_CONFIG.invalidate_cache()
+    try:
+        h, st = _handle("kvt_stale", [b"a", b"b"])
+        prompt = list(range(1, 49))
+        hashes = set(chain_hashes(prompt, 16))
+        now = _time.monotonic()
+        st.prefix = {
+            b"a": {"hashes": hashes, "block_size": 16, "ts": now},
+            b"b": {"hashes": hashes, "block_size": 16, "ts": now - 60},
+        }
+        scores = h._prefix_order((prompt,), {})
+        assert scores == {b"a": 2}            # stale b never scores
+        # Every summary stale -> None -> pure p2c fallback.
+        st.prefix[b"a"]["ts"] = now - 60
+        assert h._prefix_order((prompt,), {}) is None
+        # Non-token prompts never score (text requests use p2c).
+        assert h._prefix_order(("hello",), {}) is None
+        assert h._prefix_order((), {}) is None
+    finally:
+        GLOBAL_CONFIG.invalidate_cache()

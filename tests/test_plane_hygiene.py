@@ -159,6 +159,29 @@ def test_pp_compute_spans_are_chunk_tagged():
             f"pp/{kind} span lost its chunk= tag"
 
 
+def test_kv_plane_kinds_present():
+    """The disaggregated-serving plane (serve/kv_tier) is attributable
+    only through these kinds: scale_attrib's serve mode carves request
+    wall into route/prefill/kv_xfer/decode via the spans, and the chaos
+    gates + bench key on the tier/handoff instants.  Pin them so
+    refactors cannot silently blind the tooling."""
+    sites = {(pl, k) for _, _, pl, k in _call_sites()}
+    required_spans = {
+        ("kv", "export"),        # engine: gather sealed chain for handoff
+        ("kv", "import"),        # engine: adopt a shipped chain
+        ("kv", "handoff"),       # handle: prefill hop + frame transfer
+    }
+    required_instants = {
+        ("kv", "spilled"),       # tier: block left the device pool
+        ("kv", "restored"),      # tier: spilled block rejoined the pool
+        ("kv", "dropped"),       # tier: block fell off the last tier
+        ("kv", "handoff_lost"),  # handle: prefill died, decode re-prefills
+        ("serve", "prefix_route"),  # router: prefix affinity won a pick
+    }
+    missing = (required_spans | required_instants) - sites
+    assert not missing, f"kv plane kinds vanished: {missing}"
+
+
 def test_gcs_ft_event_kinds_present():
     """The head-survival plane (PR 16) is observable only through these
     instants: the availability bench and the chaos gates key on the
