@@ -1,0 +1,38 @@
+"""Async actor/learner RL (Podracer) on CartPole: a rollout gang runs
+ahead of a stale-tolerant V-trace learner, weights publish in place
+through the object plane every update."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu
+from ray_tpu.rl import PodracerConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    algo = (PodracerConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                      rollout_fragment_length=32)
+            .training(staleness_bound=2, publish_interval=1,
+                      min_updates_per_step=2, lr=1e-3)
+            .debugging(seed=0)
+            .build())
+    try:
+        for _ in range(15):
+            r = algo.train()
+            print(f"iter {r['training_iteration']}: "
+                  f"reward_mean={r['episode_reward_mean']:.1f} "
+                  f"version={r['policy_version']} "
+                  f"updates={r['learner_updates_total']} "
+                  f"staleness={r.get('learner/staleness', 0.0):.0f} "
+                  f"dropped={r['queue']['stale_dropped']}")
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -151,6 +151,10 @@ class _Request:
     # the draft tokens riding the in-flight verify dispatch.
     spec_k: int = 0
     draft: tuple = ()
+    # Per-token behavior log-probs (capture_logp engines only), parallel
+    # to `emitted` — the RL rollout path needs the sampling
+    # distribution's log-prob of every committed token for V-trace.
+    logps: List[float] = field(default_factory=list)
     # Disaggregated prefill: run chunked prefill + seal the prompt's
     # blocks, then finish WITHOUT sampling — the sealed chain is the
     # product (export_prefix ships it to a decode engine).
@@ -234,6 +238,13 @@ class GenerationHandle:
     def finish_reason(self) -> Optional[str]:
         return self._req.finish_reason
 
+    @property
+    def logps(self) -> List[float]:
+        """Behavior log-probs of the committed tokens (parallel to the
+        emitted stream).  Empty unless the engine was built with
+        ``capture_logp=True``."""
+        return list(self._req.logps)
+
 
 def _resolve_model(model):
     if isinstance(model, str):
@@ -274,7 +285,8 @@ class InferenceEngine:
                  prefix_cache: bool = True, auto_start: bool = True,
                  spec_k: int = 0, draft_proposer="ngram",
                  spec_adaptive: bool = True,
-                 kv_tier: Optional[bool] = None):
+                 kv_tier: Optional[bool] = None,
+                 capture_logp: bool = False):
         self.model = _resolve_model(model)
         self.config = (self.model.CONFIGS[config] if isinstance(config, str)
                        else config)
@@ -310,6 +322,11 @@ class InferenceEngine:
             self._proposer = None
         self._spec_stats = {"drafted": 0, "accepted": 0, "emitted": 0,
                             "steps": 0, "bursts": 0}
+        # RL rollout support: per-token behavior log-prob capture (the
+        # step fns grow one [B(,T)] float32 output) and a policy version
+        # stamp advanced by update_params().
+        self._capture_logp = bool(capture_logp)
+        self.policy_version = 0
         self._lanes: List[Optional[_Request]] = [None] * max_lanes
         self._waiting: "collections.deque[_Request]" = collections.deque()
         self._rid = itertools.count(1)
@@ -383,6 +400,26 @@ class InferenceEngine:
             while self.step():
                 pass
         return h.tokens()
+
+    def update_params(self, params, version: Optional[int] = None) -> int:
+        """Swap the model weights IN PLACE between scheduler steps.
+
+        The jitted step reads ``self.params`` afresh at every dispatch,
+        so the swap is a boundary between steps: in-flight lanes keep
+        their KV state and continue generating under the NEW weights at
+        the next dispatch — no lane is dropped, no request restarted.
+        (The actor/learner RL path publishes learner weights through
+        here at version boundaries.)  Returns the new policy version
+        (``version`` when given, else the previous version + 1)."""
+        with self._work:
+            self.params = params
+            self.policy_version = (int(version) if version is not None
+                                   else self.policy_version + 1)
+            events.record("engine", "weights_swap",
+                          version=self.policy_version,
+                          live_lanes=self.num_active)
+            self._work.notify()
+            return self.policy_version
 
     # -------- disaggregated prefill/decode (serve/kv_tier) --------
 
@@ -528,6 +565,7 @@ class InferenceEngine:
             "restored_blocks": cs["restored_blocks"],
             **(self.cache.tier.counters if self.cache.tier is not None
                else {}),
+            "policy_version": self.policy_version,
             "spec_k": self.spec_k,
             "spec_drafted_tokens": st["drafted"],
             "spec_accepted_tokens": st["accepted"],
@@ -677,18 +715,22 @@ class InferenceEngine:
         done = []
         for spec, lanes, batch, chunks in plans:
             vtok = spans.begin("engine", "spec_verify") if spec else None
-            next_tok = self._run_step(batch, spec)
+            next_tok, lps = self._run_step(batch, spec)
             toks = np.asarray(next_tok)
             if toks.ndim == 1:      # plain/prefill: one token per lane
                 toks = toks[:, None]
+            if lps is not None:
+                lps = np.asarray(lps)
+                if lps.ndim == 1:
+                    lps = lps[:, None]
             spans.end(vtok, lanes=len(lanes))
             if spec:
                 self._spec_stats["steps"] += 1
                 _metrics()["spec_steps"].inc()
-            done.append((lanes, chunks, toks))
+            done.append((lanes, chunks, toks, lps))
         with self._work:
-            for lanes, chunks, toks in done:
-                self._commit(lanes, chunks, toks)
+            for lanes, chunks, toks, lps in done:
+                self._commit(lanes, chunks, toks, lps)
             self._work.notify()
         return True
 
@@ -742,12 +784,30 @@ class InferenceEngine:
         fn = self._step_fns.get(key)
         if fn is None:
             fn = self._step_fns[key] = self._make_step_fn(sample, spec)
-        next_tok, k, v = fn(self.params, self.cache.k, self.cache.v, *args)
+        if self._capture_logp:
+            next_tok, logp, k, v = fn(self.params, self.cache.k,
+                                      self.cache.v, *args)
+        else:
+            next_tok, k, v = fn(self.params, self.cache.k, self.cache.v,
+                                *args)
+            logp = None
         self.cache.update_pools(k, v)
-        return next_tok
+        return next_tok, logp
 
     def _make_step_fn(self, sample: bool, spec: bool = False):
         model, config = self.model, self.config
+        capture = self._capture_logp
+
+        def _logp_at(logits, out, temps_b):
+            # Behavior log-prob of the chosen token under the ACTUAL
+            # sampling distribution — softmax(logits/temp) when temp > 0,
+            # plain softmax for greedy lanes (argmax is deterministic;
+            # its soft log-prob is still the importance-weighting anchor
+            # the V-trace learner corrects against).
+            z = logits.astype(jnp.float32)
+            lp = jax.nn.log_softmax(
+                jnp.where(temps_b > 0, z / jnp.maximum(temps_b, 1e-6), z))
+            return jnp.take_along_axis(lp, out[..., None], axis=-1)[..., 0]
 
         def step(params, k, v, tokens, positions, valid, tables, ctx_lens,
                  gather, temps, seeds, counters):
@@ -765,23 +825,28 @@ class InferenceEngine:
                 logits = model.lm_head(params, x, config)    # [B, T, V]
                 greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 if not sample:
-                    return greedy, k, v
-                offs = jnp.arange(logits.shape[1], dtype=jnp.int32)
+                    out = greedy
+                else:
+                    offs = jnp.arange(logits.shape[1], dtype=jnp.int32)
 
-                def draw_lane(rows, temp, seed, counter):
-                    def draw_pos(row, off):
-                        key = jax.random.fold_in(jax.random.key(seed),
-                                                 counter + off)
-                        z = row.astype(jnp.float32) / jnp.maximum(temp,
-                                                                  1e-6)
-                        return jax.random.categorical(key, z).astype(
-                            jnp.int32)
+                    def draw_lane(rows, temp, seed, counter):
+                        def draw_pos(row, off):
+                            key = jax.random.fold_in(jax.random.key(seed),
+                                                     counter + off)
+                            z = row.astype(jnp.float32) / jnp.maximum(temp,
+                                                                      1e-6)
+                            return jax.random.categorical(key, z).astype(
+                                jnp.int32)
 
-                    return jax.vmap(draw_pos)(rows, offs)
+                        return jax.vmap(draw_pos)(rows, offs)
 
-                sampled = jax.vmap(draw_lane)(logits, temps, seeds,
-                                              counters)
-                return jnp.where(temps[:, None] > 0, sampled, greedy), k, v
+                    sampled = jax.vmap(draw_lane)(logits, temps, seeds,
+                                                  counters)
+                    out = jnp.where(temps[:, None] > 0, sampled, greedy)
+                if capture:
+                    return out, _logp_at(logits, out,
+                                         temps[:, None, None]), k, v
+                return out, k, v
             # Only each lane's last valid position reaches the lm head —
             # a prefill chunk never materializes [B, T, V], and the
             # logits never leave the device: sampling happens HERE and
@@ -791,6 +856,9 @@ class InferenceEngine:
             logits = model.lm_head(params, xg, config)       # [B, V]
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if not sample:
+                if capture:
+                    return greedy, _logp_at(logits, greedy,
+                                            temps[:, None]), k, v
                 return greedy, k, v
 
             def draw(row, temp, seed, counter):
@@ -803,6 +871,9 @@ class InferenceEngine:
 
             sampled = jax.vmap(draw)(logits, temps, seeds, counters)
             next_tok = jnp.where(temps > 0, sampled, greedy)
+            if capture:
+                return next_tok, _logp_at(logits, next_tok,
+                                          temps[:, None]), k, v
             return next_tok, k, v
 
         self._step_impls[(sample, "spec") if spec else sample] = step
@@ -811,7 +882,7 @@ class InferenceEngine:
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def _commit(self, live, chunks, toks):
+    def _commit(self, live, chunks, toks, lps=None):
         """Apply one dispatch's results: advance prefill cursors, seal
         newly-full blocks into the prefix index, stream sampled tokens
         (a multi-token speculative burst commits ATOMICALLY — one queue
@@ -902,6 +973,10 @@ class InferenceEngine:
             req.last_emit = now
             req.last_token = emit[-1]
             req.emitted.extend(emit)
+            if lps is not None:
+                # lps rows are position-parallel with toks rows, so the
+                # clamped emit prefix maps 1:1 onto the first m entries.
+                req.logps.extend(float(lps[lane, j]) for j in range(m))
             req.produced += m
             if self._proposer is not None and not was_prefill:
                 self._spec_stats["emitted"] += m

@@ -1,0 +1,38 @@
+"""Podracer-style split actor/learner RL substrate.
+
+Three composable parts (Podracer / RLAX lineage, see PAPERS.md):
+
+- Rollout gangs (`rollout.py`): actors that generate versioned,
+  SampleBatch-compatible trajectories — either through the serving
+  `InferenceEngine` (continuous batching + prefix cache + speculative
+  decoding as a rollout-throughput multiplier) or through the classic
+  vectorized-env `RolloutWorker`.
+- In-place weight publication (`weights.py`): the learner's weights go
+  through the object plane ONCE per version boundary and every rollout
+  actor adopts the reference; engine actors swap weights between
+  scheduler steps without dropping in-flight lanes.
+- A stale-tolerant V-trace learner (`learner.py`) consuming stale-by-≤k
+  trajectories from a bounded `TrajectoryQueue` (`trajectory.py`), with
+  COMMITTED checkpoints through `CheckpointManager`.
+
+`controller.py` wires them into the async actor/learner loop
+(`PodracerConfig().build()` — same driver surface as `rllib`
+algorithms).  Everything records on the `rl` event plane so
+`scale_attrib.py rl` can attribute rollout vs publish vs learn wall.
+"""
+
+from ray_tpu.rl.controller import Podracer, PodracerConfig
+from ray_tpu.rl.learner import StaleTolerantLearner
+from ray_tpu.rl.rollout import EngineRolloutActor, EnvRolloutActor
+from ray_tpu.rl.trajectory import TrajectoryQueue
+from ray_tpu.rl.weights import WeightPublisher
+
+__all__ = [
+    "EngineRolloutActor",
+    "EnvRolloutActor",
+    "Podracer",
+    "PodracerConfig",
+    "StaleTolerantLearner",
+    "TrajectoryQueue",
+    "WeightPublisher",
+]

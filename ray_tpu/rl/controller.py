@@ -1,0 +1,255 @@
+"""Podracer: the async actor/learner control loop.
+
+Composition of the substrate (Podracer/Sebulba shape, PAPERS.md): a
+gang of versioned rollout actors runs ahead asynchronously; delivered
+fragments enter the bounded `TrajectoryQueue` (stale-by->k batches are
+dropped at the door, a full queue backpressures the producer instead of
+growing a staleness ramp); the stale-tolerant V-trace learner drains
+whatever is admissible; and every `publish_interval` updates the new
+weights cross the object plane ONCE and the gang adopts by reference —
+engine-backed actors swap between scheduler steps without dropping
+in-flight lanes.
+
+Fault tolerance is part of the loop, not a wrapper: a dead rollout
+worker is detected at delivery, replaced, and re-adopts the CURRENT
+published weights (`rl/worker_replaced`); a dead learner is rebuilt
+from the newest COMMITTED checkpoint (`recover_learner()` ->
+`rl/learner_resume`) and the queue — which the controller owns, not the
+learner — survives with its entries re-screened against the restored
+version, so resume never trains on trajectories from beyond its
+horizon.
+
+Driver surface matches `rllib`: `PodracerConfig().environment(...)
+.training(...).build()`, then `.train()` per iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import ray_tpu
+from ray_tpu.rl.learner import StaleTolerantLearner
+from ray_tpu.rl.rollout import EnvRolloutActor
+from ray_tpu.rl.trajectory import TrajectoryQueue
+from ray_tpu.rl.weights import WeightPublisher
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.worker_set import WorkerSet
+from ray_tpu.util import events
+from ray_tpu.util.metrics import Counter
+
+_MET = None
+
+
+def _metrics() -> dict:
+    global _MET
+    if _MET is None:
+        _MET = {
+            "replaced": Counter(
+                "rl_workers_replaced",
+                "Rollout workers replaced after death (re-formed + "
+                "re-adopted the current weights)"),
+        }
+    return _MET
+
+
+class PodracerConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=Podracer)
+        self.lr = 6e-4
+        self.grad_clip = 40.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho_threshold = 1.0
+        self.clip_c_threshold = 1.0
+        # Async-loop knobs: k=0 forces on-policy (every batch must be at
+        # the learner's version — the PPO-parity configuration).
+        self.staleness_bound = 1
+        self.queue_capacity = 8
+        self.publish_interval = 1     # learner updates between publishes
+        self.min_updates_per_step = 1
+        # Durability: ckpt_dir=None disables checkpointing.
+        self.ckpt_dir = None
+        self.ckpt_interval = 20
+
+
+class Podracer(Algorithm):
+    def setup(self) -> None:
+        cfg = self.config
+        self.workers = WorkerSet(
+            num_workers=max(cfg.num_rollout_workers, 1),
+            num_cpus_per_worker=cfg.num_cpus_per_worker,
+            worker_cls=EnvRolloutActor,
+            worker_kwargs=dict(
+                env=cfg.env, num_envs=cfg.num_envs_per_worker,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                gamma=cfg.gamma, lam=cfg.lambda_,
+                hidden=cfg.model_hidden, seed=cfg.seed))
+        self.learner = self._make_learner()
+        self.queue = TrajectoryQueue(cfg.queue_capacity,
+                                     cfg.staleness_bound)
+        self.publisher = WeightPublisher()
+        self.publisher.publish(self.learner.get_weights(),
+                               self.workers.remote_workers,
+                               version=self.learner.version)
+        self._inflight: Dict[Any, Any] = {}   # sample ref -> worker
+        self._idle: List[Any] = []            # backpressured workers
+
+    def _make_learner(self) -> StaleTolerantLearner:
+        cfg = self.config
+        return StaleTolerantLearner(
+            self.obs_dim, self.num_actions, hidden=cfg.model_hidden,
+            gamma=cfg.gamma, lr=cfg.lr, grad_clip=cfg.grad_clip,
+            vf_loss_coeff=cfg.vf_loss_coeff,
+            entropy_coeff=cfg.entropy_coeff,
+            clip_rho_threshold=cfg.clip_rho_threshold,
+            clip_c_threshold=cfg.clip_c_threshold, seed=cfg.seed,
+            ckpt_dir=cfg.ckpt_dir, ckpt_interval=cfg.ckpt_interval)
+
+    # -- gang management ---------------------------------------------------
+    def _launch(self, worker) -> None:
+        self._inflight[worker.sample_versioned.remote()] = worker
+
+    def _launch_all_idle(self) -> None:
+        # Backpressured workers restart only once the queue has room.
+        while self._idle and not self.queue.full:
+            self._launch(self._idle.pop())
+        busy = set(map(id, self._inflight.values()))
+        busy |= set(map(id, self._idle))
+        for w in self.workers.remote_workers:
+            if id(w) not in busy:
+                self._launch(w)
+
+    def _replace(self, worker) -> None:
+        replacement = self.workers.replace_worker(worker)
+        _metrics()["replaced"].inc()
+        events.record("rl", "worker_replaced",
+                      version=self.publisher.version)
+        try:
+            # Re-formed worker re-adopts the CURRENT published weights —
+            # no new put, the reference is still live in the object plane.
+            self.publisher.re_adopt(replacement)
+        except Exception:
+            pass  # surfaces at its next delivery if it is truly gone
+        self._launch(replacement)
+
+    def _publish_boundary(self) -> None:
+        version, weights = self.learner.publish_boundary()
+        # wait=False: adoption lands per-actor behind whatever fragment
+        # is in flight (the version boundary IS the fragment boundary);
+        # blocking the driver here would serialize publish behind the
+        # slowest rollout.
+        self.publisher.publish(weights, self.workers.remote_workers,
+                               version=version, wait=False)
+
+    def _drain_learner(self) -> int:
+        cfg = self.config
+        updates = 0
+        while True:
+            item = self.queue.get(self.learner.version, timeout=0.0)
+            if item is None:
+                return updates
+            batch, bversion = item
+            self._last_learner_metrics = self.learner.update(batch,
+                                                             bversion)
+            updates += 1
+            if self.learner.num_updates % cfg.publish_interval == 0:
+                self._publish_boundary()
+
+    def _process_deliveries(self, block: bool) -> tuple:
+        """Harvest completed sample refs: queue the batches (or hold the
+        worker under backpressure) and replace workers whose refs
+        surface a death.  block=False sweeps everything already done
+        without waiting — the end-of-step pass that keeps dead-worker
+        detection latency at one iteration even when the learner's
+        update quota was met early."""
+        if not self._inflight:
+            return 0, 0
+        refs = list(self._inflight)
+        ready, _ = ray_tpu.wait(
+            refs, num_returns=1 if block else len(refs),
+            timeout=10.0 if block else 0.0)
+        fragments = 0
+        episodes = 0
+        for ref in ready:
+            worker = self._inflight.pop(ref)
+            try:
+                batch, bversion, metrics = ray_tpu.get(ref)
+            except Exception:
+                self._replace(worker)
+                continue
+            episodes += self._record_metrics([metrics])
+            fragments += 1
+            accepted = self.queue.put(batch, bversion,
+                                      self.learner.version)
+            if accepted or bversion < self.learner.version:
+                # Delivered (or too stale to queue — either way the
+                # worker should go sample under fresher weights).
+                self._launch(worker)
+            else:
+                self._idle.append(worker)   # backpressure
+        return fragments, episodes
+
+    # -- training ----------------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        updates_before = self.learner.num_updates
+        fragments = 0
+        episodes = 0
+        self._last_learner_metrics = getattr(self, "_last_learner_metrics",
+                                             {})
+        while (self.learner.num_updates - updates_before
+               < cfg.min_updates_per_step):
+            self._drain_learner()
+            self._launch_all_idle()
+            if (self.learner.num_updates - updates_before
+                    >= cfg.min_updates_per_step):
+                break
+            if not self._inflight:
+                continue   # everything backpressured: drain again
+            f, e = self._process_deliveries(block=True)
+            fragments += f
+            episodes += e
+        f, e = self._process_deliveries(block=False)
+        fragments += f
+        episodes += e
+        self._launch_all_idle()
+        self.workers.local_worker.set_weights(self.learner.get_weights())
+        return {"fragments_this_iter": fragments,
+                "episodes_this_iter": episodes,
+                "learner_updates_total": self.learner.num_updates,
+                "policy_version": self.learner.version,
+                "queue": self.queue.stats(),
+                **{f"learner/{k}": v
+                   for k, v in self._last_learner_metrics.items()}}
+
+    # -- fault tolerance ---------------------------------------------------
+    def recover_learner(self):
+        """The killed-learner path: throw away the in-memory learner,
+        rebuild from the newest COMMITTED checkpoint (fresh optimizer +
+        step 0 when none exists), re-screen the surviving queue against
+        the restored version, and republish so the gang converges onto
+        the restored weights.  Returns the restored update count (None
+        for a from-scratch rebuild)."""
+        self.learner = self._make_learner()
+        restored = self.learner.restore_latest()
+        self.queue.evict_stale(self.learner.version)
+        self.publisher.publish(self.learner.get_weights(),
+                               self.workers.remote_workers,
+                               version=self.learner.version, wait=False)
+        return restored
+
+    # -- persistence -------------------------------------------------------
+    def save_to_dict(self) -> Dict[str, Any]:
+        return {"learner_state": self.learner.state_tree(),
+                "config": self.config.to_dict()}
+
+    def restore_from_dict(self, state: Dict[str, Any]) -> None:
+        import numpy as np
+        tree = state["learner_state"]
+        self.learner._core.set_state({"params": tree["params"],
+                                      "opt_state": tree["opt_state"]})
+        self.learner.version = int(np.asarray(tree["version"]))
+        self.learner.num_updates = int(np.asarray(tree["num_updates"]))
+        self.publisher.publish(self.learner.get_weights(),
+                               self.workers.remote_workers,
+                               version=self.learner.version)

@@ -1,0 +1,130 @@
+"""Stale-tolerant V-trace learner with COMMITTED checkpoints.
+
+Wraps the jitted `rllib` V-trace SGD core (`impala._VTraceLearner`, the
+`rllib/vtrace.py` importance correction) with the three things the
+async actor/learner loop needs on top of plain IMPALA:
+
+- an explicit POLICY VERSION that advances only at publish boundaries
+  (`publish_boundary()` — the controller puts the returned weights
+  through the `WeightPublisher`), so trajectory staleness is a
+  well-defined `learner.version - behavior_version`;
+- per-update staleness accounting (histogram + the `rl/learn` span
+  carries the staleness it trained on);
+- durable state through `CheckpointManager`: periodic COMMITTED
+  checkpoints of (params, opt_state, version, num_updates), and
+  `restore_latest()` for the killed-learner chaos path — torn saves are
+  invisible by construction, so a resume never reads a half-written
+  tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.impala import IMPALAConfig, _VTraceLearner
+from ray_tpu.util import events, spans
+from ray_tpu.util.metrics import Counter, Histogram
+
+_MET = None
+
+
+def _metrics() -> dict:
+    global _MET
+    if _MET is None:
+        _MET = {
+            "updates": Counter(
+                "rl_learner_updates", "V-trace SGD updates applied"),
+            "staleness": Histogram(
+                "rl_update_staleness",
+                "Policy-version staleness of each trained batch",
+                buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 8.0)),
+        }
+    return _MET
+
+
+class StaleTolerantLearner:
+    def __init__(self, obs_dim: int, num_actions: int, *,
+                 hidden=(64, 64), gamma: float = 0.99, lr: float = 6e-4,
+                 grad_clip: float = 40.0, vf_loss_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01,
+                 clip_rho_threshold: float = 1.0,
+                 clip_c_threshold: float = 1.0, seed: int = 0,
+                 ckpt_dir: Optional[str] = None, ckpt_interval: int = 20,
+                 keep_last_k: int = 3):
+        cfg = IMPALAConfig()
+        cfg.gamma = gamma
+        cfg.lr = lr
+        cfg.grad_clip = grad_clip
+        cfg.vf_loss_coeff = vf_loss_coeff
+        cfg.entropy_coeff = entropy_coeff
+        cfg.clip_rho_threshold = clip_rho_threshold
+        cfg.clip_c_threshold = clip_c_threshold
+        self._core = _VTraceLearner(obs_dim, num_actions, cfg, hidden, seed)
+        self.version = 1          # the initial weights ARE version 1
+        self.num_updates = 0
+        self.ckpt_interval = int(ckpt_interval)
+        self._ckpt = None
+        if ckpt_dir is not None:
+            from ray_tpu.checkpoint.manager import CheckpointManager
+            self._ckpt = CheckpointManager(ckpt_dir, keep_last_k=keep_last_k)
+
+    # -- training ----------------------------------------------------------
+    def update(self, batch, behavior_version: int) -> Dict[str, float]:
+        """One V-trace SGD step on a batch collected under
+        `behavior_version`.  The importance correction in the loss is
+        what licenses staleness > 0; bounding it is the queue's job."""
+        staleness = self.version - int(behavior_version)
+        met = _metrics()
+        met["staleness"].observe(float(max(0, staleness)))
+        train = {k: v for k, v in batch.items()
+                 if k not in ("policy_version", "valid")}
+        with spans.span("rl", "learn", version=self.version,
+                        staleness=staleness):
+            metrics = self._core.update(train)
+        self.num_updates += 1
+        met["updates"].inc()
+        if (self._ckpt is not None and self.ckpt_interval > 0
+                and self.num_updates % self.ckpt_interval == 0):
+            self.checkpoint()
+        metrics["staleness"] = float(staleness)
+        return metrics
+
+    def publish_boundary(self) -> Tuple[int, Any]:
+        """Advance the policy version and hand out the weights to
+        publish under it."""
+        self.version += 1
+        return self.version, self._core.get_weights()
+
+    def get_weights(self):
+        return self._core.get_weights()
+
+    # -- durability --------------------------------------------------------
+    def state_tree(self) -> Dict[str, Any]:
+        state = self._core.get_state()
+        return {"params": state["params"], "opt_state": state["opt_state"],
+                "version": np.asarray(self.version, np.int64),
+                "num_updates": np.asarray(self.num_updates, np.int64)}
+
+    def checkpoint(self, *, sync: bool = True) -> None:
+        """COMMITTED save at the current update count (sync by default:
+        the chaos gate's contract is that a checkpoint the learner
+        reported is one it can resume from)."""
+        if self._ckpt is None:
+            raise RuntimeError("learner built without ckpt_dir")
+        self._ckpt.save(self.num_updates, self.state_tree(), sync=sync)
+
+    def restore_latest(self) -> Optional[int]:
+        """Resume from the newest COMMITTED checkpoint; None when there
+        is none.  Returns the restored update count."""
+        if self._ckpt is None or self._ckpt.latest_step() is None:
+            return None
+        tree = self._ckpt.restore()
+        self._core.set_state({"params": tree["params"],
+                              "opt_state": tree["opt_state"]})
+        self.version = int(np.asarray(tree["version"]))
+        self.num_updates = int(np.asarray(tree["num_updates"]))
+        events.record("rl", "learner_resume", version=self.version,
+                      num_updates=self.num_updates)
+        return self.num_updates

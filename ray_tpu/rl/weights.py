@@ -1,0 +1,97 @@
+"""In-place weight publication: put once, adopt by reference.
+
+The learner's weights cross the process boundary exactly once per
+version boundary — one `ray_tpu.put` into the object plane — and every
+rollout actor receives the REFERENCE (`actor.adopt.remote(version,
+ref)`), pulling the payload zero-copy from the object store instead of
+having the driver pickle the tree into each actor call.  The publisher
+remembers the current (version, ref) pair so a re-formed rollout worker
+can re-adopt the live weights without a fresh put (`re_adopt`).
+
+Spans: the driver-side put + fan-out is one `rl/publish` span; each
+actor records its own `rl/adopt` span around the in-place engine swap,
+so `scale_attrib.py rl` can separate publish wall from rollout wall.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import ray_tpu
+from ray_tpu.util import spans
+from ray_tpu.util.metrics import Counter, Histogram
+
+_MET = None
+
+
+def _metrics() -> dict:
+    global _MET
+    if _MET is None:
+        _MET = {
+            "publishes": Counter(
+                "rl_weight_publishes",
+                "Weight versions published through the object plane"),
+            "adoptions": Counter(
+                "rl_weight_adoptions",
+                "Per-actor adoptions of a published weight reference"),
+            "publish_s": Histogram(
+                "rl_weight_publish_s",
+                "Wall seconds per publish (one put + gang-wide adopt)",
+                buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                         1.0, 2.5, 5.0)),
+        }
+    return _MET
+
+
+class WeightPublisher:
+    """Driver-side fan-out of learner weights to a rollout gang."""
+
+    def __init__(self):
+        self.version = 0
+        self._ref: Any = None
+
+    def publish(self, weights: Any, actors: Sequence[Any], *,
+                version: Optional[int] = None,
+                wait: bool = True) -> Tuple[int, List[Any]]:
+        """Put `weights` once and fan the reference to `actors`.
+
+        Returns (version, failed_actors): adoption failures (dead
+        actors) are collected, not raised, so the controller can replace
+        the worker and `re_adopt` the replacement.  With wait=False the
+        adopt calls are left in flight (the engine swap is between-steps
+        safe, so nothing downstream needs the barrier)."""
+        import time
+        t0 = time.monotonic()
+        self.version = (int(version) if version is not None
+                        else self.version + 1)
+        failed: List[Any] = []
+        with spans.span("rl", "publish", version=self.version,
+                        actors=len(actors)):
+            self._ref = ray_tpu.put(weights)
+            refs = [(a, a.adopt.remote(self.version, self._ref))
+                    for a in actors]
+            if wait:
+                for a, ref in refs:
+                    try:
+                        ray_tpu.get(ref)
+                        _metrics()["adoptions"].inc()
+                    except Exception:
+                        failed.append(a)
+        met = _metrics()
+        met["publishes"].inc()
+        met["publish_s"].observe(time.monotonic() - t0)
+        return self.version, failed
+
+    def re_adopt(self, actor: Any) -> int:
+        """Hand the CURRENT (version, ref) to one actor — the re-formed
+        rollout worker path.  No new put: the payload is already in the
+        object plane."""
+        if self._ref is None:
+            raise RuntimeError("nothing published yet")
+        ray_tpu.get(actor.adopt.remote(self.version, self._ref))
+        _metrics()["adoptions"].inc()
+        return self.version
+
+    @property
+    def current_ref(self) -> Any:
+        return self._ref

@@ -37,7 +37,7 @@ from ray_tpu.util import tracing
 # Planes (the `plane` field of every event).  Free-form strings are
 # accepted; these constants document the instrumented set.
 PLANES = ("sched", "object", "engine", "serve", "ckpt", "ingest", "train",
-          "proc", "gcs", "pp", "link", "kv")
+          "proc", "gcs", "pp", "link", "kv", "rl")
 
 
 class FlightRecorder:

@@ -438,6 +438,100 @@ def run_serve(n_requests: int = 24, groups: int = 4,
     assert not missing, f"serve phases absent from attribution: {missing}"
 
 
+# Actor/learner RL phases, innermost first on the DRIVER's critical
+# path: learn (the jitted V-trace step) and publish (put + fan-out)
+# happen on the driver thread, adopt on the rollout actors, and rollout
+# spans elapse on the actors CONCURRENTLY with everything — so rollout
+# is last and keeps only its exposed remainder (driver wall spent
+# purely waiting on sample delivery), while its raw union length is
+# reported separately as the gang's total rollout wall.
+RL_PHASE_PRIORITY = ("learn", "publish", "adopt", "rollout")
+
+
+def run_rl(min_updates: int = 30):
+    """Attribute an async actor/learner RL loop's wall clock across
+    rollout / publish / adopt / learn.
+
+    Runs the Podracer controller (2 CartPole rollout actors feeding the
+    stale-tolerant V-trace learner, publish every update) for
+    `min_updates` learner updates, then scrapes the cluster event
+    stream for the window (rollout/adopt spans live in the actor rings,
+    publish/learn in the driver's) and union-sweeps the `rl` plane.
+    The headline ratio is publish wall vs the gang's rollout wall — the
+    in-place publication path is supposed to be invisible next to
+    generation."""
+    ray_tpu.init(
+        num_cpus=4, object_store_memory=256 << 20,
+        _system_config={"events_ring_size": 1 << 18})
+    from ray_tpu.rl import PodracerConfig
+    cfg = (PodracerConfig()
+           .environment("CartPole-v1")
+           .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                     rollout_fragment_length=32)
+           .training(staleness_bound=2, publish_interval=1,
+                     min_updates_per_step=1)
+           .debugging(seed=0))
+    algo = cfg.build()
+    algo.train()                                  # warm jit + gang
+    t0 = time.time()
+    while algo.learner.num_updates < min_updates + 1:
+        algo.train()
+    t1 = time.time()
+    total_s = t1 - t0
+    updates = algo.learner.num_updates - 1
+    print(f"rl(podracer): {updates} learner updates / "
+          f"{algo.learner.version} published versions in {total_s:.2f}s")
+    time.sleep(1.5)                                     # let rings settle
+
+    evs = state.events(since=t0 - 1.0)
+    table, _roots = state.build_spans(evs)
+    flat = [r for r in table.values() if r.get("plane") == "rl"]
+    phases, unattributed = attribute(flat, t0, t1,
+                                     priority=RL_PHASE_PRIORITY)
+    coverage = 1.0 - unattributed / total_s
+
+    def raw(kind):
+        return _len(_union([(max(r["start"], t0), min(r["end"], t1))
+                            for r in flat
+                            if r["kind"] == kind
+                            and r["start"] is not None
+                            and r["end"] is not None
+                            and min(r["end"], t1) > max(r["start"], t0)]))
+
+    rollout_raw = raw("rollout")
+    publish_raw = raw("publish") + raw("adopt")
+    ratio = publish_raw / rollout_raw if rollout_raw > 0 else 0.0
+    ranked = sorted(((k, v) for k, v in phases.items() if v > 0),
+                    key=lambda kv: -kv[1])
+    doc = {
+        "workload": "rl_podracer",
+        "updates": updates,
+        "published_versions": algo.learner.version,
+        "wall_clock_s": round(total_s, 3),
+        "spans_observed": len(flat),
+        "phases_s": {k: round(v, 3) for k, v in ranked},
+        "phases_frac": {k: round(v / total_s, 4) for k, v in ranked},
+        "top_phases": [k for k, _ in ranked[:3]],
+        "rollout_wall_s": round(rollout_raw, 3),
+        "publish_wall_s": round(publish_raw, 3),
+        "publish_frac_of_rollout": round(ratio, 4),
+        "queue": algo.queue.stats(),
+        "unattributed_s": round(unattributed, 3),
+        "coverage": round(coverage, 4),
+    }
+    _report(ranked, total_s, unattributed, coverage)
+    print(f"  rollout wall (gang total) {rollout_raw:.3f}s; publish+adopt "
+          f"{publish_raw:.3f}s ({ratio:.1%} of rollout)")
+    _write({"rl": doc})
+    algo.stop()
+    ray_tpu.shutdown()
+    # The actor/learner phases MUST be visible — that is this mode's
+    # point — and publication must stay small next to generation.
+    have = set(doc["phases_s"])
+    missing = {"rollout", "learn", "publish"} - have
+    assert not missing, f"rl phases absent from attribution: {missing}"
+
+
 def main():
     ray_tpu.init(
         num_cpus=2, object_store_memory=256 << 20,
@@ -490,6 +584,8 @@ if __name__ == "__main__":
         run_actor_storm(int(sys.argv[2]) if len(sys.argv) > 2 else 200)
     elif len(sys.argv) > 1 and sys.argv[1] == "serve":
         run_serve(int(sys.argv[2]) if len(sys.argv) > 2 else 24)
+    elif len(sys.argv) > 1 and sys.argv[1] == "rl":
+        run_rl(int(sys.argv[2]) if len(sys.argv) > 2 else 30)
     elif len(sys.argv) > 1 and sys.argv[1] == "pp":
         # pp [steps] [interleave] [prefetch:0|1]
         run_pipeline(

@@ -443,7 +443,11 @@ def test_partition_then_heal_fences_stale_node():
             return None
 
         # Phase 1: the partition opens and the head declares node2 dead.
-        deadline = time.monotonic() + 45
+        # Generous deadlines throughout: every phase is a wait-until on
+        # heartbeat/fence timers that stretch under CI load — the loops
+        # exit as soon as the condition lands, so a wide window costs
+        # nothing on a healthy box and only absorbs scheduler noise.
+        deadline = time.monotonic() + 90
         while time.monotonic() < deadline:
             info = node2_info()
             if info is not None and not info.alive:
@@ -460,7 +464,7 @@ def test_partition_then_heal_fences_stale_node():
 
         # Phase 2: the link heals, the node fences itself and rejoins as
         # the next incarnation.
-        deadline = time.monotonic() + 45
+        deadline = time.monotonic() + 90
         while time.monotonic() < deadline:
             info = node2_info()
             if info is not None and info.alive and \
@@ -473,7 +477,7 @@ def test_partition_then_heal_fences_stale_node():
 
         # Phase 3: the failover lands back on the healed node as a FRESH
         # worker; the stale incarnation is dead and its state is gone.
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 90
         pid2 = None
         while time.monotonic() < deadline:
             try:
@@ -490,7 +494,7 @@ def test_partition_then_heal_fences_stale_node():
             p, v = ray_tpu.get(c.inc.remote(), timeout=30)
             assert (p, v) == (pid2, expect)
         # The stale worker was killed by the fence, not left running.
-        fence_deadline = time.monotonic() + 20
+        fence_deadline = time.monotonic() + 60
         while time.monotonic() < fence_deadline:
             try:
                 os.kill(pid1, 0)

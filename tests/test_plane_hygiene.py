@@ -182,6 +182,30 @@ def test_kv_plane_kinds_present():
     assert not missing, f"kv plane kinds vanished: {missing}"
 
 
+def test_rl_plane_kinds_present():
+    """The Podracer actor/learner substrate (PR 20) is attributable only
+    because these kinds exist: scale_attrib's rl mode carves wall into
+    rollout/learn/publish/adopt via the spans, and the chaos gates +
+    staleness accounting key on the instants.  Pin them so refactors
+    cannot silently blind the tooling."""
+    sites = {(pl, k) for _, _, pl, k in _call_sites()}
+    required_spans = {
+        ("rl", "publish"),        # driver: one put + gang-wide adopt fan-out
+        ("rl", "adopt"),          # actor: in-place weight swap (live lanes)
+        ("rl", "rollout"),        # actor: one versioned fragment/episode gang
+        ("rl", "learn"),          # learner: one V-trace SGD step
+    }
+    required_instants = {
+        ("rl", "stale_drop"),     # queue: batch beyond the staleness bound
+        ("rl", "backpressure"),   # queue: producer held, queue full
+        ("rl", "worker_replaced"),  # controller: rollout gang re-formed
+        ("rl", "learner_resume"),   # learner: restored from COMMITTED ckpt
+        ("engine", "weights_swap"),  # engine: params swapped between steps
+    }
+    missing = (required_spans | required_instants) - sites
+    assert not missing, f"rl plane kinds vanished: {missing}"
+
+
 def test_gcs_ft_event_kinds_present():
     """The head-survival plane (PR 16) is observable only through these
     instants: the availability bench and the chaos gates key on the

@@ -338,12 +338,23 @@ def test_pb2_gp_explore_targets_good_region():
 
 def test_pb2_end_to_end_migrates_bad_trials(cluster):
     def objective(config):
+        import time as _time
+
         from ray_tpu.tune import get_checkpoint
         start = 0
         ckpt = get_checkpoint()
         if ckpt is not None:
             start = ckpt.to_dict()["step"] + 1
-        for step in range(start, 12):
+        for step in range(start, 16):
+            # Pace the loop so concurrently-launched trials OVERLAP in
+            # wall time even when worker spawns stagger under CI load:
+            # exploitation only happens at a perturbation boundary where
+            # the scheduler has windows from the trial's peers, so a bad
+            # trial that sprints through every step before its peers
+            # report anything never migrates — the load-timing flake
+            # this pacing (plus the extra boundaries of 16 steps over
+            # 12) retires.
+            _time.sleep(0.05)
             tune.report({"score": config["lr"] * (step + 1)},
                         checkpoint=Checkpoint.from_dict({"step": step}))
 

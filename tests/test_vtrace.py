@@ -1,0 +1,166 @@
+"""V-trace unit tests against hand-computed references (PR 20 satellite).
+
+`rllib/vtrace.py` is the correction that licenses the stale-tolerant
+learner in `ray_tpu/rl/` — these tests pin its math to literal
+hand-worked numbers and to an independent numpy recursion, so a refactor
+of the lax.scan cannot silently bend the off-policy targets:
+
+- on-policy (behavior == target): rhos == cs == 1 and vs_t must equal
+  the plain discounted n-step return bootstrapped with V;
+- clipped-rho off-policy: a tiny T=2 case worked out by hand on paper,
+  asserted to the digit;
+- general off-policy: random fragments vs a per-env python recursion of
+  Espeholt et al. (2018) eq. (1) with explicit min(rho_bar, .) /
+  min(c_bar, .) clipping;
+- termination masking: a zero discount at t cuts all credit flow across
+  the boundary.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.vtrace import vtrace
+
+
+def _np_vtrace(behavior_logp, target_logp, rewards, discounts, values,
+               bootstrap, rho_bar=1.0, c_bar=1.0):
+    """Independent reference: the Espeholt et al. recursion in plain
+    python, one env at a time."""
+    T, B = rewards.shape
+    rhos = np.exp(target_logp - behavior_logp)
+    crho = np.minimum(rho_bar, rhos)
+    cs = np.minimum(c_bar, rhos)
+    vs = np.zeros((T, B), np.float64)
+    for b in range(B):
+        acc = 0.0
+        for t in range(T - 1, -1, -1):
+            v_tp1 = values[t + 1, b] if t + 1 < T else bootstrap[b]
+            delta = crho[t, b] * (rewards[t, b]
+                                  + discounts[t, b] * v_tp1 - values[t, b])
+            acc = delta + discounts[t, b] * cs[t, b] * acc
+            vs[t, b] = values[t, b] + acc
+    vs_tp1 = np.concatenate([vs[1:], bootstrap[None]], axis=0)
+    pg = crho * (rewards + discounts * vs_tp1 - values)
+    return vs, pg
+
+
+def test_vtrace_hand_computed_clipped_rho_case():
+    """T=2, B=1, worked by hand: gamma=0.9, values (1, 2), bootstrap 3,
+    rewards (0.5, 1), rhos (2, 0.5) -> clipped rhos (1, 0.5).
+
+      delta_1 = 0.5 * (1.0 + 0.9*3.0 - 2.0)        = 0.85
+      delta_0 = 1.0 * (0.5 + 0.9*2.0 - 1.0)        = 1.30
+      vs_1    = 2.0 + 0.85                          = 2.85
+      vs_0    = 1.0 + 1.30 + 0.9 * 1.0 * 0.85      = 3.065
+      pg_0    = 1.0 * (0.5 + 0.9*2.85 - 1.0)       = 2.065
+      pg_1    = 0.5 * (1.0 + 0.9*3.0 - 2.0)        = 0.85
+    """
+    import jax.numpy as jnp
+
+    behavior = np.log(np.array([[1.0], [1.0]], np.float32))
+    target = np.log(np.array([[2.0], [0.5]], np.float32))
+    rewards = np.array([[0.5], [1.0]], np.float32)
+    discounts = np.full((2, 1), 0.9, np.float32)
+    values = np.array([[1.0], [2.0]], np.float32)
+    bootstrap = np.array([3.0], np.float32)
+
+    out = vtrace(jnp.asarray(behavior), jnp.asarray(target),
+                 jnp.asarray(rewards), jnp.asarray(discounts),
+                 jnp.asarray(values), jnp.asarray(bootstrap),
+                 clip_rho_threshold=1.0, clip_c_threshold=1.0)
+    np.testing.assert_allclose(np.asarray(out.vs),
+                               [[3.065], [2.85]], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages),
+                               [[2.065], [0.85]], rtol=1e-5)
+
+
+def test_vtrace_on_policy_equals_nstep_return_and_td_advantage():
+    """behavior == target: vs_t is the discounted n-step return and the
+    pg advantage collapses to the 1-step TD error against vs."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    T, B = 10, 3
+    logp = rng.normal(size=(T, B)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=B).astype(np.float32)
+    discounts = np.full((T, B), 0.97, np.float32)
+
+    out = vtrace(jnp.asarray(logp), jnp.asarray(logp), jnp.asarray(rewards),
+                 jnp.asarray(discounts), jnp.asarray(values),
+                 jnp.asarray(bootstrap))
+    vs = np.asarray(out.vs)
+
+    expected = np.empty_like(values)
+    nxt = bootstrap.astype(np.float64)
+    for t in range(T - 1, -1, -1):
+        expected[t] = rewards[t] + discounts[t] * nxt
+        nxt = expected[t]
+    np.testing.assert_allclose(vs, expected, rtol=1e-4, atol=1e-4)
+
+    vs_tp1 = np.concatenate([vs[1:], bootstrap[None]], axis=0)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages),
+                               rewards + discounts * vs_tp1 - values,
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("rho_bar,c_bar", [(1.0, 1.0), (2.0, 0.9),
+                                           (0.5, 0.5)])
+def test_vtrace_off_policy_matches_python_recursion(rho_bar, c_bar):
+    """Random off-policy fragments vs the independent per-env numpy
+    recursion, across clipping thresholds (including c_bar != rho_bar)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(int(rho_bar * 10 + c_bar))
+    T, B = 9, 4
+    behavior = rng.normal(size=(T, B)).astype(np.float32)
+    target = (behavior + rng.normal(scale=0.7, size=(T, B))).astype(
+        np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=B).astype(np.float32)
+    dones = rng.random((T, B)) < 0.2
+    discounts = (0.99 * (~dones)).astype(np.float32)
+
+    out = vtrace(jnp.asarray(behavior), jnp.asarray(target),
+                 jnp.asarray(rewards), jnp.asarray(discounts),
+                 jnp.asarray(values), jnp.asarray(bootstrap),
+                 clip_rho_threshold=rho_bar, clip_c_threshold=c_bar)
+    ref_vs, ref_pg = _np_vtrace(behavior, target, rewards, discounts,
+                                values, bootstrap, rho_bar, c_bar)
+    np.testing.assert_allclose(np.asarray(out.vs), ref_vs,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages), ref_pg,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_vtrace_zero_discount_stops_credit_flow():
+    """A terminal at t (discount 0) makes vs before the boundary
+    independent of everything after it — the episode seam is absolute."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    T, B = 8, 2
+    behavior = rng.normal(size=(T, B)).astype(np.float32)
+    target = rng.normal(size=(T, B)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=B).astype(np.float32)
+    discounts = np.full((T, B), 0.99, np.float32)
+    discounts[3] = 0.0  # terminal transition at t=3
+
+    out1 = vtrace(jnp.asarray(behavior), jnp.asarray(target),
+                  jnp.asarray(rewards), jnp.asarray(discounts),
+                  jnp.asarray(values), jnp.asarray(bootstrap))
+    # Scramble everything after the terminal; vs[:4] must not move.
+    rewards2 = rewards.copy()
+    rewards2[4:] += 100.0
+    values2 = values.copy()
+    values2[4:] -= 50.0
+    out2 = vtrace(jnp.asarray(behavior), jnp.asarray(target),
+                  jnp.asarray(rewards2), jnp.asarray(discounts),
+                  jnp.asarray(values2), jnp.asarray(bootstrap * 0 + 99))
+    np.testing.assert_allclose(np.asarray(out1.vs)[:4],
+                               np.asarray(out2.vs)[:4],
+                               rtol=1e-4, atol=1e-4)
